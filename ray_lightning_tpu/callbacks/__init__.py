@@ -1,0 +1,13 @@
+from ray_lightning_tpu.callbacks.base import Callback
+from ray_lightning_tpu.callbacks.checkpoint import ModelCheckpoint
+from ray_lightning_tpu.callbacks.early_stopping import EarlyStopping
+from ray_lightning_tpu.callbacks.throughput import ThroughputMonitor
+from ray_lightning_tpu.callbacks.profiler import ProfilerCallback
+
+__all__ = [
+    "Callback",
+    "ModelCheckpoint",
+    "EarlyStopping",
+    "ThroughputMonitor",
+    "ProfilerCallback",
+]

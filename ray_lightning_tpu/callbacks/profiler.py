@@ -1,0 +1,46 @@
+"""jax.profiler trace capture around a window of training steps.
+
+The reference has no first-party profiler (SURVEY §5); this provides
+TensorBoard-compatible XLA traces, the idiomatic TPU observability tool.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ray_lightning_tpu.callbacks.base import Callback
+
+
+class ProfilerCallback(Callback):
+    def __init__(
+        self,
+        log_dir: Optional[str] = None,
+        start_step: int = 5,
+        num_steps: int = 3,
+    ):
+        self.log_dir = log_dir
+        self.start_step = start_step
+        self.num_steps = num_steps
+        self._active = False
+
+    def setup(self, trainer, module, stage: str) -> None:
+        if self.log_dir is None:
+            self.log_dir = os.path.join(trainer.default_root_dir, "profile")
+
+    def on_train_batch_start(self, trainer, module, batch, batch_idx) -> None:
+        if trainer.global_step == self.start_step and not self._active:
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+
+    def on_train_batch_end(self, trainer, module, outputs, batch, batch_idx) -> None:
+        if self._active and trainer.global_step >= self.start_step + self.num_steps:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def on_train_end(self, trainer, module) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False

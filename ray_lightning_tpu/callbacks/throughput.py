@@ -1,0 +1,105 @@
+"""Step-time / throughput / MFU monitor.
+
+First-class upgrade of the reference's example-only ``CUDACallback`` (epoch
+seconds + peak CUDA memory, reference:
+ray_lightning/examples/ray_ddp_sharded_example.py:16-45): measures per-step
+wall time, samples/sec, optional tokens/sec/chip and model-FLOPs-utilization
+against the chip's peak matmul throughput.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ray_lightning_tpu.callbacks.base import Callback
+
+# Peak bf16 matmul TFLOP/s per chip for common TPU generations (public specs).
+_PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5e": 197.0,
+    "v5 lite": 197.0,
+    "v5p": 459.0,
+    "v6e": 918.0,
+}
+_DEFAULT_PEAK_TFLOPS = 197.0
+_CPU_ESTIMATE_TFLOPS = 0.1  # so tests on CPU produce finite MFU numbers
+
+
+def detect_peak_tflops() -> float:
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    if dev.platform == "cpu":
+        return _CPU_ESTIMATE_TFLOPS
+    for key, tflops in _PEAK_TFLOPS.items():
+        if key in kind:
+            return tflops
+    return _DEFAULT_PEAK_TFLOPS
+
+
+class ThroughputMonitor(Callback):
+    def __init__(
+        self,
+        flops_per_sample: Optional[float] = None,
+        tokens_per_sample: Optional[int] = None,
+        window: int = 20,
+        log_every_n_steps: int = 0,
+    ):
+        self.flops_per_sample = flops_per_sample
+        self.tokens_per_sample = tokens_per_sample
+        self.window = window
+        self.log_every_n_steps = log_every_n_steps
+        self._times: list = []
+        self._t0: Optional[float] = None
+        self._batch_size: Optional[int] = None
+
+    @staticmethod
+    def _infer_batch_size(batch) -> int:
+        leaves = jax.tree_util.tree_leaves(batch)
+        return int(leaves[0].shape[0]) if leaves else 0
+
+    def on_train_batch_start(self, trainer, module, batch, batch_idx) -> None:
+        self._batch_size = self._infer_batch_size(batch)
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, trainer, module, outputs, batch, batch_idx) -> None:
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        self._times.append(dt)
+        if len(self._times) > self.window:
+            self._times.pop(0)
+        if (
+            self.log_every_n_steps
+            and trainer.global_step % self.log_every_n_steps == 0
+            and trainer.logger is not None
+        ):
+            trainer.logger.log_metrics(self.summary(trainer), step=trainer.global_step)
+
+    def summary(self, trainer) -> dict:
+        if not self._times or not self._batch_size:
+            return {}
+        # skip the first (compile-laden) step when possible
+        times = self._times[1:] if len(self._times) > 1 else self._times
+        step_time = float(np.mean(times))
+        n_chips = max(1, trainer.world_size * jax.local_device_count())
+        global_batch = self._batch_size * max(1, trainer.world_size)
+        out = {
+            "step_time_s": step_time,
+            "samples_per_sec": global_batch / step_time,
+        }
+        if self.tokens_per_sample:
+            out["tokens_per_sec_per_chip"] = (
+                global_batch * self.tokens_per_sample / step_time / n_chips
+            )
+        if self.flops_per_sample:
+            achieved = global_batch * self.flops_per_sample / step_time / n_chips
+            out["mfu"] = achieved / (detect_peak_tflops() * 1e12)
+        return out
+
+    def on_train_end(self, trainer, module) -> None:
+        summary = self.summary(trainer)
+        for k, v in summary.items():
+            trainer.callback_metrics[k] = np.asarray(v)

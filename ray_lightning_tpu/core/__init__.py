@@ -1,0 +1,14 @@
+from ray_lightning_tpu.core.module import LightningModule
+from ray_lightning_tpu.core.datamodule import LightningDataModule
+from ray_lightning_tpu.core.data import DataLoader, Dataset, TensorDataset, DistributedSampler
+from ray_lightning_tpu.core.trainer import Trainer
+
+__all__ = [
+    "LightningModule",
+    "LightningDataModule",
+    "DataLoader",
+    "Dataset",
+    "TensorDataset",
+    "DistributedSampler",
+    "Trainer",
+]

@@ -1,0 +1,249 @@
+"""Host-side data pipeline: datasets, loaders, distributed sharding.
+
+TPU-first rules applied here:
+- batches are **host numpy** until the instant they're needed, then moved to
+  device in one ``device_put`` with a ``NamedSharding`` over the mesh's data
+  axis (no per-example transfers);
+- training loaders drop the trailing partial batch by default so every jitted
+  step sees one static shape (XLA recompiles on shape change);
+- distributed sharding mirrors the reference's DistributedSampler injection
+  (reference: ray_lightning/ray_ddp.py:315-324): worker ``rank`` of
+  ``num_replicas`` takes every ``num_replicas``-th index after a seeded
+  per-epoch shuffle.
+
+Torch datasets/dataloaders are accepted and converted to numpy at the
+boundary (torch here is CPU-only input tooling, never the compute path).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class Dataset:
+    """Minimal map-style dataset protocol."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def __getitem__(self, idx: int):
+        raise NotImplementedError
+
+
+class TensorDataset(Dataset):
+    def __init__(self, *arrays):
+        assert arrays and all(len(a) == len(arrays[0]) for a in arrays)
+        self.arrays = [np.asarray(a) for a in arrays]
+
+    def __len__(self):
+        return len(self.arrays[0])
+
+    def __getitem__(self, idx):
+        items = tuple(a[idx] for a in self.arrays)
+        return items[0] if len(items) == 1 else items
+
+
+class DictDataset(Dataset):
+    def __init__(self, **arrays):
+        lens = {len(v) for v in arrays.values()}
+        assert len(lens) == 1
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+
+    def __len__(self):
+        return len(next(iter(self.arrays.values())))
+
+    def __getitem__(self, idx):
+        return {k: v[idx] for k, v in self.arrays.items()}
+
+
+class RandomDataset(Dataset):
+    """Gaussian features, parity with reference tests/utils.py:16-25."""
+
+    def __init__(self, size: int, length: int, seed: int = 0):
+        self.data = np.random.default_rng(seed).standard_normal(
+            (length, size), dtype=np.float32
+        )
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+
+class DistributedSampler:
+    """Deterministic rank-sharded index sampler.
+
+    ``set_epoch`` reshuffles per epoch with ``seed + epoch`` so all replicas
+    agree on the permutation, then each takes a strided slice.
+    """
+
+    def __init__(
+        self,
+        data_len: int,
+        num_replicas: int = 1,
+        rank: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if rank >= num_replicas:
+            raise ValueError(f"rank {rank} >= num_replicas {num_replicas}")
+        self.data_len = data_len
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        if drop_last:
+            self.num_samples = data_len // num_replicas
+        else:
+            self.num_samples = math.ceil(data_len / num_replicas)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.num_samples
+
+    def __iter__(self) -> Iterator[int]:
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            indices = rng.permutation(self.data_len)
+        else:
+            indices = np.arange(self.data_len)
+        total = self.num_samples * self.num_replicas
+        if not self.drop_last and total > len(indices):
+            # pad by wrapping so every replica sees the same count
+            indices = np.concatenate([indices, indices[: total - len(indices)]])
+        indices = indices[: total]
+        return iter(indices[self.rank :: self.num_replicas].tolist())
+
+
+def default_collate(items: Sequence[Any]):
+    """Stack a list of samples into a batch, preserving tuple/dict structure."""
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: default_collate([it[k] for it in items]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate(list(col)) for col in zip(*items))
+    try:
+        import torch
+
+        if isinstance(first, torch.Tensor):
+            return np.stack([it.detach().cpu().numpy() for it in items])
+    except ImportError:
+        pass
+    return np.stack([np.asarray(it) for it in items])
+
+
+def _to_numpy_tree(batch):
+    """Convert any torch tensors in a (possibly nested) batch to numpy."""
+    try:
+        import torch
+    except ImportError:
+        torch = None
+    if torch is not None and isinstance(batch, torch.Tensor):
+        return batch.detach().cpu().numpy()
+    if isinstance(batch, dict):
+        return {k: _to_numpy_tree(v) for k, v in batch.items()}
+    if isinstance(batch, (tuple, list)):
+        return type(batch)(_to_numpy_tree(v) for v in batch)
+    return batch
+
+
+class DataLoader:
+    """Map-style batch loader emitting numpy batches.
+
+    Accepts this package's :class:`Dataset` or any object with
+    ``__len__``/``__getitem__`` (torch datasets included).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        seed: int = 0,
+        sampler: Optional[DistributedSampler] = None,
+    ):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or default_collate
+        self.seed = seed
+        self.sampler = sampler
+        self._epoch = 0
+
+    # the strategy re-wraps loaders with a rank-sharding sampler
+    def with_sampler(self, sampler: DistributedSampler) -> "DataLoader":
+        return DataLoader(
+            self.dataset,
+            batch_size=self.batch_size,
+            shuffle=False,  # sampler owns shuffling
+            drop_last=self.drop_last,
+            collate_fn=self.collate_fn,
+            seed=self.seed,
+            sampler=sampler,
+        )
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+        if self.sampler is not None:
+            self.sampler.set_epoch(epoch)
+
+    def __len__(self):
+        n = len(self.sampler) if self.sampler is not None else len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return math.ceil(n / self.batch_size)
+
+    def __iter__(self):
+        if self.sampler is not None:
+            indices = list(self.sampler)
+        elif self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            indices = rng.permutation(len(self.dataset)).tolist()
+        else:
+            indices = list(range(len(self.dataset)))
+        bs = self.batch_size
+        stop = len(indices) - len(indices) % bs if self.drop_last else len(indices)
+        for start in range(0, stop, bs):
+            chunk = indices[start : start + bs]
+            if self.drop_last and len(chunk) < bs:
+                break
+            yield _to_numpy_tree(self.collate_fn([self.dataset[i] for i in chunk]))
+
+
+class _ForeignLoader:
+    """Wraps an arbitrary iterable (e.g. a torch DataLoader) into numpy."""
+
+    def __init__(self, loader):
+        self.loader = loader
+
+    def set_epoch(self, epoch: int) -> None:
+        sampler = getattr(self.loader, "sampler", None)
+        if sampler is not None and hasattr(sampler, "set_epoch"):
+            sampler.set_epoch(epoch)
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __iter__(self):
+        for batch in self.loader:
+            yield _to_numpy_tree(batch)
+
+
+def ensure_loader(loader):
+    """Normalize user-supplied loaders to an object with our iteration API."""
+    if loader is None or isinstance(loader, (DataLoader, _ForeignLoader)):
+        return loader
+    if hasattr(loader, "__iter__"):
+        return _ForeignLoader(loader)
+    raise TypeError(f"Cannot use {type(loader)!r} as a dataloader")

@@ -1,0 +1,32 @@
+"""LightningDataModule parity (prepare_data/setup/*_dataloader hooks).
+
+Reference usage: examples construct ``MNISTDataModule``-style objects and the
+launcher calls ``prepare_data`` on each worker before setup (reference:
+ray_lightning/launchers/ray_launcher.py:290).
+"""
+from __future__ import annotations
+
+
+class LightningDataModule:
+    def __init__(self):
+        self._has_setup = set()
+
+    def prepare_data(self) -> None:
+        """Download / write to disk. Called once per node (rank-zero style)."""
+
+    def setup(self, stage: str) -> None:
+        """Build datasets. Called on every process for the given stage."""
+
+    def teardown(self, stage: str) -> None: ...
+
+    def train_dataloader(self):
+        return None
+
+    def val_dataloader(self):
+        return None
+
+    def test_dataloader(self):
+        return None
+
+    def predict_dataloader(self):
+        return None

@@ -1,0 +1,776 @@
+"""The Trainer: PTL-parity fit/validate/test/predict driving a compiled step.
+
+Architecture (TPU-first, not a port):
+- The entire optimization step — forward, backward, optimizer update, metric
+  computation — is ONE ``jax.jit``-compiled function, traced once per
+  (shape, dtype) signature and executed every step on device. There is no
+  eager per-batch Python in the hot loop beyond host->device batch transfer
+  and callback dispatch.
+- Distribution is delegated to the Strategy's shardings; XLA GSPMD inserts
+  the collectives. ``params``/``opt_state`` are donated each step so the
+  update is in-place in HBM.
+- When the Strategy has a launcher (Ray-actor strategies), ``fit`` ships the
+  whole (trainer, module) to workers and recovers rank-0 results — the
+  reference's launch flow (reference: ray_lightning/launchers/
+  ray_launcher.py:48-69,252-310) with byte-stream weights instead of
+  ``torch.save``.
+
+Hot-loop hygiene: per-step logged values stay as device arrays; host
+synchronization happens only at logger flush points and epoch boundaries.
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import serialization as flax_serialization
+
+from ray_lightning_tpu.callbacks.base import Callback
+from ray_lightning_tpu.callbacks.checkpoint import ModelCheckpoint
+from ray_lightning_tpu.core.data import DataLoader, DistributedSampler, ensure_loader
+from ray_lightning_tpu.core.module import LightningModule
+from ray_lightning_tpu.loggers.base import Logger
+from ray_lightning_tpu.loggers.csv_logger import CSVLogger
+from ray_lightning_tpu.strategies.base import Strategy, XLAStrategy
+from ray_lightning_tpu.utils.seed import seed_everything
+from ray_lightning_tpu.utils.serialization import to_state_stream, load_state_stream
+
+__version__ = "0.1.0"
+
+
+@dataclass
+class TrainerState:
+    fn: Optional[str] = None  # fit | validate | test | predict
+    status: str = "initializing"  # running | finished | interrupted
+
+    def as_dict(self) -> Dict[str, str]:
+        return {"fn": self.fn or "", "status": self.status}
+
+
+@dataclass
+class _EpochAggregator:
+    """Accumulates per-batch on_epoch metrics as device scalars; reduces at
+    epoch end (single host sync)."""
+
+    sums: Dict[str, list] = field(default_factory=dict)
+    weights: Dict[str, list] = field(default_factory=dict)
+
+    def update(self, logs: Dict[str, Any], batch_size: int) -> None:
+        for name, value in logs.items():
+            self.sums.setdefault(name, []).append(value)
+            self.weights.setdefault(name, []).append(batch_size)
+
+    def reduce(self, meta_lookup) -> Dict[str, np.ndarray]:
+        out = {}
+        for name, values in self.sums.items():
+            vals = np.asarray(jax.device_get(values), dtype=np.float64)
+            meta = meta_lookup(name)
+            reduce_kind = meta.reduce if meta else "mean"
+            if reduce_kind == "mean":
+                w = np.asarray(self.weights[name], dtype=np.float64)
+                out[name] = np.asarray(np.sum(vals * w) / max(np.sum(w), 1e-12))
+            elif reduce_kind == "sum":
+                out[name] = np.asarray(np.sum(vals))
+            elif reduce_kind == "max":
+                out[name] = np.asarray(np.max(vals))
+            elif reduce_kind == "min":
+                out[name] = np.asarray(np.min(vals))
+            else:
+                out[name] = np.asarray(vals[-1])
+        return out
+
+
+class Trainer:
+    def __init__(
+        self,
+        max_epochs: Optional[int] = None,
+        min_epochs: int = 0,
+        max_steps: int = -1,
+        callbacks: Optional[List[Callback]] = None,
+        logger: Any = True,
+        strategy: Optional[Strategy] = None,
+        accelerator: str = "auto",
+        devices: Any = "auto",
+        enable_checkpointing: bool = True,
+        default_root_dir: Optional[str] = None,
+        log_every_n_steps: int = 50,
+        check_val_every_n_epoch: int = 1,
+        val_check_interval: Optional[int] = None,
+        num_sanity_val_steps: int = 0,
+        limit_train_batches: Optional[int] = None,
+        limit_val_batches: Optional[int] = None,
+        limit_test_batches: Optional[int] = None,
+        limit_predict_batches: Optional[int] = None,
+        gradient_clip_val: Optional[float] = None,
+        accumulate_grad_batches: int = 1,
+        precision: str = "32-true",
+        seed: Optional[int] = None,
+        enable_progress_bar: bool = False,
+        fast_dev_run: bool = False,
+        use_distributed_sampler: bool = True,
+    ):
+        self.max_epochs = max_epochs if max_epochs is not None else 1000
+        self.min_epochs = min_epochs
+        self.max_steps = max_steps
+        self.log_every_n_steps = log_every_n_steps
+        self.check_val_every_n_epoch = check_val_every_n_epoch
+        self.val_check_interval = val_check_interval
+        self.num_sanity_val_steps = num_sanity_val_steps
+        self.limit_train_batches = limit_train_batches
+        self.limit_val_batches = limit_val_batches
+        self.limit_test_batches = limit_test_batches
+        self.limit_predict_batches = limit_predict_batches
+        self.gradient_clip_val = gradient_clip_val
+        self.accumulate_grad_batches = accumulate_grad_batches
+        self.precision = precision
+        self.seed = seed
+        self.enable_progress_bar = enable_progress_bar
+        self.fast_dev_run = fast_dev_run
+        self.use_distributed_sampler = use_distributed_sampler
+        self.enable_checkpointing = enable_checkpointing and not fast_dev_run
+        if fast_dev_run:
+            self.max_epochs = 1
+            self.limit_train_batches = 1
+            self.limit_val_batches = 1
+            self.limit_test_batches = 1
+
+        self.default_root_dir = os.path.abspath(default_root_dir or os.getcwd())
+
+        self.strategy: Strategy = strategy or XLAStrategy()
+        self.accelerator = accelerator
+
+        self.callbacks: List[Callback] = list(callbacks or [])
+        if self.enable_checkpointing and not any(
+            isinstance(c, ModelCheckpoint) for c in self.callbacks
+        ):
+            self.callbacks.append(ModelCheckpoint())
+
+        if logger is True:
+            self.logger: Optional[Logger] = CSVLogger(
+                os.path.join(self.default_root_dir, "lightning_logs")
+            )
+        elif logger is False or logger is None:
+            self.logger = None
+        else:
+            self.logger = logger
+
+        # runtime state
+        self.state = TrainerState()
+        self.current_epoch = 0
+        self.global_step = 0
+        self.should_stop = False
+        self.sanity_checking = False
+        self.num_val_batches = 0
+        self.val_enabled = False
+        self._val_ran_this_epoch = False
+        self.callback_metrics: Dict[str, np.ndarray] = {}
+        self.logged_metrics: Dict[str, Any] = {}
+        self._module: Optional[LightningModule] = None
+        self._params = None
+        self._opt_state = None
+        self._tx = None
+        self._rng_root = None
+        self._datamodule = None
+        self._restored_ckpt: Optional[Dict[str, Any]] = None
+
+    # ------------------------------------------------------------------ #
+    # public properties
+    # ------------------------------------------------------------------ #
+    @property
+    def world_size(self) -> int:
+        return self.strategy.world_size
+
+    @property
+    def global_rank(self) -> int:
+        return self.strategy.global_rank
+
+    @property
+    def local_rank(self) -> int:
+        return self.strategy.local_rank
+
+    @property
+    def is_global_zero(self) -> bool:
+        return self.strategy.is_global_zero
+
+    @property
+    def is_global_zero_writer(self) -> bool:
+        """Who writes checkpoints: global rank 0 (driver or worker-0)."""
+        return self.strategy.is_global_zero
+
+    @property
+    def lightning_module(self) -> Optional[LightningModule]:
+        return self._module
+
+    @property
+    def model(self) -> Optional[LightningModule]:
+        return self._module
+
+    @property
+    def checkpoint_callback(self) -> Optional[ModelCheckpoint]:
+        for cb in self.callbacks:
+            if isinstance(cb, ModelCheckpoint):
+                return cb
+        return None
+
+    @property
+    def checkpoint_callbacks(self) -> List[ModelCheckpoint]:
+        return [cb for cb in self.callbacks if isinstance(cb, ModelCheckpoint)]
+
+    @property
+    def early_stopping_callback(self):
+        from ray_lightning_tpu.callbacks.early_stopping import EarlyStopping
+
+        for cb in self.callbacks:
+            if isinstance(cb, EarlyStopping):
+                return cb
+        return None
+
+    @property
+    def params(self):
+        return self._params
+
+    # ------------------------------------------------------------------ #
+    # callback dispatch
+    # ------------------------------------------------------------------ #
+    def _hook(self, name: str, *args) -> None:
+        module_hook = getattr(self._module, name, None)
+        if callable(module_hook):
+            module_hook(*args)
+        for cb in self.callbacks:
+            getattr(cb, name)(self, self._module, *args)
+
+    def _cb(self, name: str, *args) -> None:
+        for cb in self.callbacks:
+            getattr(cb, name)(self, self._module, *args)
+
+    # ------------------------------------------------------------------ #
+    # entry points
+    # ------------------------------------------------------------------ #
+    def fit(
+        self,
+        model: LightningModule,
+        train_dataloaders=None,
+        val_dataloaders=None,
+        datamodule=None,
+        ckpt_path: Optional[str] = None,
+    ) -> None:
+        self.state.fn = "fit"
+        self._launch(
+            self._fit_impl, model, train_dataloaders, val_dataloaders, datamodule, ckpt_path
+        )
+
+    def validate(
+        self, model=None, dataloaders=None, datamodule=None, ckpt_path=None, verbose=True
+    ):
+        self.state.fn = "validate"
+        return self._launch(self._eval_impl, model, dataloaders, datamodule, ckpt_path, "val")
+
+    def test(
+        self, model=None, dataloaders=None, datamodule=None, ckpt_path=None, verbose=True
+    ):
+        self.state.fn = "test"
+        return self._launch(self._eval_impl, model, dataloaders, datamodule, ckpt_path, "test")
+
+    def predict(self, model=None, dataloaders=None, datamodule=None, ckpt_path=None):
+        self.state.fn = "predict"
+        return self._launch(self._predict_impl, model, dataloaders, datamodule, ckpt_path)
+
+    def _launch(self, fn, model, *args):
+        model = model or self._module
+        if model is None:
+            raise ValueError("no model provided")
+        self._module = model
+        model.trainer = self
+        self.strategy.connect(self, model)
+        launcher = self.strategy.launcher
+        self.state.status = "running"
+        try:
+            if launcher is not None:
+                result = launcher.launch(fn, model, *args, trainer=self)
+            else:
+                result = fn(model, *args)
+            self.state.status = "finished"
+            return result
+        except BaseException as e:
+            self.state.status = "interrupted"
+            self._cb("on_exception", e)
+            raise
+
+    # ------------------------------------------------------------------ #
+    # dataloader resolution
+    # ------------------------------------------------------------------ #
+    def _resolve_loader(self, explicit, datamodule, module_hook_name: str):
+        if explicit is not None:
+            return ensure_loader(explicit)
+        if datamodule is not None:
+            hook = getattr(datamodule, module_hook_name, None)
+            if hook is not None:
+                loader = hook()
+                if loader is not None:
+                    return ensure_loader(loader)
+        hook = getattr(self._module, module_hook_name, None)
+        if hook is not None:
+            loader = hook()
+            if loader is not None:
+                return ensure_loader(loader)
+        return None
+
+    def _maybe_shard_loader(self, loader, shuffle: bool):
+        """Inject the rank-sharding sampler (reference: ray_ddp.py:315-324)."""
+        kwargs = self.strategy.distributed_sampler_kwargs
+        if (
+            kwargs is None
+            or not self.use_distributed_sampler
+            or not isinstance(loader, DataLoader)
+            or loader.sampler is not None
+        ):
+            return loader
+        sampler = DistributedSampler(
+            len(loader.dataset),
+            shuffle=shuffle,
+            seed=int(os.environ.get("RLT_GLOBAL_SEED", "0")),
+            drop_last=loader.drop_last,
+            **kwargs,
+        )
+        return loader.with_sampler(sampler)
+
+    # ------------------------------------------------------------------ #
+    # optimizer normalization
+    # ------------------------------------------------------------------ #
+    def _normalize_tx(self, configured) -> optax.GradientTransformation:
+        if isinstance(configured, dict):
+            configured = configured.get("optimizer", configured)
+        # optax transforms are NamedTuples; only unwrap plain containers
+        if isinstance(configured, (list, tuple)) and not hasattr(configured, "update"):
+            if len(configured) != 1:
+                raise ValueError("multiple optimizers are not supported")
+            configured = configured[0]
+        if not hasattr(configured, "update"):
+            raise TypeError(
+                "configure_optimizers must return an optax.GradientTransformation"
+            )
+        tx = configured
+        if self.gradient_clip_val:
+            tx = optax.chain(optax.clip_by_global_norm(self.gradient_clip_val), tx)
+        if self.accumulate_grad_batches > 1:
+            tx = optax.MultiSteps(tx, every_k_schedule=self.accumulate_grad_batches)
+        return tx
+
+    # ------------------------------------------------------------------ #
+    # compiled steps
+    # ------------------------------------------------------------------ #
+    def _build_train_step(self):
+        module = self._module
+        tx = self._tx
+
+        def train_step(params, opt_state, batch, rng_root, step):
+            rng = jax.random.fold_in(rng_root, step)
+
+            def loss_fn(p):
+                module._capture_begin("train", rng)
+                out = module.training_step(p, batch, step)
+                logs = module._capture_end()
+                loss = out["loss"] if isinstance(out, dict) else out
+                return loss, logs
+
+            (loss, logs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            logs = dict(logs)
+            logs.setdefault("loss", loss)
+            return new_params, new_opt_state, logs
+
+        return jax.jit(train_step, donate_argnums=(0, 1))
+
+    def _build_eval_step(self, phase: str):
+        module = self._module
+        step_fn = {
+            "val": module.validation_step,
+            "test": module.test_step,
+        }[phase]
+
+        def eval_step(params, batch, step):
+            module._capture_begin(phase)
+            out = step_fn(params, batch, step)
+            logs = module._capture_end()
+            if isinstance(out, dict):
+                for k, v in out.items():
+                    logs.setdefault(k, jnp.asarray(v))
+            return logs
+
+        return jax.jit(eval_step)
+
+    # ------------------------------------------------------------------ #
+    # fit implementation (runs on driver, or inside a worker actor)
+    # ------------------------------------------------------------------ #
+    def _fit_impl(self, model, train_dataloaders, val_dataloaders, datamodule, ckpt_path):
+        seed = seed_everything(self.seed)
+        self._datamodule = datamodule
+        self.strategy.setup_environment()
+
+        if datamodule is not None:
+            datamodule.prepare_data()
+            datamodule.setup("fit")
+        model.prepare_data()
+        model.setup("fit")
+        self._cb("setup", "fit")
+
+        train_loader = self._resolve_loader(train_dataloaders, datamodule, "train_dataloader")
+        val_loader = self._resolve_loader(val_dataloaders, datamodule, "val_dataloader")
+        if train_loader is None:
+            raise ValueError("fit requires a train dataloader")
+        train_loader = self._maybe_shard_loader(train_loader, shuffle=True)
+        val_loader = self._maybe_shard_loader(val_loader, shuffle=False)
+
+        # --- parameters & optimizer, placed with the policy's shardings ---
+        self._rng_root = jax.random.key(seed)
+        host_params = model._params if model._params is not None else model.init_params(
+            self._rng_root
+        )
+        self._params = self.strategy.place_params(host_params)
+        self._tx = self._normalize_tx(model.configure_optimizers())
+        opt_shapes = jax.eval_shape(self._tx.init, self._params)
+        opt_shardings = self.strategy.optstate_shardings(opt_shapes)
+        self._opt_state = jax.jit(self._tx.init, out_shardings=opt_shardings)(
+            self._params
+        )
+
+        if ckpt_path is not None:
+            self._restore_checkpoint(ckpt_path)
+
+        train_step = self._build_train_step()
+        val_step = self._build_eval_step("val") if val_loader is not None else None
+
+        if self.logger is not None and self.is_global_zero:
+            self.logger.log_hyperparams(dict(model.hparams))
+
+        self._hook("on_fit_start")
+        self._hook("on_train_start")
+
+        # sanity validation
+        if val_loader is not None and self.num_sanity_val_steps > 0:
+            self.sanity_checking = True
+            self._cb("on_sanity_check_start")
+            self._run_eval_epoch(val_loader, val_step, limit=self.num_sanity_val_steps, record=False)
+            self._cb("on_sanity_check_end")
+            self.sanity_checking = False
+
+        try:
+            while self.current_epoch < self.max_epochs and not self.should_stop:
+                self._run_train_epoch(train_loader, train_step, val_loader, val_step)
+                self.current_epoch += 1
+                if 0 <= self.max_steps <= self.global_step:
+                    self.should_stop = True
+                if self.should_stop and self.current_epoch < self.min_epochs:
+                    self.should_stop = False
+        finally:
+            self._hook("on_train_end")
+            self._hook("on_fit_end")
+            if self.logger is not None:
+                self.logger.finalize(self.state.status)
+            self._cb("teardown", "fit")
+            model.teardown("fit")
+            if datamodule is not None:
+                datamodule.teardown("fit")
+
+        model._params = self._params
+        return None
+
+    def _run_train_epoch(self, train_loader, train_step, val_loader, val_step):
+        model = self._module
+        if hasattr(train_loader, "set_epoch"):
+            train_loader.set_epoch(self.current_epoch)
+        self.val_enabled = val_loader is not None
+        self._val_ran_this_epoch = False
+        self.num_val_batches = (
+            self._loader_len(val_loader, self.limit_val_batches) if val_loader else 0
+        )
+        self._hook("on_train_epoch_start")
+        aggregator = _EpochAggregator()
+        t_epoch = time.perf_counter()
+        n_batches = 0
+
+        for batch_idx, batch in enumerate(train_loader):
+            if self.limit_train_batches is not None and batch_idx >= self.limit_train_batches:
+                break
+            device_batch = self.strategy.shard_batch(batch)
+            self._cb("on_train_batch_start", batch, batch_idx)
+            self._params, self._opt_state, logs = train_step(
+                self._params,
+                self._opt_state,
+                device_batch,
+                self._rng_root,
+                np.int32(self.global_step),
+            )
+            batch_size = self._batch_size_of(batch)
+            self._record_train_logs(logs, aggregator, batch_size)
+            self._cb("on_train_batch_end", logs, batch, batch_idx)
+            self.global_step += 1
+            n_batches += 1
+
+            if (
+                self.val_check_interval
+                and val_loader is not None
+                and self.global_step % self.val_check_interval == 0
+            ):
+                self._run_validation(val_loader, val_step)
+
+            if 0 <= self.max_steps <= self.global_step:
+                self.should_stop = True
+                break
+
+        # epoch-level train metrics
+        epoch_metrics = aggregator.reduce(self._module._log_meta.get)
+        epoch_out: Dict[str, np.ndarray] = {}
+        for name, value in epoch_metrics.items():
+            meta = model._log_meta.get(name)
+            if meta is None or not meta.on_epoch:
+                continue
+            out_name = f"{name}_epoch" if (meta.on_step and meta.on_epoch) else name
+            self.callback_metrics[out_name] = value
+            self.logged_metrics[out_name] = value
+            epoch_out[out_name] = value
+        if self.logger is not None and self.is_global_zero and epoch_out:
+            self.logger.log_metrics(epoch_out, step=self.global_step)
+
+        if (
+            val_loader is not None
+            and not self.val_check_interval
+            and (self.current_epoch + 1) % self.check_val_every_n_epoch == 0
+        ):
+            self._run_validation(val_loader, val_step)
+
+        self._hook("on_train_epoch_end")
+
+        if self.enable_progress_bar and self.is_global_zero:
+            dt = time.perf_counter() - t_epoch
+            shown = {
+                k: f"{float(np.asarray(v)):.4f}"
+                for k, v in list(self.callback_metrics.items())[:6]
+            }
+            print(
+                f"[epoch {self.current_epoch}] {n_batches} steps in {dt:.1f}s {shown}",
+                flush=True,
+            )
+
+    def _record_train_logs(self, logs, aggregator: _EpochAggregator, batch_size: int):
+        model = self._module
+        epoch_logs = {}
+        for name, value in logs.items():
+            meta = model._log_meta.get(name)
+            if meta is None:
+                # implicit "loss" emitted by the step wrapper
+                self.logged_metrics[name] = value
+                epoch_logs[name] = value
+                continue
+            if meta.on_step:
+                out = f"{name}_step" if (meta.on_step and meta.on_epoch) else name
+                self.logged_metrics[out] = value
+            if meta.on_epoch:
+                epoch_logs[name] = value
+        aggregator.update(epoch_logs, batch_size)
+        if (
+            self.logger is not None
+            and self.is_global_zero
+            and self.log_every_n_steps
+            and self.global_step % self.log_every_n_steps == 0
+        ):
+            step_metrics = {
+                k: float(np.asarray(jax.device_get(v)))
+                for k, v in self.logged_metrics.items()
+                if not isinstance(v, np.ndarray)
+            }
+            if step_metrics:
+                self.logger.log_metrics(step_metrics, step=self.global_step)
+
+    def _run_validation(self, val_loader, val_step):
+        self._hook("on_validation_epoch_start")
+        self._cb("on_validation_start")
+        metrics = self._run_eval_epoch(
+            val_loader, val_step, limit=self.limit_val_batches, record=True
+        )
+        self._val_ran_this_epoch = True
+        self._hook("on_validation_epoch_end")
+        self._cb("on_validation_end")
+        return metrics
+
+    def _run_eval_epoch(self, loader, eval_step, limit=None, record=True, phase="val"):
+        if hasattr(loader, "set_epoch"):
+            loader.set_epoch(self.current_epoch)
+        aggregator = _EpochAggregator()
+        for batch_idx, batch in enumerate(loader):
+            if limit is not None and batch_idx >= limit:
+                break
+            device_batch = self.strategy.shard_batch(batch)
+            logs = eval_step(self._params, device_batch, np.int32(batch_idx))
+            aggregator.update(logs, self._batch_size_of(batch))
+            hook = "on_test_batch_end" if phase == "test" else "on_validation_batch_end"
+            self._cb(hook, logs, batch, batch_idx)
+        metrics = aggregator.reduce(self._module._log_meta.get)
+        if record:
+            for name, value in metrics.items():
+                self.callback_metrics[name] = value
+                self.logged_metrics[name] = value
+            if self.logger is not None and self.is_global_zero and metrics:
+                self.logger.log_metrics(metrics, step=self.global_step)
+        return metrics
+
+    @staticmethod
+    def _batch_size_of(batch) -> int:
+        leaves = jax.tree_util.tree_leaves(batch)
+        for leaf in leaves:
+            if hasattr(leaf, "shape") and len(leaf.shape) > 0:
+                return int(leaf.shape[0])
+        return 1
+
+    @staticmethod
+    def _loader_len(loader, limit) -> int:
+        try:
+            n = len(loader)
+        except TypeError:
+            n = 0
+        if limit is not None:
+            n = min(n, limit)
+        return n
+
+    # ------------------------------------------------------------------ #
+    # validate / test / predict implementations
+    # ------------------------------------------------------------------ #
+    def _eval_impl(self, model, dataloaders, datamodule, ckpt_path, phase: str):
+        seed_everything(self.seed)
+        self.strategy.setup_environment()
+        if datamodule is not None:
+            datamodule.prepare_data()
+            datamodule.setup(phase if phase != "val" else "validate")
+        model.prepare_data()
+        model.setup(phase)
+        hook_name = {"val": "val_dataloader", "test": "test_dataloader"}[phase]
+        loader = self._resolve_loader(dataloaders, datamodule, hook_name)
+        if loader is None:
+            raise ValueError(f"{phase} requires a dataloader")
+        loader = self._maybe_shard_loader(loader, shuffle=False)
+
+        if ckpt_path is not None:
+            with open(ckpt_path, "rb") as f:
+                ckpt = load_state_stream(f.read())
+            model._params = ckpt["state_dict"]
+        if model._params is None:
+            raise ValueError(f"{phase} requires trained params (fit first or pass ckpt_path)")
+        self._params = self.strategy.place_params(model._params)
+
+        eval_step = self._build_eval_step(phase)
+        limit = self.limit_val_batches if phase == "val" else self.limit_test_batches
+        if phase == "test":
+            self._cb("on_test_start")
+        metrics = self._run_eval_epoch(eval_step=eval_step, loader=loader, limit=limit, phase=phase)
+        for name, value in metrics.items():
+            self.callback_metrics[name] = value
+        if phase == "test":
+            self._cb("on_test_epoch_end")
+            self._cb("on_test_end")
+        if self.logger is not None:
+            self.logger.save()
+        return [dict(metrics)]
+
+    def _predict_impl(self, model, dataloaders, datamodule, ckpt_path):
+        seed_everything(self.seed)
+        self.strategy.setup_environment()
+        if datamodule is not None:
+            datamodule.prepare_data()
+            datamodule.setup("predict")
+        model.prepare_data()
+        model.setup("predict")
+        loader = self._resolve_loader(dataloaders, datamodule, "predict_dataloader")
+        if loader is None:
+            raise ValueError("predict requires a dataloader")
+        if ckpt_path is not None:
+            with open(ckpt_path, "rb") as f:
+                ckpt = load_state_stream(f.read())
+            model._params = ckpt["state_dict"]
+        if model._params is None:
+            raise ValueError("predict requires trained params")
+        self._params = self.strategy.place_params(model._params)
+        module = model
+
+        @jax.jit
+        def predict_step(params, batch, step):
+            module._capture_begin("predict")
+            out = module.predict_step(params, batch, step)
+            module._capture_end()
+            return out
+
+        self._cb("on_predict_start")
+        outputs = []
+        for batch_idx, batch in enumerate(loader):
+            if (
+                self.limit_predict_batches is not None
+                and batch_idx >= self.limit_predict_batches
+            ):
+                break
+            device_batch = self.strategy.shard_batch(batch)
+            out = predict_step(self._params, device_batch, np.int32(batch_idx))
+            outputs.append(jax.device_get(out))
+        self._cb("on_predict_end")
+        return outputs
+
+    # ------------------------------------------------------------------ #
+    # checkpointing
+    # ------------------------------------------------------------------ #
+    def dump_checkpoint(self, weights_only: bool = False) -> Dict[str, Any]:
+        model = self._module
+        params_host = jax.device_get(self._params if self._params is not None else model._params)
+        ckpt: Dict[str, Any] = {
+            "epoch": self.current_epoch,
+            "global_step": self.global_step,
+            "rlt_version": __version__,
+            "state_dict": flax_serialization.to_state_dict(params_host),
+            "hyper_parameters": dict(model.hparams),
+        }
+        if not weights_only:
+            if self._opt_state is not None:
+                ckpt["optimizer_state"] = flax_serialization.to_state_dict(
+                    jax.device_get(self._opt_state)
+                )
+            ckpt["callbacks"] = {
+                cb.state_key: cb.state_dict() for cb in self.callbacks if cb.state_dict()
+            }
+            ckpt["callback_metrics"] = {
+                k: np.asarray(v) for k, v in self.callback_metrics.items()
+            }
+        model.on_save_checkpoint(ckpt)
+        return ckpt
+
+    def save_checkpoint(self, filepath: str, weights_only: bool = False) -> None:
+        ckpt = self.dump_checkpoint(weights_only)
+        os.makedirs(os.path.dirname(os.path.abspath(filepath)), exist_ok=True)
+        with open(filepath, "wb") as f:
+            f.write(to_state_stream(ckpt))
+
+    def _restore_checkpoint(self, ckpt_path: str) -> None:
+        with open(ckpt_path, "rb") as f:
+            ckpt = load_state_stream(f.read())
+        # params: restore into the existing (possibly sharded) structure
+        host_params = flax_serialization.from_state_dict(
+            jax.device_get(self._params), ckpt["state_dict"]
+        )
+        self._params = self.strategy.place_params(host_params)
+        if "optimizer_state" in ckpt and self._opt_state is not None:
+            host_opt = flax_serialization.from_state_dict(
+                jax.device_get(self._opt_state), ckpt["optimizer_state"]
+            )
+            self._opt_state = self.strategy.place_optstate(host_opt)
+        self.current_epoch = int(ckpt.get("epoch", 0)) + 1
+        self.global_step = int(ckpt.get("global_step", 0))
+        for cb in self.callbacks:
+            state = ckpt.get("callbacks", {}).get(cb.state_key)
+            if state:
+                cb.load_state_dict(state)
+        for k, v in ckpt.get("callback_metrics", {}).items():
+            self.callback_metrics[k] = np.asarray(v)
+        self._module.on_load_checkpoint(ckpt)

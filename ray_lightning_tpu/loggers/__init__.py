@@ -1,0 +1,5 @@
+from ray_lightning_tpu.loggers.base import Logger
+from ray_lightning_tpu.loggers.csv_logger import CSVLogger
+from ray_lightning_tpu.loggers.tensorboard import TensorBoardLogger
+
+__all__ = ["Logger", "CSVLogger", "TensorBoardLogger"]

@@ -1,0 +1,3 @@
+from ray_lightning_tpu.models.mnist import MNISTClassifier, MNISTDataModule
+
+__all__ = ["MNISTClassifier", "MNISTDataModule"]

@@ -1,0 +1,18 @@
+from ray_lightning_tpu.parallel.mesh import build_mesh, MeshSpec
+from ray_lightning_tpu.parallel.sharding import (
+    ShardingPolicy,
+    batch_sharding,
+    replicated_sharding,
+    fsdp_param_shardings,
+    infer_param_shardings,
+)
+
+__all__ = [
+    "build_mesh",
+    "MeshSpec",
+    "ShardingPolicy",
+    "batch_sharding",
+    "replicated_sharding",
+    "fsdp_param_shardings",
+    "infer_param_shardings",
+]

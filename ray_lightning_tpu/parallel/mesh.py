@@ -1,0 +1,101 @@
+"""Device-mesh construction for single-host, multi-host (ICI) and
+multi-slice (DCN) topologies.
+
+This replaces the reference's backend-string choice (NCCL vs Gloo via
+``PL_TORCH_DISTRIBUTED_BACKEND``, reference: ray_lightning/ray_ddp.py:91-100)
+with the TPU-native mechanism: *which collectives ride which interconnect is
+decided by mesh construction*, not a backend flag. Within a slice, XLA
+compiles collectives onto ICI; across slices, axes laid out over processes
+ride DCN (``create_hybrid_device_mesh``).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+@dataclass
+class MeshSpec:
+    """Named parallelism axes and their sizes.
+
+    Axis names follow the scaling-book convention:
+      - ``dp``: pure data parallel (batch)
+      - ``fsdp``: data parallel with parameter/optimizer sharding (ZeRO)
+      - ``tp``: tensor parallel
+      - ``sp``: sequence/context parallel (ring attention)
+      - ``ep``: expert parallel (MoE)
+      - ``pp``: pipeline stages
+    A size of -1 means "absorb all remaining devices".
+    """
+
+    axes: Dict[str, int] = field(default_factory=dict)
+    # axes listed here are laid out across slices (DCN); the rest across ICI
+    dcn_axes: Tuple[str, ...] = ()
+
+    def resolved(self, n_devices: int) -> Dict[str, int]:
+        axes = {k: v for k, v in self.axes.items() if v != 1 or k in ("dp",)}
+        if not axes:
+            axes = {"dp": -1}
+        fill_keys = [k for k, v in axes.items() if v == -1]
+        if len(fill_keys) > 1:
+            raise ValueError("at most one axis may be -1")
+        fixed = int(np.prod([v for v in axes.values() if v != -1]))
+        if fill_keys:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {axes}"
+                )
+            axes[fill_keys[0]] = n_devices // fixed
+        else:
+            total = int(np.prod(list(axes.values())))
+            if total != n_devices:
+                raise ValueError(
+                    f"mesh axes {axes} use {total} devices, have {n_devices}"
+                )
+        return axes
+
+    @staticmethod
+    def data_parallel() -> "MeshSpec":
+        return MeshSpec(axes={"dp": -1})
+
+    @staticmethod
+    def fsdp() -> "MeshSpec":
+        return MeshSpec(axes={"fsdp": -1})
+
+
+def build_mesh(
+    spec: Optional[MeshSpec] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a :class:`jax.sharding.Mesh` from a :class:`MeshSpec`.
+
+    Uses ``mesh_utils.create_device_mesh`` so the logical axes are laid out
+    along the physical torus for maximal ICI bandwidth; falls back to a plain
+    reshape for virtual/CPU device sets where topology is flat.
+    """
+    spec = spec or MeshSpec.data_parallel()
+    devices = list(devices if devices is not None else jax.devices())
+    axes = spec.resolved(len(devices))
+    names = tuple(axes)
+    shape = tuple(axes[n] for n in names)
+    try:
+        if spec.dcn_axes and jax.process_count() > 1:
+            ici_shape = tuple(
+                1 if n in spec.dcn_axes else axes[n] for n in names
+            )
+            dcn_shape = tuple(
+                axes[n] if n in spec.dcn_axes else 1 for n in names
+            )
+            arr = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices
+            )
+        else:
+            arr = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, names)

@@ -1,0 +1,135 @@
+"""Sharding policies: how params / optimizer state / batches map to the mesh.
+
+This is the single mechanism into which the reference's three strategies
+collapse (SURVEY §2c): plain DDP = params replicated, batch over data axes;
+ZeRO/FairScale-sharded = params+optimizer sharded over ``fsdp``; Horovod
+ring-allreduce = the same compiled all-reduce XLA emits for the replicated
+case. Tensor/sequence/expert parallelism are additional axes consumed by
+models whose flax modules carry ``nn.with_partitioning`` annotations or via
+the generic largest-divisible-axis rule below.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ShardingPolicy:
+    """What to shard over which mesh axes.
+
+    ``zero_stage`` semantics (all expressed as GSPMD shardings, executed by
+    XLA as reduce-scatter/all-gather over ICI):
+      0: replicate params + optimizer state (classic DDP)
+      1/2: replicate params, shard optimizer state over data axes
+      3: shard params and optimizer state (FSDP)
+    """
+
+    zero_stage: int = 0
+    # axes the global batch is split over
+    data_axes: Tuple[str, ...] = ("dp",)
+    # axes params/opt-state shard over for zero>=1
+    shard_axes: Tuple[str, ...] = ()
+    # minimum leaf size to bother sharding (small leaves stay replicated)
+    min_shard_size: int = 2**14
+
+    @property
+    def effective_shard_axes(self) -> Tuple[str, ...]:
+        return self.shard_axes or self.data_axes
+
+    @staticmethod
+    def ddp() -> "ShardingPolicy":
+        return ShardingPolicy(zero_stage=0)
+
+    @staticmethod
+    def zero(stage: int = 3, axes: Tuple[str, ...] = ()) -> "ShardingPolicy":
+        return ShardingPolicy(zero_stage=stage, shard_axes=axes)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, data_axes: Tuple[str, ...] = ("dp",)) -> NamedSharding:
+    """Shard the leading (batch) dim over the product of the data axes."""
+    axes = tuple(a for a in data_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    if not axes:
+        return replicated_sharding(mesh)
+    spec = axes[0] if len(axes) == 1 else axes
+    return NamedSharding(mesh, P(spec))
+
+
+def _largest_divisible_axis(shape, divisor: int) -> Optional[int]:
+    best, best_dim = None, -1
+    for i, d in enumerate(shape):
+        if d % divisor == 0 and d > best_dim:
+            best, best_dim = i, d
+    return best
+
+
+def fsdp_param_shardings(
+    mesh: Mesh,
+    params: Any,
+    shard_axes: Tuple[str, ...],
+    min_shard_size: int = 2**14,
+) -> Any:
+    """Per-leaf shardings: shard the largest axis divisible by the axis size.
+
+    The generic rule that makes *any* model's params/opt-state ZeRO-shardable
+    without per-layer annotations — the TPU-native counterpart of FairScale's
+    parameter flattening+bucketing (which GSPMD makes unnecessary).
+    """
+    axes = tuple(a for a in shard_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    if not axes:
+        repl = replicated_sharding(mesh)
+        return jax.tree_util.tree_map(lambda _: repl, params)
+    divisor = 1
+    for a in axes:
+        divisor *= mesh.shape[a]
+    spec_entry = axes[0] if len(axes) == 1 else axes
+
+    def leaf_sharding(leaf):
+        shape = getattr(leaf, "shape", ())
+        size = getattr(leaf, "size", 0)
+        if not shape or size < min_shard_size:
+            return replicated_sharding(mesh)
+        axis = _largest_divisible_axis(shape, divisor)
+        if axis is None:
+            return replicated_sharding(mesh)
+        spec = [None] * len(shape)
+        spec[axis] = spec_entry
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree_util.tree_map(leaf_sharding, params)
+
+
+def infer_param_shardings(
+    mesh: Mesh, params: Any, policy: ShardingPolicy
+) -> Tuple[Any, Any]:
+    """Return (param_shardings, optstate_rule) for the policy.
+
+    ``optstate_rule`` is a callable mapping a freshly-initialized optimizer
+    state pytree to shardings: optimizer moments mirror the param sharding
+    when their leaf shape matches a sharded param leaf, else follow the same
+    largest-divisible-axis rule (zero>=1) or replicate (zero==0).
+    """
+    if policy.zero_stage >= 3:
+        param_sh = fsdp_param_shardings(
+            mesh, params, policy.effective_shard_axes, policy.min_shard_size
+        )
+    else:
+        repl = replicated_sharding(mesh)
+        param_sh = jax.tree_util.tree_map(lambda _: repl, params)
+
+    def optstate_shardings(opt_state: Any) -> Any:
+        if policy.zero_stage == 0:
+            repl = replicated_sharding(mesh)
+            return jax.tree_util.tree_map(lambda _: repl, opt_state)
+        return fsdp_param_shardings(
+            mesh, opt_state, policy.effective_shard_axes, policy.min_shard_size
+        )
+
+    return param_sh, optstate_shardings

@@ -1,0 +1,3 @@
+from ray_lightning_tpu.strategies.base import Strategy, XLAStrategy, SingleDeviceStrategy
+
+__all__ = ["Strategy", "XLAStrategy", "SingleDeviceStrategy"]

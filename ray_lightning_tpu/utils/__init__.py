@@ -1,0 +1,20 @@
+from ray_lightning_tpu.utils.serialization import (
+    to_state_stream,
+    load_state_stream,
+    tree_byte_size,
+)
+from ray_lightning_tpu.utils.seed import seed_everything, reset_seed
+from ray_lightning_tpu.utils.ports import find_free_port
+from ray_lightning_tpu.utils.common import Unavailable, rank_zero_info, rank_zero_warn
+
+__all__ = [
+    "to_state_stream",
+    "load_state_stream",
+    "tree_byte_size",
+    "seed_everything",
+    "reset_seed",
+    "find_free_port",
+    "Unavailable",
+    "rank_zero_info",
+    "rank_zero_warn",
+]

@@ -1,0 +1,38 @@
+"""Misc shared helpers: rank-zero logging and optional-dependency sentinel."""
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger("ray_lightning_tpu")
+
+
+def _global_rank() -> int:
+    return int(os.environ.get("RLT_GLOBAL_RANK", "0"))
+
+
+def rank_zero_info(msg: str, *args) -> None:
+    if _global_rank() == 0:
+        logger.info(msg, *args)
+
+
+def rank_zero_warn(msg: str, *args) -> None:
+    if _global_rank() == 0:
+        logger.warning(msg, *args)
+
+
+class Unavailable:
+    """Placeholder for optional integrations that are not installed.
+
+    Mirrors the reference's optional-dependency fallback
+    (reference: ray_lightning/util.py:42-46, tune.py:13-27): importing the
+    symbol succeeds, using it raises with a helpful message.
+    """
+
+    _reason = "this optional dependency is not available in this environment"
+
+    def __init__(self, *args, **kwargs):
+        raise RuntimeError(f"Cannot instantiate: {self._reason}")
+
+    def __getattr__(self, item):
+        raise RuntimeError(f"Cannot use attribute {item!r}: {self._reason}")

@@ -1,0 +1,28 @@
+"""Network helpers for the coordinator rendezvous.
+
+The reference picks a free port on worker 0 for the torch.distributed
+``env://`` rendezvous (reference: ray_lightning/launchers/utils.py:12-17).
+Here the same pattern bootstraps ``jax.distributed.initialize``'s
+coordinator address.
+"""
+from __future__ import annotations
+
+import socket
+
+
+def find_free_port(host: str = "") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        return s.getsockname()[1]
+
+
+def node_ip_address() -> str:
+    """Best-effort IP of this host as seen by peers."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            # No packets are sent; this just selects the outbound interface.
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"

@@ -1,0 +1,29 @@
+"""Package setup (parity role: reference setup.py:3-12).
+
+Core deps are the JAX stack only; torch/transformers are optional input-side
+integrations. The native runtime extension (C++ shared-memory object store)
+is built separately via `make -C ray_lightning_tpu/runtime/native` and is
+optional at runtime (pure-Python fallback).
+"""
+from setuptools import find_packages, setup
+
+setup(
+    name="ray-lightning-tpu",
+    version="0.1.0",
+    description=(
+        "TPU-native distributed training framework: PyTorch-Lightning-style "
+        "Trainer/strategies over JAX/XLA with a Ray-style actor runtime"
+    ),
+    packages=find_packages(include=["ray_lightning_tpu", "ray_lightning_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=[
+        "jax",
+        "flax",
+        "optax",
+        "numpy",
+    ],
+    extras_require={
+        "test": ["pytest"],
+        "torch": ["torch"],
+    },
+)

@@ -1,0 +1,30 @@
+"""Test-wide JAX config: CPU platform with 8 virtual devices.
+
+This is the Gloo-equivalent of the reference's CI (SURVEY §4: local ray.init
+"clusters" on CPU): an 8-device host mesh exercises every sharding/collective
+code path that runs on a real TPU slice, compiled by the same XLA GSPMD
+partitioner. Must run before jax is imported anywhere.
+"""
+import os
+
+# The image pins JAX_PLATFORMS to the TPU tunnel and pre-imports jax via
+# sitecustomize; tests always run on the virtual CPU mesh (set
+# RLT_TEST_ON_TPU=1 to opt out). Backends init lazily, so flipping the
+# platform after import but before first device use is safe.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+if not os.environ.get("RLT_TEST_ON_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_root(tmp_path):
+    return str(tmp_path)

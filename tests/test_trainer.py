@@ -1,0 +1,167 @@
+"""Core Trainer behavior: training moves weights, metrics plumb through,
+checkpointing/early-stopping/resume, forked metric names, predict accuracy.
+Mirrors the concerns of reference tests/test_ddp.py for the single-process
+strategy (the launcher-based variants are covered in test_ray_strategy.py).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from ray_lightning_tpu import (
+    EarlyStopping,
+    ModelCheckpoint,
+    SingleDeviceStrategy,
+    Trainer,
+    XLAStrategy,
+)
+from ray_lightning_tpu.models.mnist import MNISTClassifier, MNISTDataModule
+
+from tests.utils import (
+    BoringModel,
+    XORDataModule,
+    XORModel,
+    get_trainer,
+    load_test,
+    predict_test,
+    train_test,
+)
+
+
+def test_eight_virtual_devices():
+    assert jax.device_count() >= 8
+
+
+def test_train_moves_weights(tmp_root):
+    model = BoringModel()
+    trainer = get_trainer(tmp_root, max_epochs=2)
+    train_test(trainer, model)
+
+
+def test_hooks_called_in_order(tmp_root):
+    model = BoringModel()
+    trainer = get_trainer(tmp_root, max_epochs=1)
+    trainer.fit(model)
+    calls = model.hook_calls
+    assert calls[0] == "on_fit_start"
+    assert "on_train_epoch_start" in calls
+    assert calls.index("on_train_epoch_start") < calls.index("on_train_epoch_end")
+    assert "on_validation_epoch_end" in calls
+    assert calls[-1] == "on_fit_end"
+
+
+def test_metric_constants_through_pipe(tmp_root):
+    """The XOR 1.234/5.678 pattern: logged values must survive the full
+    jit -> aggregation -> callback_metrics path exactly."""
+    model = XORModel()
+    dm = XORDataModule()
+    trainer = get_trainer(tmp_root, max_epochs=1)
+    trainer.fit(model, datamodule=dm)
+    assert np.isclose(float(trainer.callback_metrics["val_loss"]), XORModel.VAL_LOSS, atol=1e-5)
+    assert np.isclose(float(trainer.callback_metrics["val_acc"]), XORModel.VAL_ACC, atol=1e-4)
+
+
+def test_forked_metric_names(tmp_root):
+    """on_step + on_epoch logging forks name_step / name_epoch
+    (reference tests/test_ddp.py:326-352)."""
+    model = BoringModel()
+    trainer = get_trainer(tmp_root, max_epochs=1)
+    trainer.fit(model)
+    assert "train_loss_step" in trainer.logged_metrics
+    assert "train_loss_epoch" in trainer.callback_metrics
+
+
+def test_mnist_end_to_end(tmp_root):
+    config = {"lr": 1e-2, "batch_size": 32}
+    model = MNISTClassifier(config)
+    dm = MNISTDataModule(batch_size=32)
+    trainer = get_trainer(tmp_root, max_epochs=3, limit_train_batches=None)
+    train_test(trainer, model, datamodule=dm)
+    load_test(trainer, MNISTClassifier)
+    predict_test(trainer, model, dm)
+
+
+def test_checkpoint_monitor_best(tmp_root):
+    model = XORModel()
+    dm = XORDataModule()
+    ckpt = ModelCheckpoint(monitor="val_loss", mode="min", save_top_k=1)
+    trainer = get_trainer(tmp_root, max_epochs=2, callbacks=[ckpt])
+    trainer.fit(model, datamodule=dm)
+    assert os.path.exists(ckpt.best_model_path)
+    assert ckpt.best_model_score is not None
+
+
+def test_early_stopping_stops(tmp_root):
+    model = XORModel()  # val_loss is a constant -> never improves
+    dm = XORDataModule()
+    es = EarlyStopping(monitor="val_loss", patience=2, min_delta=0.0)
+    trainer = get_trainer(tmp_root, max_epochs=50, callbacks=[es], checkpoint_callback=False)
+    trainer.fit(model, datamodule=dm)
+    # first epoch sets best, then 2 epochs of no improvement
+    assert trainer.current_epoch <= 4
+    assert es.stopped_epoch > 0 or trainer.current_epoch < 50
+
+
+def test_resume_from_checkpoint(tmp_root):
+    model = BoringModel()
+    trainer = get_trainer(tmp_root, max_epochs=2)
+    trainer.fit(model)
+    ckpt_path = trainer.checkpoint_callback.best_model_path
+    assert ckpt_path
+
+    model2 = BoringModel()
+    trainer2 = get_trainer(tmp_root, max_epochs=4)
+    trainer2.fit(model2, ckpt_path=ckpt_path)
+    assert trainer2.current_epoch == 4
+    assert trainer2.global_step > trainer.global_step
+
+
+def test_max_steps(tmp_root):
+    model = BoringModel()
+    trainer = get_trainer(tmp_root, max_epochs=100, max_steps=5, checkpoint_callback=False)
+    trainer.fit(model)
+    assert trainer.global_step == 5
+
+
+def test_single_device_strategy(tmp_root):
+    model = BoringModel()
+    trainer = get_trainer(tmp_root, strategy=SingleDeviceStrategy(), checkpoint_callback=False)
+    trainer.fit(model)
+    assert model.params is not None
+
+
+def test_local_dp_uses_all_devices(tmp_root):
+    strategy = XLAStrategy()
+    model = BoringModel()
+    trainer = get_trainer(tmp_root, strategy=strategy, checkpoint_callback=False)
+    trainer.fit(model)
+    assert strategy.num_chips == jax.device_count()
+    # batch sharding across dp
+    assert strategy.batch_sharding.spec == jax.sharding.PartitionSpec("dp")
+
+
+def test_validate_and_test_entry_points(tmp_root):
+    config = {"lr": 1e-2}
+    model = MNISTClassifier(config)
+    dm = MNISTDataModule()
+    trainer = get_trainer(tmp_root, max_epochs=1, limit_train_batches=None)
+    trainer.fit(model, datamodule=dm)
+    val_metrics = trainer.validate(model, datamodule=dm)
+    assert "ptl/val_loss" in val_metrics[0]
+    test_metrics = trainer.test(model, datamodule=dm)
+    assert "test_acc" in test_metrics[0]
+
+
+def test_gradient_clip_and_accumulate(tmp_root):
+    model = BoringModel()
+    trainer = get_trainer(
+        tmp_root,
+        max_epochs=1,
+        gradient_clip_val=1.0,
+        accumulate_grad_batches=2,
+        checkpoint_callback=False,
+    )
+    trainer.fit(model)
+    assert model.params is not None
