@@ -28,6 +28,12 @@ from ray_lightning_tpu.callbacks import (
     ProfilerCallback,
 )
 from ray_lightning_tpu.utils.seed import seed_everything
+from ray_lightning_tpu.strategies.ray_strategies import (
+    RayStrategy,
+    RayTPUStrategy,
+    HorovodRayStrategy,
+    RayShardedStrategy,
+)
 
 __version__ = "0.1.0"
 
@@ -53,4 +59,8 @@ __all__ = [
     "ThroughputMonitor",
     "ProfilerCallback",
     "seed_everything",
+    "RayStrategy",
+    "RayTPUStrategy",
+    "HorovodRayStrategy",
+    "RayShardedStrategy",
 ]

@@ -66,3 +66,29 @@ class Callback:
         return {}
 
     def load_state_dict(self, state: Dict[str, Any]) -> None: ...
+
+
+def _enumerate_state_keys(callbacks):
+    """Stable, instance-unique keys: second and later callbacks of the same
+    class get '#<n>' suffixes (same enumeration on save and restore)."""
+    counts: Dict[str, int] = {}
+    for cb in callbacks:
+        key = cb.state_key
+        n = counts.get(key, 0)
+        counts[key] = n + 1
+        yield (f"{key}#{n}" if n else key), cb
+
+
+def collect_callback_states(callbacks) -> Dict[str, Any]:
+    states: Dict[str, Any] = {}
+    for key, cb in _enumerate_state_keys(callbacks):
+        sd = cb.state_dict()
+        if sd:
+            states[key] = sd
+    return states
+
+
+def restore_callback_states(callbacks, states: Dict[str, Any]) -> None:
+    for key, cb in _enumerate_state_keys(callbacks):
+        if key in states and states[key]:
+            cb.load_state_dict(states[key])

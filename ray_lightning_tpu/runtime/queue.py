@@ -1,0 +1,83 @@
+"""Cross-process queue, implemented as an actor (like ray.util.queue.Queue,
+which the reference uses to tunnel tune.report lambdas from workers to the
+driver: reference: ray_lightning/launchers/ray_launcher.py:101-103,
+session.py:61-63, util.py:49-54).
+
+The handle is picklable: workers and driver each talk to the queue actor
+over their own connection.
+"""
+from __future__ import annotations
+
+import collections
+import queue as _queue_mod
+from typing import Any, List, Optional
+
+from ray_lightning_tpu.runtime import api
+
+Full = _queue_mod.Full
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int = 0):
+        self.maxsize = maxsize
+        self.items: collections.deque = collections.deque()
+
+    def put(self, item: Any) -> bool:
+        if self.maxsize and len(self.items) >= self.maxsize:
+            return False
+        self.items.append(item)
+        return True
+
+    def get_nowait_batch(self, max_items: int = 0) -> List[Any]:
+        n = len(self.items) if max_items <= 0 else min(max_items, len(self.items))
+        return [self.items.popleft() for _ in range(n)]
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def empty(self) -> bool:
+        return not self.items
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        options = dict(actor_options or {})
+        self._actor = api.create_actor(
+            _QueueActor,
+            args=(maxsize,),
+            name=options.get("name"),
+            num_cpus=options.get("num_cpus", 0),
+            # queue actor never touches devices
+            env={"JAX_PLATFORMS": "cpu"},
+        )
+
+    @property
+    def actor(self):
+        return self._actor
+
+    def put(self, item: Any) -> None:
+        if not self._actor.call("put", item).result():
+            raise Full("queue is full")
+
+    def get_all(self) -> List[Any]:
+        return self._actor.call("get_nowait_batch").result()
+
+    def empty(self) -> bool:
+        return self._actor.call("empty").result()
+
+    def qsize(self) -> int:
+        return self._actor.call("qsize").result()
+
+    def shutdown(self) -> None:
+        api.kill(self._actor)
+
+
+class QueueClient:
+    """Worker-side view of a queue from a pickled ActorHandle."""
+
+    def __init__(self, actor_handle):
+        self._actor = actor_handle
+
+    def put(self, item: Any) -> None:
+        if not self._actor.call("put", item).result():
+            raise Full("queue is full")

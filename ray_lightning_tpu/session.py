@@ -1,0 +1,66 @@
+"""Per-worker singleton session: actor rank + queue handle back to driver.
+
+Direct role parity with the reference's session module (reference:
+ray_lightning/session.py:6-63): ``init_session`` is called exactly once per
+worker by the launcher's wrapping function; ``put_queue`` is how
+Tune callbacks tunnel ``report``/checkpoint lambdas back to the driver
+process.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+
+class RayLightningSession:
+    def __init__(self, rank: int, queue: Optional[Any]):
+        self._rank = rank
+        self._queue = queue
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    def put_queue(self, item: Callable) -> None:
+        if self._queue is None:
+            raise ValueError(
+                "Trying to put something into a session queue, but no queue "
+                "was configured (not running under tune?)"
+            )
+        self._queue.put(item)
+
+
+_session: Optional[RayLightningSession] = None
+
+
+def init_session(rank: int, queue: Optional[Any]) -> None:
+    global _session
+    if _session is not None:
+        raise ValueError(
+            "A session already exists in this process; only one training "
+            "session may be active per worker."
+        )
+    _session = RayLightningSession(rank=rank, queue=queue)
+
+
+def reset_session() -> None:
+    """Allow repeated fit() calls in one worker process (the reference's
+    double-init guard, ray_ddp.py:178-181, is per-process; workers here are
+    reused across trainer entry points)."""
+    global _session
+    _session = None
+
+
+def get_session() -> RayLightningSession:
+    if _session is None:
+        raise ValueError(
+            "No session found; init_session was not called in this process."
+        )
+    return _session
+
+
+def get_actor_rank() -> int:
+    return get_session().rank
+
+
+def put_queue(item: Callable) -> None:
+    get_session().put_queue(item)

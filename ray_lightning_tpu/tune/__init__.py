@@ -1,0 +1,31 @@
+from ray_lightning_tpu.tune.session import is_session_enabled, get_trial_session
+from ray_lightning_tpu.tune.callbacks import (
+    TuneReportCallback,
+    TuneReportCheckpointCallback,
+)
+from ray_lightning_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_lightning_tpu.tune.tune import run, get_tune_resources, ExperimentAnalysis
+from ray_lightning_tpu.tune.schedulers import ASHAScheduler, PopulationBasedTraining
+
+__all__ = [
+    "is_session_enabled",
+    "get_trial_session",
+    "TuneReportCallback",
+    "TuneReportCheckpointCallback",
+    "choice",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "uniform",
+    "run",
+    "get_tune_resources",
+    "ExperimentAnalysis",
+    "ASHAScheduler",
+    "PopulationBasedTraining",
+]

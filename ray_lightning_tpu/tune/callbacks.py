@@ -1,0 +1,148 @@
+"""Tune <-> Trainer callbacks.
+
+Direct parity with the reference's tune integration (reference:
+ray_lightning/tune.py:58-236): metrics and checkpoints produced inside
+*worker* processes must be reported from the *trial* process where the tune
+session lives, so they travel as callables over the session queue and are
+executed by the driver's result-polling loop (SURVEY §3.3 invariant).
+
+Improvement over the reference: the callbacks also work when the trainer runs
+a non-launcher strategy inside the trial process itself (no queue hop
+needed) — the reference hard-requires a Ray strategy.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ray_lightning_tpu.callbacks.base import Callback
+from ray_lightning_tpu.session import get_session
+from ray_lightning_tpu.tune import session as tune_session
+from ray_lightning_tpu.utils.serialization import to_state_stream
+
+
+def _deliver(fn) -> None:
+    """Run ``fn`` in the trial process: via the worker queue when we're in a
+    launcher worker, directly when the trial session is local."""
+    try:
+        worker_session = get_session()
+    except ValueError:
+        worker_session = None
+    if worker_session is not None:
+        worker_session.put_queue(fn)
+    else:
+        fn()
+
+
+class TuneCallback(Callback):
+    VALID_ON = ("validation_end", "train_epoch_end", "test_end")
+
+    def __init__(self, on: Union[str, Sequence[str]] = "validation_end"):
+        if isinstance(on, str):
+            on = [on]
+        for point in on:
+            if point not in self.VALID_ON:
+                raise ValueError(f"invalid hook point {point!r}; valid: {self.VALID_ON}")
+        self._on = list(on)
+
+    def _handle(self, trainer, module) -> None:
+        raise NotImplementedError
+
+    def on_validation_end(self, trainer, module):
+        if "validation_end" in self._on:
+            self._handle(trainer, module)
+
+    def on_train_epoch_end(self, trainer, module):
+        if "train_epoch_end" in self._on:
+            self._handle(trainer, module)
+
+    def on_test_end(self, trainer, module):
+        if "test_end" in self._on:
+            self._handle(trainer, module)
+
+
+class TuneReportCallback(TuneCallback):
+    """Report trainer metrics to tune (reference: tune.py:58-134).
+
+    ``metrics`` maps tune names -> trainer callback_metrics keys (or a list
+    of keys reported under their own names).
+    """
+
+    def __init__(
+        self,
+        metrics: Optional[Union[str, List[str], Dict[str, str]]] = None,
+        on: Union[str, Sequence[str]] = "validation_end",
+    ):
+        super().__init__(on)
+        if isinstance(metrics, str):
+            metrics = [metrics]
+        self._metrics = metrics
+
+    def _get_report_dict(self, trainer) -> Optional[Dict[str, float]]:
+        if trainer.sanity_checking:  # skip sanity-check metrics (tune.py:110-128)
+            return None
+        available = trainer.callback_metrics
+        if not self._metrics:
+            return {k: float(np.asarray(v)) for k, v in available.items()}
+        report = {}
+        if isinstance(self._metrics, dict):
+            items = self._metrics.items()
+        else:
+            items = [(m, m) for m in self._metrics]
+        for tune_name, trainer_name in items:
+            if trainer_name in available:
+                report[tune_name] = float(np.asarray(available[trainer_name]))
+        return report or None
+
+    def _handle(self, trainer, module):
+        if not trainer.is_global_zero:
+            return
+        report = self._get_report_dict(trainer)
+        if report is None:
+            return
+        _deliver(lambda: tune_session.report(**report))
+
+
+class _TuneCheckpointCallback(TuneCallback):
+    """Ship a full trainer checkpoint stream to the trial process, which
+    writes it into the trial dir (reference: tune.py:136-178 — the write
+    must happen driver-side because only the trial process knows the
+    checkpoint dir)."""
+
+    def __init__(self, filename: str = "checkpoint", on="validation_end"):
+        super().__init__(on)
+        self._filename = filename
+
+    def _handle(self, trainer, module):
+        if trainer.sanity_checking or not trainer.is_global_zero:
+            return
+        stream = to_state_stream(trainer.dump_checkpoint())
+        filename = self._filename
+
+        def write():
+            sess = tune_session.get_trial_session()
+            sess.checkpoint(stream, filename)
+
+        _deliver(write)
+
+
+class TuneReportCheckpointCallback(TuneCallback):
+    """Checkpoint then report, as one callback (reference: tune.py:180-236).
+    Checkpoint runs first so the reported iteration has a matching
+    checkpoint."""
+
+    def __init__(
+        self,
+        metrics: Optional[Union[str, List[str], Dict[str, str]]] = None,
+        filename: str = "checkpoint",
+        on: Union[str, Sequence[str]] = "validation_end",
+    ):
+        super().__init__(on)
+        self._checkpoint = _TuneCheckpointCallback(filename, on)
+        self._report = TuneReportCallback(metrics, on)
+
+    def _handle(self, trainer, module):
+        self._checkpoint._handle(trainer, module)
+        self._report._handle(trainer, module)

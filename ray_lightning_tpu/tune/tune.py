@@ -1,0 +1,334 @@
+"""The tune controller: parallel trials as runtime actors, scheduler-driven
+early stopping / PBT exploit-explore, result collection.
+
+Role parity: ``ray.tune.run`` as the reference uses it (reference:
+README.md:150-193, examples/ray_ddp_example.py:118-173, tests/test_tune.py).
+Each trial is a *trial-driver process* (an actor) executing the user's
+trainable function; inside it, the trainable may construct a Trainer with a
+Ray strategy, which spawns nested worker actors — the reference's exact
+process topology (SURVEY §3.3).
+
+PBT restore contract: when a trial is exploited, it restarts with the
+mutated config plus ``config["__checkpoint_path__"]`` pointing at the source
+trial's checkpoint; trainables pass it to ``trainer.fit(ckpt_path=...)``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+from ray_lightning_tpu import runtime as rt
+from ray_lightning_tpu.tune.schedulers import (
+    CONTINUE,
+    EXPLOIT,
+    STOP,
+    FIFOScheduler,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_lightning_tpu.tune.search import generate_trial_configs, mutate_config
+
+
+def get_tune_resources(
+    num_workers: int = 1,
+    num_cpus_per_worker: int = 1,
+    use_gpu: bool = False,
+    use_tpu: bool = False,
+) -> Dict[str, float]:
+    """Resource bundle for one trial (reference: tune.py:32-56 builds a
+    PlacementGroupFactory of 1 driver CPU + num_workers bundles; here the
+    single-host runtime consumes a flat dict with the same accounting)."""
+    resources: Dict[str, float] = {"CPU": 1 + num_workers * num_cpus_per_worker}
+    if use_tpu or use_gpu:
+        resources["TPU_HOST"] = float(num_workers)
+    return resources
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    logdir: str
+    status: str = "PENDING"  # RUNNING | TERMINATED | STOPPED | ERROR
+    results: List[Dict[str, Any]] = field(default_factory=list)
+    checkpoints: List[Dict[str, Any]] = field(default_factory=list)
+    error: Optional[str] = None
+    last_iteration: int = 0
+    _actor: Any = None
+    _future: Any = None
+    _stopping: bool = False
+
+    @property
+    def last_result(self) -> Dict[str, Any]:
+        return self.results[-1] if self.results else {}
+
+    def metric_value(self, metric: str, mode: str) -> Optional[float]:
+        values = [r[metric] for r in self.results if metric in r]
+        if not values:
+            return None
+        return min(values) if mode == "min" else max(values)
+
+
+class _TrialRunner:
+    """Actor hosting one trial-driver process."""
+
+    def run(self, trainable_bytes, config, trial_id, trial_dir, queue_handle):
+        import os
+
+        from ray_lightning_tpu.runtime.queue import QueueClient
+        from ray_lightning_tpu.tune.session import (
+            TrialSession,
+            clear_trial_session,
+            init_trial_session,
+        )
+
+        os.makedirs(trial_dir, exist_ok=True)
+        queue = QueueClient(queue_handle)
+        trainable = cloudpickle.loads(trainable_bytes)
+
+        def report_fn(metrics, iteration):
+            row = dict(metrics)
+            row["training_iteration"] = iteration
+            row["trial_id"] = trial_id
+            with open(os.path.join(trial_dir, "result.json"), "a") as f:
+                f.write(json.dumps(row) + "\n")
+            queue.put(("report", trial_id, row, iteration))
+
+        def checkpoint_fn(data: bytes, name: str, iteration: int) -> str:
+            ckpt_dir = os.path.join(trial_dir, f"checkpoint_{iteration:06d}")
+            os.makedirs(ckpt_dir, exist_ok=True)
+            path = os.path.join(ckpt_dir, name)
+            with open(path, "wb") as f:
+                f.write(data)
+            queue.put(("checkpoint", trial_id, path, iteration))
+            return path
+
+        init_trial_session(TrialSession(trial_id, trial_dir, report_fn, checkpoint_fn))
+        try:
+            trainable(config)
+        finally:
+            clear_trial_session()
+        return "done"
+
+
+class ExperimentAnalysis:
+    def __init__(self, trials: List[Trial], metric: Optional[str], mode: str):
+        self.trials = trials
+        self.default_metric = metric
+        self.default_mode = mode
+
+    def _resolve(self, metric, mode):
+        return metric or self.default_metric, mode or self.default_mode
+
+    @property
+    def best_trial(self) -> Optional[Trial]:
+        return self.get_best_trial()
+
+    def get_best_trial(self, metric=None, mode=None) -> Optional[Trial]:
+        metric, mode = self._resolve(metric, mode)
+        scored = [
+            (t, t.metric_value(metric, mode))
+            for t in self.trials
+            if t.metric_value(metric, mode) is not None
+        ]
+        if not scored:
+            return None
+        return (min if mode == "min" else max)(scored, key=lambda kv: kv[1])[0]
+
+    @property
+    def best_config(self) -> Optional[Dict[str, Any]]:
+        trial = self.best_trial
+        return trial.config if trial else None
+
+    @property
+    def best_checkpoint(self) -> Optional[str]:
+        trial = self.best_trial
+        if trial and trial.checkpoints:
+            return trial.checkpoints[-1]["path"]
+        return None
+
+    def dataframe(self) -> List[Dict[str, Any]]:
+        return [
+            {**t.last_result, "trial_id": t.trial_id, "status": t.status}
+            for t in self.trials
+        ]
+
+    @property
+    def results(self) -> Dict[str, List[Dict[str, Any]]]:
+        return {t.trial_id: t.results for t in self.trials}
+
+
+def run(
+    trainable: Callable[[Dict[str, Any]], Any],
+    config: Optional[Dict[str, Any]] = None,
+    num_samples: int = 1,
+    metric: Optional[str] = None,
+    mode: str = "min",
+    scheduler: Optional[TrialScheduler] = None,
+    name: Optional[str] = None,
+    local_dir: Optional[str] = None,
+    resources_per_trial: Optional[Dict[str, float]] = None,
+    max_concurrent_trials: Optional[int] = None,
+    trial_env: Optional[Dict[str, str]] = None,
+    seed: int = 0,
+    poll_interval: float = 0.05,
+    verbose: int = 1,
+) -> ExperimentAnalysis:
+    if not rt.is_initialized():
+        rt.init()
+    scheduler = scheduler or FIFOScheduler()
+    name = name or f"tune-{int(time.time())}"
+    local_dir = os.path.abspath(local_dir or os.path.join(os.getcwd(), "tune_results"))
+    exp_dir = os.path.join(local_dir, name)
+    os.makedirs(exp_dir, exist_ok=True)
+
+    configs = generate_trial_configs(config, num_samples, seed=seed)
+    trials = [
+        Trial(
+            trial_id=f"trial_{i:05d}",
+            config=conf,
+            logdir=os.path.join(exp_dir, f"trial_{i:05d}"),
+        )
+        for i, conf in enumerate(configs)
+    ]
+    by_id = {t.trial_id: t for t in trials}
+
+    cpus_per_trial = (resources_per_trial or {}).get("CPU", 1)
+    if max_concurrent_trials is None:
+        max_concurrent_trials = max(1, int((os.cpu_count() or 4) // max(1, cpus_per_trial)))
+    max_concurrent_trials = min(max_concurrent_trials, len(trials)) or 1
+
+    queue = rt.Queue()
+    trainable_bytes = cloudpickle.dumps(trainable)
+
+    def start_trial(trial: Trial):
+        trial.status = "RUNNING"
+        trial._stopping = False
+        (trial._actor,) = rt.create_actors(
+            [(_TrialRunner, (), {})],
+            names=[f"tune-{name}-{trial.trial_id}-{time.monotonic_ns()}"],
+            env=trial_env,
+        )
+        trial._future = trial._actor.run.remote(
+            trainable_bytes, trial.config, trial.trial_id, trial.logdir, queue.actor
+        )
+
+    def stop_trial(trial: Trial, status: str):
+        trial._stopping = True
+        trial.status = status
+        if trial._actor is not None:
+            rt.kill(trial._actor, timeout=2.0)
+            trial._actor = None
+        scheduler.on_complete(trial.trial_id)
+
+    def reap_finished(trial: Trial) -> str:
+        """Resolve a completed future into TERMINATED or ERROR."""
+        try:
+            trial._future.result()
+            return "TERMINATED"
+        except Exception:
+            trial.error = traceback.format_exc()
+            return "ERROR"
+
+    def handle_decision(trial: Trial, decision, extra):
+        if decision == STOP:
+            # a trial that already ran to completion terminated (or errored)
+            # naturally; STOP only means "don't let it run further"
+            if trial._future is not None and trial._future.done():
+                stop_trial(trial, reap_finished(trial))
+            else:
+                if verbose:
+                    print(
+                        f"[tune] {trial.trial_id} stopped by scheduler "
+                        f"at iter {trial.last_iteration}"
+                    )
+                stop_trial(trial, "STOPPED")
+        elif decision == EXPLOIT:
+            source = by_id[extra]
+            if verbose:
+                print(f"[tune] {trial.trial_id} exploits {source.trial_id}")
+            stop_trial(trial, "PENDING")
+            # clone the WINNER's config, then explore around it
+            mutations = getattr(scheduler, "hyperparam_mutations", {})
+            rng = getattr(scheduler, "rng", None)
+            if rng is not None and mutations:
+                new_config = mutate_config(source.config, mutations, rng)
+            else:
+                new_config = dict(source.config)
+            if source.checkpoints:
+                new_config["__checkpoint_path__"] = source.checkpoints[-1]["path"]
+            trial.config = new_config
+            trial.status = "PENDING"
+
+    try:
+        pending = list(trials)
+        while True:
+            running = [t for t in trials if t.status == "RUNNING"]
+            pending = [t for t in trials if t.status == "PENDING"]
+            while pending and len(running) < max_concurrent_trials:
+                trial = pending.pop(0)
+                start_trial(trial)
+                running.append(trial)
+
+            # drain result/checkpoint messages
+            for msg in queue.get_all():
+                kind, trial_id, payload, iteration = msg
+                trial = by_id[trial_id]
+                if kind == "report":
+                    trial.results.append(payload)
+                    trial.last_iteration = iteration
+                    decision, extra = scheduler.on_result(trial_id, payload, iteration)
+                    if decision != CONTINUE and trial.status == "RUNNING":
+                        handle_decision(trial, decision, extra)
+                elif kind == "checkpoint":
+                    trial.checkpoints.append({"path": payload, "iteration": iteration})
+
+            # reap finished trials
+            for trial in trials:
+                if trial.status != "RUNNING" or trial._future is None:
+                    continue
+                if trial._future.done():
+                    trial.status = reap_finished(trial)
+                    if trial._actor is not None:
+                        rt.kill(trial._actor, timeout=2.0)
+                        trial._actor = None
+                    scheduler.on_complete(trial.trial_id)
+
+            if all(t.status in ("TERMINATED", "STOPPED", "ERROR") for t in trials):
+                break
+            time.sleep(poll_interval)
+    finally:
+        for trial in trials:
+            if trial._actor is not None:
+                rt.kill(trial._actor, timeout=2.0)
+        queue.shutdown()
+
+    errored = [t for t in trials if t.status == "ERROR"]
+    if errored and verbose:
+        for t in errored:
+            print(f"[tune] {t.trial_id} ERROR:\n{t.error}")
+
+    analysis = ExperimentAnalysis(trials, metric, mode)
+    with open(os.path.join(exp_dir, "experiment_state.json"), "w") as f:
+        json.dump(
+            [
+                {
+                    "trial_id": t.trial_id,
+                    "status": t.status,
+                    "config": {k: repr(v) for k, v in t.config.items()},
+                    "last_result": t.last_result,
+                    "checkpoints": t.checkpoints,
+                }
+                for t in trials
+            ],
+            f,
+            indent=2,
+            default=str,
+        )
+    return analysis

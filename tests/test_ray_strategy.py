@@ -1,0 +1,115 @@
+"""Ray-actor strategy family: distributed fit with weight/metric recovery,
+sharding policies, constructor parity. Mirrors reference tests/test_ddp.py,
+test_ddp_sharded.py, test_horovod.py concerns on the CPU backend
+(SURVEY §4 mechanism 1: a local "cluster" exercises the real code path)."""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+import ray_lightning_tpu as rlt
+from ray_lightning_tpu.parallel.mesh import MeshSpec, build_mesh
+from ray_lightning_tpu.parallel.sharding import ShardingPolicy, fsdp_param_shardings
+from ray_lightning_tpu.strategies.ray_strategies import (
+    HorovodRayStrategy,
+    RayShardedStrategy,
+    RayStrategy,
+    RayTPUStrategy,
+)
+from ray_lightning_tpu.models.mnist import MNISTClassifier, MNISTDataModule
+
+from tests.utils import get_trainer
+
+
+def test_public_exports():
+    assert rlt.RayStrategy is RayStrategy
+    assert rlt.RayTPUStrategy is RayStrategy
+    assert rlt.HorovodRayStrategy is HorovodRayStrategy
+    assert rlt.RayShardedStrategy is RayShardedStrategy
+
+
+def test_ctor_parity_kwargs():
+    s = RayStrategy(
+        num_workers=4, num_cpus_per_worker=2, use_gpu=False,
+        resources_per_worker={"CPU": 2},
+    )
+    assert s.num_workers == 4
+    assert s.world_size == 4
+    assert s.global_rank == 0
+    assert s.distributed_sampler_kwargs == {"num_replicas": 4, "rank": 0}
+
+
+def test_worker_env_cpu_platform():
+    s = RayStrategy(num_workers=2, platform="cpu", devices_per_worker=4)
+    env = s.worker_env()
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
+
+
+def test_sharded_policy_shards_large_leaves():
+    mesh = build_mesh(MeshSpec.data_parallel(), jax.devices()[:4])
+    params = {
+        "big": jax.ShapeDtypeStruct((256, 128), jax.numpy.float32),
+        "small": jax.ShapeDtypeStruct((8,), jax.numpy.float32),
+    }
+    shardings = fsdp_param_shardings(mesh, params, ("dp",), min_shard_size=1024)
+    assert shardings["big"].spec[0] == "dp"
+    assert shardings["small"].spec == P()
+
+
+def test_sharded_strategy_defaults():
+    s = RayShardedStrategy(num_workers=2)
+    assert s.zero_stage == 2
+    assert s.sharding_policy.zero_stage == 2
+    s3 = RayShardedStrategy(num_workers=2, zero_stage=3)
+    assert s3.sharding_policy.zero_stage == 3
+
+
+def test_horovod_parity_props():
+    s = HorovodRayStrategy(num_workers=3, use_gpu=False)
+    assert s.num_slots == 3
+    assert s.world_size == 3
+
+
+@pytest.mark.slow
+def test_ray_fit_two_workers(tmp_root):
+    """The flagship distributed path: 2 worker processes x 2 devices,
+    jax.distributed rendezvous, GSPMD gradient all-reduce, rank-0 weights
+    and metrics recovered on the driver (reference: test_ddp.py:214-286)."""
+    model = MNISTClassifier({"lr": 1e-2})
+    dm = MNISTDataModule(batch_size=32)
+    strategy = RayStrategy(num_workers=2, platform="cpu", devices_per_worker=2)
+    trainer = get_trainer(
+        tmp_root, max_epochs=2, strategy=strategy, limit_train_batches=None
+    )
+    trainer.fit(model, datamodule=dm)
+    assert trainer.state.status == "finished"
+    assert model.params is not None  # weights came back
+    assert "ptl/val_loss" in trainer.callback_metrics
+    assert float(trainer.callback_metrics["ptl/val_accuracy"]) > 0.5
+    assert trainer.checkpoint_callback.best_model_path  # state recovered
+    assert trainer.current_epoch == 2
+
+
+@pytest.mark.slow
+def test_sharded_fit_single_worker(tmp_root):
+    """ZeRO-sharded fit on one worker with a 4-device mesh: optimizer state
+    sharded over dp (reference sharded tests: test_ddp_sharded.py:27-61)."""
+    model = MNISTClassifier({"lr": 1e-2})
+    dm = MNISTDataModule(batch_size=32)
+    strategy = RayShardedStrategy(
+        num_workers=1, platform="cpu", devices_per_worker=4, zero_stage=2
+    )
+    trainer = get_trainer(
+        tmp_root, max_epochs=1, strategy=strategy, limit_train_batches=None
+    )
+    trainer.fit(model, datamodule=dm)
+    assert model.params is not None
+    # the recovered weights are usable by a plain local trainer (weights
+    # round-trip across process + sharding boundaries)
+    local = get_trainer(tmp_root, checkpoint_callback=False)
+    preds = local.predict(model, datamodule=dm)
+    merged = np.concatenate([np.asarray(p) for p in preds])
+    labels = dm.test_data.arrays["label"][: len(merged)]
+    assert float((merged == labels).mean()) >= 0.5
