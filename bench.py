@@ -1,0 +1,112 @@
+"""Benchmark: flagship decoder-LM training throughput on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric is the north-star from BASELINE.json — LightningModule tokens/sec/chip
+on a full training step (fwd + bwd + adamw, bf16, remat, flash attention).
+The reference publishes no numbers (BASELINE.md), so vs_baseline is measured
+MFU relative to the 40% MFU target BASELINE.md sets for the stretch config.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", default="mini", choices=["tiny", "mini"])
+    parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--warmup", type=int, default=2)
+    args = parser.parse_args()
+
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the image's sitecustomize prepends its TPU plugin to jax_platforms
+        # regardless of env; honor an explicit CPU request at config level
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_lightning_tpu.callbacks.throughput import detect_peak_tflops
+    from ray_lightning_tpu.models.llama import (
+        LlamaConfig,
+        init_params,
+        lm_loss,
+    )
+
+    cfg = getattr(LlamaConfig, args.preset)()
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    if not on_tpu and args.preset == "mini":
+        cfg = LlamaConfig.tiny()  # keep CPU fallback runs fast
+    batch = args.batch or (16 if on_tpu else 4)
+    seq = cfg.max_seq
+
+    params = init_params(jax.random.key(0), cfg)
+    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    opt_state = tx.init(params)
+
+    def train_step(params, opt_state, tokens):
+        (loss, _), grads = jax.value_and_grad(
+            lambda p: lm_loss(p, tokens, cfg), has_aux=True
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)),
+        jnp.int32,
+    )
+
+    for _ in range(args.warmup):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    final_loss = float(loss)  # forces completion of the whole chain
+    elapsed = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * args.steps / elapsed
+    flops_per_token = cfg.flops_per_token()
+    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
+    peak = detect_peak_tflops()
+    mfu = achieved_tflops / peak
+    result = {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {
+            "preset": args.preset,
+            "params_millions": round(cfg.num_params() / 1e6, 1),
+            "batch": batch,
+            "seq": seq,
+            "steps": args.steps,
+            "step_time_ms": round(elapsed / args.steps * 1e3, 2),
+            "achieved_tflops_per_chip": round(achieved_tflops, 2),
+            "mfu": round(mfu, 4),
+            "peak_tflops_assumed": peak,
+            "final_loss": round(final_loss, 4),
+            "platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", "?"),
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

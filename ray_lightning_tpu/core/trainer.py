@@ -413,6 +413,8 @@ class Trainer:
         seed = seed_everything(self.seed)
         self._datamodule = datamodule
         self.strategy.setup_environment()
+        if hasattr(model, "mesh"):
+            model.mesh = self.strategy.mesh
 
         if datamodule is not None:
             datamodule.prepare_data()
@@ -437,9 +439,13 @@ class Trainer:
         self._tx = self._normalize_tx(model.configure_optimizers())
         opt_shapes = jax.eval_shape(self._tx.init, self._params)
         opt_shardings = self.strategy.optstate_shardings(opt_shapes)
-        self._opt_state = jax.jit(self._tx.init, out_shardings=opt_shardings)(
-            self._params
-        )
+        if opt_shardings is None:
+            # moments inherit the param shardings through XLA propagation
+            self._opt_state = jax.jit(self._tx.init)(self._params)
+        else:
+            self._opt_state = jax.jit(self._tx.init, out_shardings=opt_shardings)(
+                self._params
+            )
 
         if ckpt_path is not None:
             self._restore_checkpoint(ckpt_path)
@@ -645,6 +651,8 @@ class Trainer:
     def _eval_impl(self, model, dataloaders, datamodule, ckpt_path, phase: str):
         seed_everything(self.seed)
         self.strategy.setup_environment()
+        if hasattr(model, "mesh"):
+            model.mesh = self.strategy.mesh
         if datamodule is not None:
             datamodule.prepare_data()
             datamodule.setup(phase if phase != "val" else "validate")
@@ -681,6 +689,8 @@ class Trainer:
     def _predict_impl(self, model, dataloaders, datamodule, ckpt_path):
         seed_everything(self.seed)
         self.strategy.setup_environment()
+        if hasattr(model, "mesh"):
+            model.mesh = self.strategy.mesh
         if datamodule is not None:
             datamodule.prepare_data()
             datamodule.setup("predict")
@@ -764,7 +774,16 @@ class Trainer:
             host_opt = flax_serialization.from_state_dict(
                 jax.device_get(self._opt_state), ckpt["optimizer_state"]
             )
-            self._opt_state = self.strategy.place_optstate(host_opt)
+            # the freshly-initialized opt_state is the sharding template —
+            # restore each leaf with the sharding it already has (works for
+            # both policy-driven and module-owned layouts)
+            self._opt_state = jax.tree_util.tree_map(
+                lambda tmpl, h: jax.device_put(h, tmpl.sharding)
+                if hasattr(tmpl, "sharding")
+                else h,
+                self._opt_state,
+                host_opt,
+            )
         self.current_epoch = int(ckpt.get("epoch", 0)) + 1
         self.global_step = int(ckpt.get("global_step", 0))
         from ray_lightning_tpu.callbacks.base import restore_callback_states

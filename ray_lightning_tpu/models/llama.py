@@ -1,0 +1,354 @@
+"""Flagship decoder-LM family (Llama-style): TPU-first pure-JAX transformer.
+
+Why hand-rolled rather than flax.linen: the param pytree doubles as the
+sharding surface — every leaf gets an explicit PartitionSpec over the
+(dp, fsdp, tp, sp) mesh axes (megatron layout for tp, largest-axis for
+fsdp), and layers are STACKED so the whole network is one ``lax.scan``
+(one compile of one layer, weights DMA'd per step — the standard TPU
+pattern for deep stacks) with ``jax.checkpoint`` rematerialisation.
+
+Role in the framework: the reference wraps user torch models and has no
+model zoo beyond examples (reference: ray_lightning/examples/); BASELINE.json
+names a Llama-3-8B config as the stretch target, so this family is built
+natively with its parallelism.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_lightning_tpu.core.module import LightningModule
+from ray_lightning_tpu.ops.attention import attention
+from ray_lightning_tpu.ops.rmsnorm import rmsnorm
+from ray_lightning_tpu.ops.rope import apply_rope, rope_angles
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 2048
+    n_layers: int = 16
+    n_heads: int = 16
+    n_kv_heads: int = 8
+    ffn_dim: int = 5632
+    max_seq: int = 2048
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attn_impl: Optional[str] = None  # None=auto, "flash", "reference"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def num_params(self) -> int:
+        d, f, v = self.dim, self.ffn_dim, self.vocab_size
+        per_layer = (
+            d * (self.n_heads * self.head_dim)  # wq
+            + 2 * d * (self.n_kv_heads * self.head_dim)  # wk, wv
+            + (self.n_heads * self.head_dim) * d  # wo
+            + 3 * d * f  # gate, up, down
+            + 2 * d  # norms
+        )
+        return v * d * 2 + self.n_layers * per_layer + d
+
+    def flops_per_token(self) -> float:
+        """Training FLOPs/token ~ 6*N plus attention term."""
+        return 6.0 * self.num_params() + 12.0 * self.n_layers * self.dim * self.max_seq
+
+    # ---- presets ----
+    @staticmethod
+    def tiny() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=512, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=256, max_seq=128, remat=False,
+        )
+
+    @staticmethod
+    def mini() -> "LlamaConfig":  # ~160M: the single-chip bench config
+        return LlamaConfig(
+            vocab_size=32000, dim=768, n_layers=12, n_heads=12, n_kv_heads=12,
+            ffn_dim=2048, max_seq=1024,
+        )
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            ffn_dim=14336, max_seq=8192,
+        )
+
+
+# --------------------------------------------------------------------- #
+# parameters
+# --------------------------------------------------------------------- #
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Stacked-layer param pytree. Layer leaves have leading dim n_layers."""
+    k_embed, k_layers, k_head = jax.random.split(rng, 3)
+    d, hd = cfg.dim, cfg.head_dim
+    dt = cfg.dtype
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)).astype(dt)
+
+    L = cfg.n_layers
+    lk = jax.random.split(k_layers, 7)
+    layers = {
+        "attn_norm": jnp.ones((L, d), dt),
+        "wq": dense(lk[0], (L, d, cfg.n_heads * hd), d),
+        "wk": dense(lk[1], (L, d, cfg.n_kv_heads * hd), d),
+        "wv": dense(lk[2], (L, d, cfg.n_kv_heads * hd), d),
+        "wo": dense(lk[3], (L, cfg.n_heads * hd, d), cfg.n_heads * hd),
+        "mlp_norm": jnp.ones((L, d), dt),
+        "w_gate": dense(lk[4], (L, d, cfg.ffn_dim), d),
+        "w_up": dense(lk[5], (L, d, cfg.ffn_dim), d),
+        "w_down": dense(lk[6], (L, cfg.ffn_dim, d), cfg.ffn_dim),
+    }
+    return {
+        "embed": dense(k_embed, (cfg.vocab_size, d), d),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), dt),
+        "lm_head": dense(k_head, (d, cfg.vocab_size), d),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+    """PartitionSpecs per leaf over ('fsdp', 'tp') — megatron tp layout:
+    column-parallel in-projections, row-parallel out-projections; fsdp
+    shards the other big axis. Specs reference axis names that may or may
+    not exist in a given mesh; filter with :func:`shardings_for_mesh`."""
+    return {
+        # vocab axis replicated: token gather must stay local (a
+        # vocab-sharded gather forces involuntary full remat in SPMD);
+        # the model dim shards over both axes instead
+        "embed": P(None, ("fsdp", "tp")),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "tp"),
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, "fsdp", "tp"),
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),
+        },
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+def _filter_spec(spec: P, mesh: Mesh) -> P:
+    """Drop axis names the mesh doesn't have (or has at size 1)."""
+    entries = []
+    for entry in spec:
+        if entry is None:
+            entries.append(None)
+        elif isinstance(entry, (tuple, list)):
+            keep = tuple(a for a in entry if a in mesh.axis_names and mesh.shape[a] > 1)
+            entries.append(keep if keep else None)
+        else:
+            entries.append(
+                entry if entry in mesh.axis_names and mesh.shape[entry] > 1 else None
+            )
+    return P(*entries)
+
+
+def shardings_for_mesh(cfg: LlamaConfig, mesh: Mesh) -> Dict[str, Any]:
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, _filter_spec(s, mesh)),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+# --------------------------------------------------------------------- #
+# forward
+# --------------------------------------------------------------------- #
+def _act_constraint(x, mesh: Optional[Mesh], *entries):
+    if mesh is None:
+        return x
+    spec = _filter_spec(P(*entries), mesh)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+) -> jnp.ndarray:
+    """tokens [B, S] -> logits [B, S, V].
+
+    Data axes: batch over ('dp','fsdp'); sequence over 'sp' (ring attention
+    handles cross-shard attention when the mesh has sp>1).
+    """
+    B, S = tokens.shape
+    hd = cfg.head_dim
+    x = params["embed"][tokens]  # gather -> [B, S, D]
+    x = _act_constraint(x, mesh, ("dp", "fsdp"), "sp", None)
+    cos, sin = rope_angles(S, hd, cfg.rope_theta)
+
+    use_ring = (
+        mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1
+    )
+    if use_ring:
+        from ray_lightning_tpu.parallel.ring_attention import ring_attention
+
+    def layer_fn(x, lp):
+        h = rmsnorm(x, lp["attn_norm"])
+        q = (h @ lp["wq"]).reshape(B, S, cfg.n_heads, hd)
+        k = (h @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+        q = apply_rope(q, cos, sin)  # [B, S, H, hd]
+        k = apply_rope(k, cos, sin)
+        # [B, H, S, hd] for the kernel
+        q = q.swapaxes(1, 2)
+        k = k.swapaxes(1, 2)
+        v = v.swapaxes(1, 2)
+        if use_ring:
+            att = ring_attention(q, k, v, mesh=mesh, axis="sp", causal=True)
+        else:
+            att = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        att = att.swapaxes(1, 2).reshape(B, S, cfg.n_heads * hd)
+        x = x + att @ lp["wo"]
+        h2 = rmsnorm(x, lp["mlp_norm"])
+        gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
+        x = x + gated @ lp["w_down"]
+        x = _act_constraint(x, mesh, ("dp", "fsdp"), "sp", None)
+        return x, None
+
+    scanned = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
+    x, _ = jax.lax.scan(scanned, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"])
+    logits = x @ params["lm_head"]
+    return logits
+
+
+def lm_loss(
+    params, tokens, cfg: LlamaConfig, mesh: Optional[Mesh] = None
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Next-token cross entropy. The full sequence is fed (so sequence
+    sharding stays divisible) and the last position is masked out."""
+    logits = forward(params, tokens, cfg, mesh)
+    targets = jnp.roll(tokens, -1, axis=1)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets
+    )
+    mask = jnp.ones_like(losses).at[:, -1].set(0.0)
+    loss = jnp.sum(losses * mask) / jnp.sum(mask)
+    return loss, {"loss": loss, "ppl": jnp.exp(loss)}
+
+
+# --------------------------------------------------------------------- #
+# LightningModule wrapper
+# --------------------------------------------------------------------- #
+class LlamaModule(LightningModule):
+    """The flagship LightningModule: decoder-LM pretraining step."""
+
+    def __init__(self, config: Optional[LlamaConfig] = None, lr: float = 3e-4,
+                 warmup_steps: int = 100, total_steps: int = 10000,
+                 weight_decay: float = 0.1):
+        super().__init__()
+        self.config = config or LlamaConfig.tiny()
+        self.lr = lr
+        self.warmup_steps = warmup_steps
+        self.total_steps = total_steps
+        self.weight_decay = weight_decay
+        self.hparams.update(
+            lr=lr, warmup_steps=warmup_steps, total_steps=total_steps,
+            weight_decay=weight_decay,
+        )
+        self.mesh: Optional[Mesh] = None  # set by trainer/strategy if sharded
+
+    def init_params(self, rng):
+        return init_params(rng, self.config)
+
+    def param_shardings(self, mesh: Optional[Mesh]):
+        """Module-owned sharding layout consumed by the Strategy (megatron
+        tp + fsdp; see :func:`param_specs`)."""
+        if mesh is None:
+            return None
+        self.mesh = mesh
+        return shardings_for_mesh(self.config, mesh)
+
+    def _tokens_of(self, batch):
+        if isinstance(batch, dict):
+            return batch["input_ids"]
+        return batch
+
+    def training_step(self, params, batch, batch_idx):
+        loss, logs = lm_loss(params, self._tokens_of(batch), self.config, self.mesh)
+        self.log("train_loss", loss, on_step=True, on_epoch=True)
+        self.log("train_ppl", logs["ppl"], on_step=True, on_epoch=False)
+        return loss
+
+    def validation_step(self, params, batch, batch_idx):
+        loss, logs = lm_loss(params, self._tokens_of(batch), self.config, self.mesh)
+        self.log("val_loss", loss)
+        self.log("val_ppl", logs["ppl"])
+
+    def predict_step(self, params, batch, batch_idx):
+        return forward(params, self._tokens_of(batch), self.config, self.mesh)
+
+    def configure_optimizers(self):
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, self.lr, self.warmup_steps, max(self.total_steps, self.warmup_steps + 1)
+        )
+        return optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=self.weight_decay)
+
+
+from ray_lightning_tpu.core.datamodule import LightningDataModule
+
+
+class SyntheticLMDataModule(LightningDataModule):
+    """Learnable synthetic token streams (arithmetic progressions) so LM
+    tests can assert the loss actually falls."""
+
+    def __init__(self, cfg: LlamaConfig, batch_size: int = 8, n_train: int = 256,
+                 n_val: int = 64, seed: int = 0):
+        super().__init__()
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.n_train = n_train
+        self.n_val = n_val
+        self.seed = seed
+
+    def prepare_data(self):
+        pass
+
+    def _make(self, n, seed):
+        from ray_lightning_tpu.core.data import DictDataset
+
+        rng = np.random.default_rng(seed)
+        starts = rng.integers(0, self.cfg.vocab_size, size=(n, 1))
+        steps = rng.integers(1, 4, size=(n, 1))
+        seq = (starts + steps * np.arange(self.cfg.max_seq)[None, :]) % self.cfg.vocab_size
+        return DictDataset(input_ids=seq.astype(np.int32))
+
+    def setup(self, stage):
+        self.train_data = self._make(self.n_train, self.seed)
+        self.val_data = self._make(self.n_val, self.seed + 1)
+
+    def teardown(self, stage):
+        pass
+
+    def train_dataloader(self):
+        from ray_lightning_tpu.core.data import DataLoader
+
+        return DataLoader(self.train_data, batch_size=self.batch_size, shuffle=True,
+                          drop_last=True)
+
+    def val_dataloader(self):
+        from ray_lightning_tpu.core.data import DataLoader
+
+        return DataLoader(self.val_data, batch_size=self.batch_size, drop_last=True)

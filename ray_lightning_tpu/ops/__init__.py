@@ -1,0 +1,11 @@
+from ray_lightning_tpu.ops.rope import apply_rope, rope_angles
+from ray_lightning_tpu.ops.rmsnorm import rmsnorm
+from ray_lightning_tpu.ops.attention import attention, reference_attention
+
+__all__ = [
+    "apply_rope",
+    "rope_angles",
+    "rmsnorm",
+    "attention",
+    "reference_attention",
+]

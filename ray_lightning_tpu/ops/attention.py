@@ -1,0 +1,374 @@
+"""Attention: blockwise (flash) pallas TPU kernels with custom VJP, plus a
+reference einsum path.
+
+Design (TPU-first):
+- layout [B, H, S, D] so the inner dots are MXU-shaped [BQ, D] x [D, BK];
+- forward: online-softmax over KV blocks (fp32 accumulators carried through
+  a fori_loop, bf16 inputs), causal block skipping via the loop bound;
+- backward: recompute-based (no S x S materialization): a dQ kernel looping
+  KV blocks and a dK/dV kernel looping Q blocks, both seeded with the saved
+  per-row logsumexp and delta = rowsum(dO * O);
+- GQA: KV-head index derived in the BlockSpec index map (no repeat/copy);
+- `interpret=True` runs the same kernels on CPU for numerical tests.
+
+The reference project has no attention of its own (it wraps user torch
+models); this is the hot op of our flagship model family (SURVEY §5
+long-context: ring attention in parallel/ring_attention.py shards sequence
+ACROSS chips and calls this kernel per block pair).
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _interpret_default() -> bool:
+    if os.environ.get("RLT_PALLAS_INTERPRET"):
+        return True
+    return jax.devices()[0].platform not in ("tpu", "axon")
+
+
+# --------------------------------------------------------------------- #
+# reference path
+# --------------------------------------------------------------------- #
+def reference_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """q: [B, Hq, S, D]; k, v: [B, Hkv, S, D] with Hq % Hkv == 0."""
+    b, hq, sq, d = q.shape
+    hkv = k.shape[1]
+    if hq != hkv:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        skv = k.shape[2]
+        qi = jnp.arange(sq)[:, None] + (skv - sq)
+        ki = jnp.arange(skv)[None, :]
+        logits = jnp.where(qi >= ki, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v).astype(q.dtype)
+
+
+# --------------------------------------------------------------------- #
+# pallas forward
+# --------------------------------------------------------------------- #
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    q = q_ref[:].astype(jnp.float32) * scale  # [BQ, D]
+    skv = k_ref.shape[0]
+    n_kv = skv // block_k
+    if causal:
+        # only blocks whose first kv index <= last q index
+        hi = jax.lax.min(((qi + 1) * block_q + block_k - 1) // block_k, n_kv)
+    else:
+        hi = n_kv
+
+    def body(j, carry):
+        acc, m, l = carry
+        ks = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vs = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, vs, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc, m_new, l
+
+    d = q_ref.shape[-1]
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
+    # logsumexp per row, columnar [BQ, 1] (TPU tiling wants the blocked
+    # seq dim second-to-last)
+    l_ref[:] = m + jnp.log(l_safe)
+
+
+# --------------------------------------------------------------------- #
+# pallas backward: dQ
+# --------------------------------------------------------------------- #
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, scale, causal, block_q, block_k,
+):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    q = q_ref[:].astype(jnp.float32)  # [BQ, D]
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]  # [BQ, 1]
+    delta = delta_ref[:]
+    skv = k_ref.shape[0]
+    n_kv = skv // block_k
+    if causal:
+        hi = jax.lax.min(((qi + 1) * block_q + block_k - 1) // block_k, n_kv)
+    else:
+        hi = n_kv
+
+    def body(j, dq):
+        ks = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vs = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        p = jnp.exp(s - lse)  # [BQ, BK]
+        dp = jax.lax.dot_general(
+            do, vs, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, ks, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    d = q_ref.shape[-1]
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+# --------------------------------------------------------------------- #
+# pallas backward: dK, dV (one grid step per KV block, loop over Q blocks)
+# --------------------------------------------------------------------- #
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, scale, causal, block_q, block_k,
+):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    ks = k_ref[:].astype(jnp.float32)  # [BK, D]
+    vs = v_ref[:].astype(jnp.float32)
+    sq = q_ref.shape[0]
+    n_q = sq // block_q
+    lo = (ki * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        qs = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i * block_q, block_q), :]
+        delta = delta_ref[pl.ds(i * block_q, block_q), :]
+        s = (
+            jax.lax.dot_general(
+                qs, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        p = jnp.exp(s - lse)  # [BQ, BK]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, vs, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    d = q_ref.shape[-1]
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, n_q, body, (dk0, dv0))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+# --------------------------------------------------------------------- #
+# pallas_call wrappers
+# --------------------------------------------------------------------- #
+def _pick_blocks(s: int):
+    bq = min(512, s)
+    bk = min(512, s)
+    return bq, bk
+
+
+def _flash_fwd(q, k, v, causal, scale, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hq, sq, d = q.shape
+    hkv = k.shape[1]
+    group = hq // hkv
+    skv = k.shape[2]
+    bq, bk = _pick_blocks(sq)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, hq, sq // bq),
+        in_specs=[
+            pl.BlockSpec((None, None, bq, d), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((None, None, skv, d), lambda b_, h, i, g=group: (b_, h // g, 0, 0)),
+            pl.BlockSpec((None, None, skv, d), lambda b_, h, i, g=group: (b_, h // g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, bq, d), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((None, None, bq, 1), lambda b_, h, i: (b_, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, sq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _flash_bwd(q, k, v, out, lse, do, causal, scale, interpret):
+    from jax.experimental import pallas as pl
+
+    b, hq, sq, d = q.shape
+    hkv = k.shape[1]
+    group = hq // hkv
+    skv = k.shape[2]
+    bq, bk = _pick_blocks(sq)
+
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1, keepdims=True)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+        ),
+        grid=(b, hq, sq // bq),
+        in_specs=[
+            pl.BlockSpec((None, None, bq, d), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((None, None, skv, d), lambda b_, h, i, g=group: (b_, h // g, 0, 0)),
+            pl.BlockSpec((None, None, skv, d), lambda b_, h, i, g=group: (b_, h // g, 0, 0)),
+            pl.BlockSpec((None, None, bq, d), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((None, None, bq, 1), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((None, None, bq, 1), lambda b_, h, i: (b_, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, bq, d), lambda b_, h, i: (b_, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dK/dV are computed per Q-head then reduced over the GQA group
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+        ),
+        grid=(b, hq, skv // bk),
+        in_specs=[
+            pl.BlockSpec((None, None, sq, d), lambda b_, h, j: (b_, h, 0, 0)),
+            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, g=group: (b_, h // g, j, 0)),
+            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, g=group: (b_, h // g, j, 0)),
+            pl.BlockSpec((None, None, sq, d), lambda b_, h, j: (b_, h, 0, 0)),
+            pl.BlockSpec((None, None, sq, 1), lambda b_, h, j: (b_, h, 0, 0)),
+            pl.BlockSpec((None, None, sq, 1), lambda b_, h, j: (b_, h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, bk, d), lambda b_, h, j: (b_, h, j, 0)),
+            pl.BlockSpec((None, None, bk, d), lambda b_, h, j: (b_, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, skv, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, skv, d), q.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    if group > 1:
+        dk = dk.reshape(b, hkv, group, skv, d).sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(b, hkv, group, skv, d).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------- #
+# public op with custom VJP
+# --------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention(q, k, v, causal, scale, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, scale, interpret)
+    return out
+
+
+def _flash_attention_fwd(q, k, v, causal, scale, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, scale, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_attention_bwd(causal, scale, interpret, residuals, g):
+    q, k, v, out, lse = residuals
+    return _flash_bwd(q, k, v, out, lse, g, causal, scale, interpret)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    impl: Optional[str] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Dispatching attention op. q: [B, Hq, S, D]; k/v: [B, Hkv, S, D].
+
+    impl: "flash" | "reference" | None (auto: flash when shapes are
+    TPU-tileable, reference otherwise).
+    """
+    b, hq, sq, d = q.shape
+    skv = k.shape[2]
+    scale = sm_scale if sm_scale is not None else float(1.0 / np.sqrt(d))
+    bq, bk = _pick_blocks(sq)
+    # the flash kernels assume last-aligned self-attention (sq == skv) and
+    # block-divisible lengths; anything else must take the reference path
+    flash_ok = (
+        sq == skv and sq % bq == 0 and skv % bk == 0 and d % 128 == 0
+    )
+    if impl is None:
+        impl = "flash" if flash_ok else "reference"
+    elif impl == "flash" and not flash_ok:
+        raise ValueError(
+            f"flash attention requires sq == skv, sq % {bq} == 0 and "
+            f"d % 128 == 0; got q {q.shape}, k {k.shape}. "
+            "Use impl='reference' for these shapes."
+        )
+    if impl == "reference":
+        return reference_attention(q, k, v, causal=causal, sm_scale=scale)
+    if interpret is None:
+        interpret = _interpret_default()
+    return _flash_attention(q, k, v, causal, scale, interpret)

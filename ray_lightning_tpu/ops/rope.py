@@ -1,0 +1,28 @@
+"""Rotary position embeddings (the position encoding of the flagship decoder
+family). Precomputed angle tables; applied in fp32 then cast back, which XLA
+fuses into the surrounding matmuls."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_angles(seq_len: int, head_dim: int, theta: float = 500000.0, offset: int = 0):
+    """Return (cos, sin) tables of shape [seq_len, head_dim//2]."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    positions = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    angles = positions[:, None] * inv_freq[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate pairs. x: [..., seq, n_heads, head_dim]; cos/sin: [seq, hd//2]."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    # broadcast tables over batch and head axes
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)

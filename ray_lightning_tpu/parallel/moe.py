@@ -1,0 +1,100 @@
+"""Mixture-of-Experts FFN with expert parallelism (the 'ep' mesh axis).
+
+GSPMD formulation: capacity-bounded top-k routing with one-hot dispatch/
+combine einsums over an expert-sharded weight stack — XLA partitions the
+[tokens, experts, capacity] dispatch tensors into all-to-alls over the 'ep'
+axis (Switch-Transformer style). No scatter/gather, fully static shapes.
+
+The reference has no MoE (SURVEY §2c: EP absent); this is part of the
+framework's first-class parallelism surface.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def init_moe_params(
+    rng: jax.Array, dim: int, ffn_dim: int, n_experts: int, dtype=jnp.bfloat16,
+    n_layers: Optional[int] = None,
+) -> Dict[str, Any]:
+    ks = jax.random.split(rng, 4)
+    lead = (n_layers,) if n_layers else ()
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, lead + shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype)
+
+    return {
+        "router": dense(ks[0], (dim, n_experts), dim).astype(jnp.float32),
+        "w_gate": dense(ks[1], (n_experts, dim, ffn_dim), dim),
+        "w_up": dense(ks[2], (n_experts, dim, ffn_dim), dim),
+        "w_down": dense(ks[3], (n_experts, ffn_dim, dim), ffn_dim),
+    }
+
+
+def moe_param_specs(n_layers: Optional[int] = None) -> Dict[str, P]:
+    lead = (None,) if n_layers else ()
+    return {
+        "router": P(*lead, None, None),
+        "w_gate": P(*lead, "ep", "fsdp", "tp"),
+        "w_up": P(*lead, "ep", "fsdp", "tp"),
+        "w_down": P(*lead, "ep", "tp", "fsdp"),
+    }
+
+
+def moe_ffn(
+    params: Dict[str, Any],
+    x: jnp.ndarray,
+    top_k: int = 2,
+    capacity_factor: float = 1.5,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x: [B, S, D] -> (out [B, S, D], aux_loss scalar).
+
+    aux_loss is the standard load-balancing loss (mean gate fraction x mean
+    dispatch fraction x n_experts).
+    """
+    b, s, d = x.shape
+    e = params["router"].shape[-1]
+    t = b * s
+    xt = x.reshape(t, d)
+    capacity = max(1, int(capacity_factor * top_k * t / e))
+
+    logits = (xt.astype(jnp.float32) @ params["router"]).astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)  # [T, E]
+
+    # top-k selection as dense one-hots
+    top_vals, top_idx = jax.lax.top_k(gates, top_k)  # [T, K]
+    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    sel = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # [T, K, E]
+
+    # position of each (token, k) within its expert queue, capacity-bounded
+    # flatten expert choices in priority order (k-major so 1st choices win)
+    sel_k = jnp.transpose(sel, (1, 0, 2))  # [K, T, E]
+    flat = sel_k.reshape(top_k * t, e)
+    pos = jnp.cumsum(flat, axis=0) - flat  # slots used before each entry
+    keep = (pos < capacity) * flat  # [K*T, E]
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    # dispatch [K*T, E, C]
+    disp_flat = keep[..., None] * pos_oh
+    disp = disp_flat.reshape(top_k, t, e, capacity).sum(axis=0)  # [T, E, C]
+    weights = (sel * top_vals[..., None]).sum(axis=1)  # [T, E] gate weights
+    combine = disp * weights[:, :, None]  # [T, E, C]
+
+    # expert inputs [E, C, D] — the all-to-all happens here under GSPMD
+    expert_in = jnp.einsum("tec,td->ecd", disp, xt.astype(jnp.float32)).astype(x.dtype)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_gate"])) * jnp.einsum(
+        "ecd,edf->ecf", expert_in, params["w_up"]
+    )
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_down"])  # [E, C, D]
+    out = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
+
+    # load-balancing auxiliary loss
+    frac_tokens = jnp.mean(disp.sum(axis=-1), axis=0)  # [E] dispatch fraction
+    frac_gates = jnp.mean(gates, axis=0)  # [E]
+    aux = e * jnp.sum(frac_tokens * frac_gates) / top_k
+
+    return out.reshape(b, s, d).astype(x.dtype), aux

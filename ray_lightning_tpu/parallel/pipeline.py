@@ -1,0 +1,109 @@
+"""Pipeline parallelism over the 'pp' mesh axis: GPipe-style microbatch
+schedule with activations moved between stages by ``ppermute`` over ICI.
+
+The reference has no pipeline parallelism (SURVEY §2c: PP absent). Design:
+- stage parameters are STACKED on a leading axis sharded over 'pp' (one
+  stage per pp-device inside shard_map);
+- the schedule runs P + M - 1 ticks; at tick t, stage s processes
+  microbatch t - s (inactive stages compute on zeros — SPMD requires every
+  device to execute the same program);
+- activations flow stage s -> s+1 through a single ppermute per tick;
+- autodiff: the whole schedule is differentiable JAX; the transpose of
+  ppermute is the reverse rotation, so the backward pass is the reverse
+  pipeline (1F1B-style interleaving is a later optimization).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    x: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "pp",
+    num_microbatches: int = 2,
+) -> jnp.ndarray:
+    """Run ``stage_fn`` sequentially across the 'pp' stages.
+
+    stage_params: pytree with leading axis == mesh.shape[axis] (one slice
+    per stage). x: [B, ...] global batch, B divisible by num_microbatches.
+    Returns the final stage's output for the full batch, replicated.
+    """
+    pp = mesh.shape[axis]
+    m = num_microbatches
+    b = x.shape[0]
+    assert b % m == 0, "batch must divide into microbatches"
+    mb = b // m
+
+    # data/batch specs: everything replicated except stage params
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(param_spec, P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+    def _pipe(params_local, x_full):
+        stage = jax.lax.axis_index(axis)
+        # local params have leading dim 1 (one stage per device)
+        params_here = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        micro = x_full.reshape(m, mb, *x_full.shape[1:])
+        perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(t, carry):
+            recv, outputs = carry
+            mb_idx = t - stage
+            active = (mb_idx >= 0) & (mb_idx < m)
+            # stage 0 reads its microbatch; later stages read what arrived
+            inp = jnp.where(
+                stage == 0,
+                micro[jnp.clip(mb_idx, 0, m - 1)],
+                recv,
+            )
+            out = stage_fn(params_here, inp)
+            out = jnp.where(active, out, jnp.zeros_like(out))
+            # the last stage records finished microbatches
+            done_idx = jnp.clip(mb_idx, 0, m - 1)
+            record = active & (stage == pp - 1)
+            outputs = jax.lax.dynamic_update_slice(
+                outputs,
+                jnp.where(record, out, jax.lax.dynamic_slice(
+                    outputs, (done_idx, *([0] * (outputs.ndim - 1))),
+                    (1, *outputs.shape[1:]))[0])[None],
+                (done_idx, *([0] * (outputs.ndim - 1))),
+            )
+            # pass activations forward around the ring
+            recv = jax.lax.ppermute(out, axis, perm_fwd)
+            return recv, outputs
+
+        recv0 = jnp.zeros((mb, *x_full.shape[1:]), x_full.dtype)
+        out_shape = jax.eval_shape(stage_fn, params_here, recv0)
+        outputs0 = jnp.zeros((m, *out_shape.shape), out_shape.dtype)
+        _, outputs = jax.lax.fori_loop(0, pp + m - 1, tick, (recv0, outputs0))
+        # only the last stage holds real outputs; broadcast around the ring
+        outputs = jax.lax.psum(
+            jnp.where(stage == pp - 1, outputs, jnp.zeros_like(outputs)), axis
+        )
+        return outputs.reshape(m * mb, *out_shape.shape[1:])
+
+    return _pipe(stage_params, x)
+
+
+def sequential_reference(stage_fn, stage_params, x):
+    """Same math without the mesh (for tests)."""
+    pp = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    out = x
+    for s in range(pp):
+        params_s = jax.tree_util.tree_map(lambda p: p[s], stage_params)
+        out = stage_fn(params_s, out)
+    return out

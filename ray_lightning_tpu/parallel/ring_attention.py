@@ -1,0 +1,142 @@
+"""Ring attention: exact causal attention over a sequence sharded across a
+mesh axis, with KV blocks rotated around the ring via ``ppermute`` over ICI.
+
+This is the long-context subsystem the reference entirely lacks (SURVEY §5
+"Long-context: entirely absent"): sequence length scales linearly with the
+number of chips on the 'sp' axis while memory per chip stays O(S/sp).
+
+Algorithm (blockwise, numerically exact):
+- every device holds local q, k, v of shape [B, H, S_local, D];
+- sp steps: at step t each device attends its q against the kv block that
+  originated on device (my_index - t) mod sp, then passes its current kv
+  block to the next device in the ring;
+- per-block partial outputs carry (out, logsumexp); partials merge with the
+  standard streaming-softmax combine, so the result equals monolithic
+  causal attention over the full sequence;
+- causality at block granularity: origin > my_index contributes nothing,
+  origin == my_index is causal, origin < my_index is full attention. The
+  ppermute is unconditional, so every device participates in every
+  collective (SPMD-safe).
+
+Autodiff: the whole function is differentiable JAX (ppermute transposes to
+the reverse rotation), so the backward pass is itself a ring program.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_lightning_tpu.ops.attention import reference_attention
+
+
+def _block_attention(q, k, v, mode, scale):
+    """Partial attention of grouped q against one kv block.
+
+    q: [B, Hkv, G, Sq, D] (G = GQA group); k, v: [B, Hkv, Sk, D] — kv heads
+    broadcast over the group inside the einsum, so GQA costs no copies and
+    the ring only moves true-KV-sized blocks.
+    mode: 0=skip, 1=causal (same-origin block), 2=full (earlier block).
+    Returns (out [B,Hkv,G,Sq,D] normalized within block, lse [...,Sq,1]).
+    """
+    logits = (
+        jnp.einsum("bhgqd,bhkd->bhgqk", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+    sq, sk = q.shape[3], k.shape[2]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    causal_mask = rows >= cols
+    neg = jnp.float32(-1e30)
+    logits = jnp.where(
+        (mode == 2) | ((mode == 1) & causal_mask[None, None, None]), logits, neg
+    )
+    lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)  # [B,Hkv,G,Sq,1]
+    probs = jnp.exp(logits - lse)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, v.astype(jnp.float32))
+    return out, lse
+
+
+def _merge(o1, l1, o2, l2):
+    """Streaming-softmax merge of two normalized partials with lses."""
+    m = jnp.maximum(l1, l2)
+    w1 = jnp.exp(l1 - m)
+    w2 = jnp.exp(l2 - m)
+    denom = w1 + w2
+    out = (o1 * w1 + o2 * w2) / denom
+    return out, m + jnp.log(denom)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """q/k/v: [B, H, S, D] GLOBAL shapes, sequence sharded over ``axis``
+    (and batch over dp/fsdp if present). Returns [B, H, S, D] with the same
+    sharding.
+    """
+    if not causal:
+        raise NotImplementedError("ring attention currently implements causal LM")
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else float(1.0 / (d**0.5))
+    sp = mesh.shape[axis]
+
+    def batch_entry():
+        names = [a for a in ("dp", "fsdp") if a in mesh.axis_names]
+        return tuple(names) if names else None
+
+    spec = P(batch_entry(), None, axis, None)
+
+    # GQA without copies: fold q heads into [B, Hkv, G, S, D]; kv blocks
+    # ride the ring at true KV size (group broadcast happens in-einsum)
+    hq, hkv = q.shape[1], k.shape[1]
+    group = hq // hkv
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    def _ring(q_loc, k_loc, v_loc):
+        my = jax.lax.axis_index(axis)
+        b_, _, sl, d_ = q_loc.shape
+        qf = q_loc.astype(jnp.float32).reshape(b_, hkv, group, sl, d_)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        def step(t, carry):
+            out, lse, kb, vb = carry
+            origin = (my - t) % sp
+            mode = jnp.where(origin > my, 0, jnp.where(origin == my, 1, 2))
+            o_new, l_new = _block_attention(
+                qf, kb.astype(jnp.float32), vb.astype(jnp.float32), mode, scale
+            )
+            # a skipped block must not perturb the merge: force its weight
+            # to zero via lse = -inf
+            l_new = jnp.where(mode == 0, jnp.float32(-1e30), l_new)
+            out, lse = _merge(out, lse, o_new, l_new)
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+            return out, lse, kb, vb
+
+        out0 = jnp.zeros(qf.shape, jnp.float32)
+        lse0 = jnp.full((*qf.shape[:-1], 1), -1e30, jnp.float32)
+        out, lse, _, _ = jax.lax.fori_loop(0, sp, step, (out0, lse0, k_loc, v_loc))
+        return out.reshape(q_loc.shape).astype(q_loc.dtype)
+
+    return _ring(q, k, v)
+
+
+def ring_attention_single_device(q, k, v, causal=True, sm_scale=None):
+    """Mesh-free reference of the same math (for tests)."""
+    return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)

@@ -131,14 +131,26 @@ class Strategy:
         return replicated_sharding(self.mesh)
 
     def param_shardings(self, params: Any) -> Any:
+        # a module may own its sharding layout (e.g. the llama family's
+        # megatron tp + fsdp rules); otherwise apply the generic policy
+        module_fn = getattr(self._module, "param_shardings", None)
+        if callable(module_fn):
+            sh = module_fn(self.mesh)
+            if sh is not None:
+                self._optstate_rule = None  # propagate from params via XLA
+                return sh
         sh, self._optstate_rule = infer_param_shardings(
             self.mesh, params, self.sharding_policy
         )
         return sh
 
-    def optstate_shardings(self, opt_state: Any) -> Any:
+    def optstate_shardings(self, opt_state: Any) -> Optional[Any]:
+        """None means: let XLA propagate optimizer-state shardings from the
+        (already-sharded) params through ``tx.init``."""
         if not hasattr(self, "_optstate_rule"):
             raise RuntimeError("call param_shardings first")
+        if self._optstate_rule is None:
+            return None
         return self._optstate_rule(opt_state)
 
     def place_params(self, params: Any) -> Any:
@@ -150,6 +162,8 @@ class Strategy:
 
     def place_optstate(self, opt_state: Any) -> Any:
         shardings = self.optstate_shardings(opt_state)
+        if shardings is None:
+            return jax.device_put(opt_state)
         return jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, s), opt_state, shardings
         )
@@ -166,9 +180,25 @@ class Strategy:
         """
         sharding = self.batch_sharding
         multiproc = jax.process_count() > 1
+        n_shards = 1
+        for entry in sharding.spec:
+            for a in (entry if isinstance(entry, tuple) else (entry,)):
+                if a is not None:
+                    n_shards *= self.mesh.shape[a]
+
+        # each process only needs its local slice divisible by its
+        # addressable shards; the sampler already split the global batch
+        local_shards = max(1, n_shards // jax.process_count()) if multiproc else n_shards
 
         def put(x):
             x = np.asarray(x)
+            if x.ndim and local_shards > 1 and x.shape[0] % local_shards:
+                raise ValueError(
+                    f"per-process batch size {x.shape[0]} is not divisible by "
+                    f"the {local_shards} local data-parallel shards of mesh "
+                    f"{dict(self.mesh.shape)}; pick batch_size as a multiple "
+                    f"of {local_shards}"
+                )
             if multiproc:
                 return jax.make_array_from_process_local_data(sharding, x)
             return jax.device_put(x, sharding)

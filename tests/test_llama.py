@@ -1,0 +1,109 @@
+"""Flagship llama family: training across mesh layouts, sharding specs,
+checkpoint round-trip, graft entry contract."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_lightning_tpu as rlt
+from ray_lightning_tpu.models.llama import (
+    LlamaConfig,
+    LlamaModule,
+    SyntheticLMDataModule,
+    forward,
+    init_params,
+    shardings_for_mesh,
+)
+from ray_lightning_tpu.parallel.mesh import MeshSpec, build_mesh
+from ray_lightning_tpu.parallel.sharding import ShardingPolicy
+
+from tests.utils import get_trainer
+
+
+def test_forward_shapes():
+    cfg = LlamaConfig.tiny()
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((2, cfg.max_seq), jnp.int32)
+    logits = jax.jit(lambda p, t: forward(p, t, cfg))(params, tokens)
+    assert logits.shape == (2, cfg.max_seq, cfg.vocab_size)
+
+
+def test_param_count_formula():
+    cfg = LlamaConfig.tiny()
+    params = init_params(jax.random.key(0), cfg)
+    actual = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+    assert actual == cfg.num_params()
+
+
+def test_tp_shardings_cover_all_leaves():
+    cfg = LlamaConfig.tiny()
+    mesh = build_mesh(MeshSpec(axes={"fsdp": 2, "tp": 4}))
+    params = init_params(jax.random.key(0), cfg)
+    shardings = shardings_for_mesh(cfg, mesh)
+    jax.tree_util.tree_map(lambda p, s: None, params, shardings)  # structure match
+    assert "tp" in str(shardings["layers"]["wq"].spec)
+
+
+def test_train_loss_decreases_dp(tmp_root):
+    cfg = LlamaConfig.tiny()
+    module = LlamaModule(cfg, lr=3e-3, warmup_steps=5, total_steps=200)
+    dm = SyntheticLMDataModule(cfg, batch_size=8, n_train=128)
+    trainer = get_trainer(tmp_root, max_epochs=2, limit_train_batches=None,
+                          checkpoint_callback=False)
+    trainer.fit(module, datamodule=dm)
+    first_loss = float(np.log(cfg.vocab_size))  # ~uniform init loss
+    final = float(trainer.callback_metrics["val_loss"])
+    assert final < first_loss * 0.7, f"loss {final} did not drop below {first_loss}"
+
+
+def test_train_tp_fsdp_mesh(tmp_root):
+    cfg = LlamaConfig.tiny()
+    strategy = rlt.XLAStrategy(
+        mesh_spec=MeshSpec(axes={"dp": 2, "fsdp": 2, "tp": 2}),
+        sharding_policy=ShardingPolicy(zero_stage=3, data_axes=("dp", "fsdp")),
+    )
+    module = LlamaModule(cfg, lr=3e-3, warmup_steps=2, total_steps=50)
+    dm = SyntheticLMDataModule(cfg, batch_size=8, n_train=32)
+    trainer = get_trainer(tmp_root, max_epochs=1, strategy=strategy,
+                          limit_train_batches=None, checkpoint_callback=False)
+    trainer.fit(module, datamodule=dm)
+    spec = trainer.params["layers"]["wq"].sharding.spec
+    assert "tp" in str(spec) and "fsdp" in str(spec)
+
+
+def test_train_ring_attention_mesh(tmp_root):
+    cfg = LlamaConfig.tiny()
+    strategy = rlt.XLAStrategy(
+        mesh_spec=MeshSpec(axes={"dp": 2, "sp": 4}),
+        sharding_policy=ShardingPolicy(data_axes=("dp",)),
+    )
+    module = LlamaModule(cfg, lr=3e-3, warmup_steps=2, total_steps=50)
+    dm = SyntheticLMDataModule(cfg, batch_size=4, n_train=16)
+    trainer = get_trainer(tmp_root, max_epochs=1, strategy=strategy,
+                          limit_train_batches=None, checkpoint_callback=False)
+    trainer.fit(module, datamodule=dm)
+    assert "val_loss" in trainer.callback_metrics
+
+
+def test_llama_checkpoint_roundtrip(tmp_root):
+    cfg = LlamaConfig.tiny()
+    module = LlamaModule(cfg, lr=3e-3)
+    dm = SyntheticLMDataModule(cfg, batch_size=8, n_train=16)
+    trainer = get_trainer(tmp_root, max_epochs=1, limit_train_batches=None)
+    trainer.fit(module, datamodule=dm)
+    path = trainer.checkpoint_callback.best_model_path
+    assert path
+    reloaded = LlamaModule.load_from_checkpoint(path, config=cfg)
+    orig = jax.device_get(module.params)
+    back = reloaded.params
+    leaf_a = jax.tree_util.tree_leaves(orig)[0]
+    leaf_b = jax.tree_util.tree_leaves(back)[0]
+    assert np.allclose(np.asarray(leaf_a, np.float32), np.asarray(leaf_b, np.float32))
+
+
+def test_graft_entry_contract():
+    import __graft_entry__ as graft
+
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.ndim == 3

@@ -1,0 +1,89 @@
+"""Parallelism layer: ring attention, pipeline, MoE, mesh construction —
+all validated against mesh-free references on the 8-device CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from ray_lightning_tpu.ops.attention import reference_attention
+from ray_lightning_tpu.parallel.mesh import MeshSpec, build_mesh
+from ray_lightning_tpu.parallel.moe import init_moe_params, moe_ffn
+from ray_lightning_tpu.parallel.pipeline import pipeline_apply, sequential_reference
+from ray_lightning_tpu.parallel.ring_attention import ring_attention
+
+
+def test_mesh_spec_resolution():
+    assert MeshSpec(axes={"dp": -1}).resolved(8) == {"dp": 8}
+    assert MeshSpec(axes={"dp": 2, "tp": -1}).resolved(8) == {"dp": 2, "tp": 4}
+    with pytest.raises(ValueError):
+        MeshSpec(axes={"dp": 3}).resolved(8)
+
+
+def test_build_mesh_axes():
+    mesh = build_mesh(MeshSpec(axes={"dp": 2, "fsdp": 2, "tp": 2}))
+    assert mesh.axis_names == ("dp", "fsdp", "tp")
+    assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "tp": 2}
+
+
+def test_ring_attention_exact():
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "sp"))
+    b, h, s, d = 4, 4, 256, 64
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.float32)
+    k = jax.random.normal(kk, (b, h, s, d), jnp.float32)
+    v = jax.random.normal(kv, (b, h, s, d), jnp.float32)
+    ref = reference_attention(q, k, v, causal=True)
+    out = ring_attention(q, k, v, mesh=mesh, axis="sp")
+    assert float(jnp.max(jnp.abs(ref - out))) < 1e-5
+
+
+def test_ring_attention_grad_exact():
+    mesh = Mesh(np.array(jax.devices()).reshape(1, 8), ("dp", "sp"))
+    b, h, s, d = 2, 2, 128, 32
+    q = jax.random.normal(jax.random.key(0), (b, h, s, d), jnp.float32)
+    g_ref = jax.grad(lambda q: (reference_attention(q, q, q, causal=True) ** 2).sum())(q)
+    g_ring = jax.grad(lambda q: (ring_attention(q, q, q, mesh=mesh, axis="sp") ** 2).sum())(q)
+    assert float(jnp.max(jnp.abs(g_ref - g_ring))) < 1e-4
+
+
+def test_pipeline_matches_sequential():
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("pp", "dp"))
+    w = jax.random.normal(jax.random.key(2), (4, 32, 32), jnp.float32) * 0.3
+
+    def stage(wi, h):
+        return jnp.tanh(h @ wi)
+
+    x = jax.random.normal(jax.random.key(3), (8, 32), jnp.float32)
+    ref = sequential_reference(stage, w, x)
+    out = pipeline_apply(stage, w, x, mesh=mesh, num_microbatches=4)
+    assert float(jnp.max(jnp.abs(ref - out))) < 1e-6
+    g_ref = jax.grad(lambda w: (sequential_reference(stage, w, x) ** 2).sum())(w)
+    g_pipe = jax.grad(
+        lambda w: (pipeline_apply(stage, w, x, mesh=mesh, num_microbatches=4) ** 2).sum()
+    )(w)
+    assert float(jnp.max(jnp.abs(g_ref - g_pipe))) < 1e-4
+
+
+def test_moe_routing_and_grads():
+    p = init_moe_params(jax.random.key(0), dim=32, ffn_dim=64, n_experts=4,
+                        dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(1), (2, 16, 32), jnp.float32)
+    out, aux = moe_ffn(p, x, top_k=2, capacity_factor=8.0)
+    assert out.shape == x.shape
+    assert float(aux) > 0.0
+    grads = jax.grad(lambda p: moe_ffn(p, x, 2, 8.0)[0].sum())(p)
+    assert float(jnp.linalg.norm(grads["router"])) > 0.0
+
+
+def test_moe_capacity_drops_tokens():
+    """With capacity 1 slot per expert most tokens are dropped (out≈0 for
+    them) — the capacity mechanism actually binds."""
+    p = init_moe_params(jax.random.key(0), dim=32, ffn_dim=64, n_experts=2,
+                        dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(1), (1, 64, 32), jnp.float32)
+    out_small, _ = moe_ffn(p, x, top_k=1, capacity_factor=0.05)
+    out_big, _ = moe_ffn(p, x, top_k=1, capacity_factor=8.0)
+    zero_rows_small = int(jnp.sum(jnp.all(out_small == 0, axis=-1)))
+    zero_rows_big = int(jnp.sum(jnp.all(out_big == 0, axis=-1)))
+    assert zero_rows_small > zero_rows_big
