@@ -1,0 +1,84 @@
+"""MNIST data-parallel training over worker actors, with an optional tune
+sweep — the reference's flagship example re-done TPU-native
+(role parity: ray_lightning/examples/ray_ddp_example.py).
+
+Usage:
+  python examples/ray_ddp_example.py --num-workers 2 --smoke-test
+  python examples/ray_ddp_example.py --tune --num-samples 4 --smoke-test
+"""
+from __future__ import annotations
+
+import argparse
+
+
+def train_mnist(config: dict, num_workers: int = 2, use_tune: bool = False,
+                max_epochs: int = 4, platform: str | None = "cpu"):
+    import ray_lightning_tpu as rlt
+    from ray_lightning_tpu.models.mnist import MNISTClassifier, MNISTDataModule
+
+    callbacks = []
+    if use_tune:
+        from ray_lightning_tpu.tune import TuneReportCallback
+
+        callbacks.append(
+            TuneReportCallback(
+                {"loss": "ptl/val_loss", "acc": "ptl/val_accuracy"},
+                on="validation_end",
+            )
+        )
+
+    model = MNISTClassifier(config)
+    dm = MNISTDataModule(batch_size=config.get("batch_size", 32))
+    trainer = rlt.Trainer(
+        max_epochs=max_epochs,
+        callbacks=callbacks,
+        strategy=rlt.RayStrategy(
+            num_workers=num_workers,
+            num_cpus_per_worker=1,
+            platform=platform,
+            devices_per_worker=2,
+        ),
+        enable_progress_bar=not use_tune,
+        logger=False,
+    )
+    trainer.fit(model, datamodule=dm)
+    return trainer
+
+
+def tune_mnist(num_workers: int, num_samples: int, max_epochs: int):
+    from ray_lightning_tpu import tune
+
+    config = {
+        "layer_1": tune.choice([32, 64, 128]),
+        "layer_2": tune.choice([64, 128, 256]),
+        "lr": tune.loguniform(1e-4, 1e-1),
+        "batch_size": tune.choice([32, 64]),
+    }
+    analysis = tune.run(
+        lambda cfg: train_mnist(cfg, num_workers=num_workers, use_tune=True,
+                                max_epochs=max_epochs),
+        config=config,
+        num_samples=num_samples,
+        metric="loss",
+        mode="min",
+        name="tune_mnist",
+        resources_per_trial=tune.get_tune_resources(num_workers=num_workers),
+        trial_env={"JAX_PLATFORMS": "cpu"},
+    )
+    print("Best hyperparameters found were:", analysis.best_config)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=2)
+    parser.add_argument("--tune", action="store_true")
+    parser.add_argument("--num-samples", type=int, default=2)
+    parser.add_argument("--smoke-test", action="store_true")
+    args = parser.parse_args()
+    epochs = 1 if args.smoke_test else 4
+
+    if args.tune:
+        tune_mnist(args.num_workers, args.num_samples, epochs)
+    else:
+        trainer = train_mnist({"lr": 1e-2}, args.num_workers, max_epochs=epochs)
+        print("metrics:", {k: float(v) for k, v in trainer.callback_metrics.items()})

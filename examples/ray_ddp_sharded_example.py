@@ -1,0 +1,46 @@
+"""Large-model sharded training with throughput/MFU measurement (role
+parity: ray_lightning/examples/ray_ddp_sharded_example.py, whose CUDACallback
+measured epoch time + peak memory; here the first-class ThroughputMonitor
+reports step time, tokens/sec/chip and MFU)."""
+from __future__ import annotations
+
+import argparse
+
+import ray_lightning_tpu as rlt
+from ray_lightning_tpu.callbacks import ThroughputMonitor
+from ray_lightning_tpu.models.llama import (
+    LlamaConfig,
+    LlamaModule,
+    SyntheticLMDataModule,
+)
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=1)
+    parser.add_argument("--smoke-test", action="store_true")
+    parser.add_argument("--zero-stage", type=int, default=3)
+    args = parser.parse_args()
+
+    cfg = LlamaConfig.tiny() if args.smoke_test else LlamaConfig.mini()
+    model = LlamaModule(cfg, lr=3e-4)
+    monitor = ThroughputMonitor(
+        flops_per_sample=cfg.flops_per_token() * cfg.max_seq,
+        tokens_per_sample=cfg.max_seq,
+    )
+    trainer = rlt.Trainer(
+        max_epochs=1,
+        strategy=rlt.RayShardedStrategy(
+            num_workers=args.num_workers,
+            platform="cpu",
+            devices_per_worker=4,
+            zero_stage=args.zero_stage,
+        ),
+        callbacks=[monitor],
+        logger=False,
+        enable_progress_bar=True,
+    )
+    dm = SyntheticLMDataModule(cfg, batch_size=8, n_train=64)
+    trainer.fit(model, datamodule=dm)
+    perf = {k: float(v) for k, v in trainer.callback_metrics.items()
+            if k in ("step_time_s", "samples_per_sec", "tokens_per_sec_per_chip", "mfu")}
+    print("perf:", perf)

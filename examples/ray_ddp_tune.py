@@ -1,0 +1,61 @@
+"""Tune sweep with a worker init hook (role parity:
+ray_lightning/examples/ray_ddp_tune.py — the reference uses the init_hook
+for a FileLock'd dataset download; here it pre-warms worker-local state)."""
+from __future__ import annotations
+
+import argparse
+
+
+def init_hook():
+    # runs once in every worker actor before training (e.g. dataset
+    # download, cache warmup)
+    import os
+
+    os.environ.setdefault("RLT_EXAMPLE_HOOK_RAN", "1")
+
+
+def train_mnist(config):
+    import ray_lightning_tpu as rlt
+    from ray_lightning_tpu.models.mnist import MNISTClassifier, MNISTDataModule
+    from ray_lightning_tpu.tune import TuneReportCallback
+
+    model = MNISTClassifier(config)
+    dm = MNISTDataModule(batch_size=config.get("batch_size", 32))
+    trainer = rlt.Trainer(
+        max_epochs=config.get("max_epochs", 2),
+        callbacks=[
+            TuneReportCallback(
+                {"loss": "ptl/val_loss", "acc": "ptl/val_accuracy"},
+                on="validation_end",
+            )
+        ],
+        strategy=rlt.RayStrategy(
+            num_workers=1, platform="cpu", devices_per_worker=2,
+            init_hook=init_hook,
+        ),
+        logger=False,
+    )
+    trainer.fit(model, datamodule=dm)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-samples", type=int, default=2)
+    parser.add_argument("--smoke-test", action="store_true")
+    args = parser.parse_args()
+
+    from ray_lightning_tpu import tune
+
+    analysis = tune.run(
+        train_mnist,
+        config={
+            "lr": tune.loguniform(1e-3, 1e-1),
+            "max_epochs": 1 if args.smoke_test else 3,
+        },
+        num_samples=args.num_samples,
+        metric="loss",
+        mode="min",
+        name="ray_ddp_tune",
+        trial_env={"JAX_PLATFORMS": "cpu"},
+    )
+    print("Best config:", analysis.best_config)

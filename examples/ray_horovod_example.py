@@ -1,0 +1,29 @@
+"""MNIST with the ring-allreduce-named strategy (role parity:
+ray_lightning/examples/ray_horovod_example.py). On TPU the "ring" is the ICI
+torus and XLA's compiled all-reduce already rides it, so this strategy
+shares RayStrategy's engine under the Horovod name."""
+from __future__ import annotations
+
+import argparse
+
+import ray_lightning_tpu as rlt
+from ray_lightning_tpu.models.mnist import MNISTClassifier, MNISTDataModule
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=2)
+    parser.add_argument("--smoke-test", action="store_true")
+    args = parser.parse_args()
+
+    model = MNISTClassifier({"lr": 1e-2})
+    dm = MNISTDataModule(batch_size=32)
+    trainer = rlt.Trainer(
+        max_epochs=1 if args.smoke_test else 4,
+        strategy=rlt.HorovodRayStrategy(
+            num_workers=args.num_workers, platform="cpu", devices_per_worker=2
+        ),
+        logger=False,
+        enable_progress_bar=True,
+    )
+    trainer.fit(model, datamodule=dm)
+    print("metrics:", {k: float(v) for k, v in trainer.callback_metrics.items()})

@@ -376,12 +376,26 @@ class Trainer:
                 module._capture_begin("train", rng)
                 out = module.training_step(p, batch, step)
                 logs = module._capture_end()
-                loss = out["loss"] if isinstance(out, dict) else out
-                return loss, logs
+                if isinstance(out, dict):
+                    loss = out["loss"]
+                    mutated = out.get("mutated_params")
+                else:
+                    loss, mutated = out, None
+                return loss, (logs, mutated)
 
-            (loss, logs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            (loss, (logs, mutated)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
             updates, new_opt_state = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
+            if mutated is not None and isinstance(new_params, dict):
+                # non-differentiable collections (e.g. flax batch_stats)
+                # take their forward-pass-mutated values, not the
+                # optimizer's no-op update
+                new_params = {
+                    k: (mutated[k] if (k != "params" and k in mutated) else v)
+                    for k, v in new_params.items()
+                }
             logs = dict(logs)
             logs.setdefault("loss", loss)
             return new_params, new_opt_state, logs

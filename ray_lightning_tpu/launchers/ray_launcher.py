@@ -31,7 +31,7 @@ from ray_lightning_tpu.callbacks.base import (
 from ray_lightning_tpu.launchers.utils import RayExecutor, WorkerOutput
 from ray_lightning_tpu.session import init_session, reset_session
 from ray_lightning_tpu.utils.common import rank_zero_info
-from ray_lightning_tpu.utils.seed import GLOBAL_SEED_ENV
+from ray_lightning_tpu.utils.seed import GLOBAL_SEED_ENV, seed_everything
 from ray_lightning_tpu.utils.serialization import load_state_stream, to_state_stream
 
 
@@ -124,6 +124,12 @@ class RayLauncher:
     def launch(self, function, *args, trainer=None) -> Any:
         if not rt.is_initialized():
             rt.init()
+        # Pin the global seed on the driver BEFORE spawning so every worker
+        # initializes identical parameters (SPMD requires bitwise-equal
+        # replicated values across processes). seed_everything records it in
+        # the env that setup_workers propagates (the reference's
+        # PL_GLOBAL_SEED flow, ray_launcher.py:159-175).
+        seed_everything(trainer.seed if trainer is not None else None)
         self.setup_workers()
         try:
             output = self.run_function_on_workers(function, *args, trainer=trainer)

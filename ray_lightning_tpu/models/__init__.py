@@ -1,3 +1,25 @@
 from ray_lightning_tpu.models.mnist import MNISTClassifier, MNISTDataModule
+from ray_lightning_tpu.models.resnet import ResNetClassifier, CIFARDataModule
+from ray_lightning_tpu.models.bert import (
+    BertClassifier,
+    BertConfig,
+    TextClassificationDataModule,
+)
+from ray_lightning_tpu.models.llama import (
+    LlamaConfig,
+    LlamaModule,
+    SyntheticLMDataModule,
+)
 
-__all__ = ["MNISTClassifier", "MNISTDataModule"]
+__all__ = [
+    "MNISTClassifier",
+    "MNISTDataModule",
+    "ResNetClassifier",
+    "CIFARDataModule",
+    "BertClassifier",
+    "BertConfig",
+    "TextClassificationDataModule",
+    "LlamaConfig",
+    "LlamaModule",
+    "SyntheticLMDataModule",
+]

@@ -1,0 +1,189 @@
+"""BERT-style encoder family (BASELINE config 3: BERT-base fine-tune under
+the sharded strategy). Bidirectional attention through the same dispatching
+attention op as the flagship (non-causal path), bf16 matmuls with fp32
+layer-norm, flax module + LightningModule fine-tune/MLM heads.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_lightning_tpu.core.data import DataLoader, DictDataset
+from ray_lightning_tpu.core.datamodule import LightningDataModule
+from ray_lightning_tpu.core.module import LightningModule
+from ray_lightning_tpu.ops.attention import attention
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    max_seq: int = 512
+    type_vocab: int = 2
+    dropout: float = 0.1
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def tiny() -> "BertConfig":
+        return BertConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                          ffn_dim=128, max_seq=64)
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+
+class _Encoder(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, deterministic=True):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        tok = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype)(input_ids)
+        pos = nn.Embed(cfg.max_seq, cfg.dim, dtype=cfg.dtype)(
+            jnp.arange(s)[None, :].repeat(b, axis=0)
+        )
+        x = nn.LayerNorm(dtype=jnp.float32)(tok + pos)
+        hd = cfg.dim // cfg.n_heads
+        for _ in range(cfg.n_layers):
+            h = nn.LayerNorm(dtype=jnp.float32)(x).astype(cfg.dtype)
+            q = nn.Dense(cfg.dim, dtype=cfg.dtype)(h).reshape(b, s, cfg.n_heads, hd)
+            k = nn.Dense(cfg.dim, dtype=cfg.dtype)(h).reshape(b, s, cfg.n_heads, hd)
+            v = nn.Dense(cfg.dim, dtype=cfg.dtype)(h).reshape(b, s, cfg.n_heads, hd)
+            att = attention(
+                q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2), causal=False
+            )
+            att = att.swapaxes(1, 2).reshape(b, s, cfg.dim)
+            att = nn.Dense(cfg.dim, dtype=cfg.dtype)(att)
+            att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
+            x = x + att
+            h2 = nn.LayerNorm(dtype=jnp.float32)(x).astype(cfg.dtype)
+            y = nn.Dense(cfg.ffn_dim, dtype=cfg.dtype)(h2)
+            y = nn.gelu(y)
+            y = nn.Dense(cfg.dim, dtype=cfg.dtype)(y)
+            y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+            x = x + y
+        return nn.LayerNorm(dtype=jnp.float32)(x)
+
+
+class BertClassifier(LightningModule):
+    """Sequence-classification fine-tune head over the encoder's [CLS]."""
+
+    def __init__(self, config: Optional[BertConfig] = None, num_classes: int = 2,
+                 lr: float = 2e-5, weight_decay: float = 0.01):
+        super().__init__()
+        if isinstance(config, dict):  # rebuilt from checkpoint hparams
+            d = dict(config)
+            if isinstance(d.get("dtype"), str):
+                d["dtype"] = jnp.dtype(d["dtype"]).type
+            config = BertConfig(**d)
+        self.config = config or BertConfig.tiny()
+        self.num_classes = num_classes
+        self.lr = lr
+        self.weight_decay = weight_decay
+        import dataclasses
+
+        cfg_dict = dataclasses.asdict(self.config)
+        cfg_dict["dtype"] = jnp.dtype(self.config.dtype).name
+        self.hparams.update(config=cfg_dict, num_classes=num_classes, lr=lr,
+                            weight_decay=weight_decay)
+        self.encoder = _Encoder(self.config)
+        self.head = nn.Dense(num_classes, dtype=jnp.float32)
+
+    def init_params(self, rng):
+        r1, r2 = jax.random.split(rng)
+        dummy = jnp.zeros((1, self.config.max_seq), jnp.int32)
+        enc = self.encoder.init(r1, dummy)
+        head = self.head.init(r2, jnp.zeros((1, self.config.dim), jnp.float32))
+        return {"encoder": enc, "head": head}
+
+    def _logits(self, params, input_ids, deterministic=True, rngs=None):
+        hidden = self.encoder.apply(
+            params["encoder"], input_ids, deterministic=deterministic, rngs=rngs
+        )
+        cls = hidden[:, 0].astype(jnp.float32)
+        return self.head.apply(params["head"], cls)
+
+    def training_step(self, params, batch, batch_idx):
+        logits = self._logits(
+            params, batch["input_ids"], deterministic=False,
+            rngs={"dropout": self.step_rng},
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+        self.log("train_loss", loss)
+        self.log("train_acc", acc)
+        return loss
+
+    def validation_step(self, params, batch, batch_idx):
+        logits = self._logits(params, batch["input_ids"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+        self.log("val_loss", loss)
+        self.log("val_acc", jnp.mean(jnp.argmax(logits, -1) == batch["label"]))
+
+    def predict_step(self, params, batch, batch_idx):
+        ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        return jnp.argmax(self._logits(params, ids), -1)
+
+    def configure_optimizers(self):
+        return optax.adamw(self.lr, weight_decay=self.weight_decay)
+
+
+def synthetic_text_classification(cfg: BertConfig, n: int, seed: int = 0,
+                                  num_classes: int = 2):
+    """Label-dependent token distributions (hermetic GLUE stand-in)."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, n)
+    ids = rng.integers(4, cfg.vocab_size, (n, cfg.max_seq))
+    for i, lab in enumerate(labels):
+        marks = rng.integers(1, cfg.max_seq, cfg.max_seq // 4)
+        ids[i, marks] = 4 + lab  # class-marker tokens
+    ids[:, 0] = 1  # [CLS]
+    return {"input_ids": ids.astype(np.int32), "label": labels.astype(np.int32)}
+
+
+class TextClassificationDataModule(LightningDataModule):
+    def __init__(self, cfg: BertConfig, batch_size: int = 16, n_train: int = 256,
+                 n_val: int = 64, num_classes: int = 2):
+        super().__init__()
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.n_train = n_train
+        self.n_val = n_val
+        self.num_classes = num_classes
+
+    def setup(self, stage):
+        self.train_data = DictDataset(
+            **synthetic_text_classification(self.cfg, self.n_train, 0, self.num_classes)
+        )
+        self.val_data = DictDataset(
+            **synthetic_text_classification(self.cfg, self.n_val, 1, self.num_classes)
+        )
+        self.test_data = self.val_data
+
+    def train_dataloader(self):
+        return DataLoader(self.train_data, batch_size=self.batch_size, shuffle=True,
+                          drop_last=True)
+
+    def val_dataloader(self):
+        return DataLoader(self.val_data, batch_size=self.batch_size, drop_last=True)
+
+    def test_dataloader(self):
+        return self.val_dataloader()
+
+    def predict_dataloader(self):
+        return self.val_dataloader()

@@ -49,6 +49,20 @@ class LlamaConfig:
     def head_dim(self) -> int:
         return self.dim // self.n_heads
 
+    def to_dict(self) -> Dict[str, Any]:
+        import dataclasses
+
+        d = dataclasses.asdict(self)
+        d["dtype"] = jnp.dtype(self.dtype).name
+        return d
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "LlamaConfig":
+        d = dict(d)
+        if isinstance(d.get("dtype"), str):
+            d["dtype"] = jnp.dtype(d["dtype"]).type
+        return LlamaConfig(**d)
+
     def num_params(self) -> int:
         d, f, v = self.dim, self.ffn_dim, self.vocab_size
         per_layer = (
@@ -74,8 +88,9 @@ class LlamaConfig:
 
     @staticmethod
     def mini() -> "LlamaConfig":  # ~160M: the single-chip bench config
+        # head_dim 128 (dim/n_heads) so attention takes the pallas flash path
         return LlamaConfig(
-            vocab_size=32000, dim=768, n_layers=12, n_heads=12, n_kv_heads=12,
+            vocab_size=32000, dim=768, n_layers=12, n_heads=6, n_kv_heads=6,
             ffn_dim=2048, max_seq=1024,
         )
 
@@ -259,12 +274,15 @@ class LlamaModule(LightningModule):
                  warmup_steps: int = 100, total_steps: int = 10000,
                  weight_decay: float = 0.1):
         super().__init__()
+        if isinstance(config, dict):  # rebuilt from checkpoint hparams
+            config = LlamaConfig.from_dict(config)
         self.config = config or LlamaConfig.tiny()
         self.lr = lr
         self.warmup_steps = warmup_steps
         self.total_steps = total_steps
         self.weight_decay = weight_decay
         self.hparams.update(
+            config=self.config.to_dict(),
             lr=lr, warmup_steps=warmup_steps, total_steps=total_steps,
             weight_decay=weight_decay,
         )

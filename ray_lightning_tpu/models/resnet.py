@@ -1,0 +1,198 @@
+"""ResNet for CIFAR-scale images (BASELINE config 2: ResNet-50/CIFAR-10,
+8-worker DP). TPU-first choices: NHWC layout (XLA:TPU's native conv layout),
+bf16 compute with fp32 batch-norm statistics, and a flax module whose
+BatchNorm runs in inference-free "batch-stats-carried" mode folded into the
+functional step (mutable collections threaded through the pure step).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_lightning_tpu.core.data import DataLoader, DictDataset
+from ray_lightning_tpu.core.datamodule import LightningDataModule
+from ray_lightning_tpu.core.module import LightningModule
+
+
+class _BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides),
+                    use_bias=False, dtype=self.dtype)(x)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=jnp.float32)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), use_bias=False, dtype=self.dtype)(y)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=jnp.float32)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), (self.strides, self.strides),
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                    dtype=jnp.float32)(residual)
+        return nn.relu(y + residual)
+
+
+class _BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=jnp.float32)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides),
+                    use_bias=False, dtype=self.dtype)(y)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=jnp.float32)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=jnp.float32)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1),
+                               (self.strides, self.strides),
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                    dtype=jnp.float32)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)  # resnet18
+    bottleneck: bool = False
+    num_classes: int = 10
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        # CIFAR stem: 3x3, no max-pool (images are 32x32)
+        x = nn.Conv(self.width, (3, 3), use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        block = _BottleneckBlock if self.bottleneck else _BasicBlock
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if (i > 0 and j == 0) else 1
+                x = block(self.width * 2**i, strides, self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+_PRESETS = {
+    "resnet18": dict(stage_sizes=(2, 2, 2, 2), bottleneck=False),
+    "resnet34": dict(stage_sizes=(3, 4, 6, 3), bottleneck=False),
+    "resnet50": dict(stage_sizes=(3, 4, 6, 3), bottleneck=True),
+}
+
+
+class ResNetClassifier(LightningModule):
+    """CIFAR classifier with batch-norm state threaded through the pure
+    step (params pytree = {"params": ..., "batch_stats": ...})."""
+
+    def __init__(self, arch: str = "resnet18", num_classes: int = 10,
+                 lr: float = 0.1, momentum: float = 0.9,
+                 weight_decay: float = 5e-4, image_size: int = 32):
+        super().__init__()
+        self.save_hyperparameters()
+        self.model = ResNet(num_classes=num_classes, **_PRESETS[arch])
+        self.lr = lr
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.image_size = image_size
+
+    def init_params(self, rng):
+        dummy = jnp.zeros((1, self.image_size, self.image_size, 3), jnp.float32)
+        return self.model.init(rng, dummy, train=True)
+
+    def _apply_train(self, params, x):
+        out, updates = self.model.apply(
+            params, x, train=True, mutable=["batch_stats"]
+        )
+        new_params = {**params, "batch_stats": updates["batch_stats"]}
+        return out, new_params
+
+    def training_step(self, params, batch, batch_idx):
+        x, y = batch["image"], batch["label"]
+        logits, new_params = self._apply_train(params, x)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == y)
+        self.log("train_loss", loss)
+        self.log("train_acc", acc)
+        # batch-stats updates ride back as auxiliary state
+        return {"loss": loss, "mutated_params": new_params}
+
+    def validation_step(self, params, batch, batch_idx):
+        x, y = batch["image"], batch["label"]
+        logits = self.model.apply(params, x, train=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == y)
+        self.log("val_loss", loss)
+        self.log("val_acc", acc)
+
+    def test_step(self, params, batch, batch_idx):
+        x, y = batch["image"], batch["label"]
+        logits = self.model.apply(params, x, train=False)
+        self.log("test_acc", jnp.mean(jnp.argmax(logits, -1) == y))
+
+    def predict_step(self, params, batch, batch_idx):
+        x = batch["image"] if isinstance(batch, dict) else batch
+        return jnp.argmax(self.model.apply(params, x, train=False), -1)
+
+    def configure_optimizers(self):
+        return optax.chain(
+            optax.add_decayed_weights(
+                self.weight_decay,
+                mask=lambda p: jax.tree_util.tree_map(lambda x: x.ndim > 1, p),
+            ),
+            optax.sgd(self.lr, momentum=self.momentum, nesterov=True),
+        )
+
+
+def synthetic_cifar(n: int, size: int = 32, classes: int = 10, seed: int = 0):
+    """Class-signal-bearing random images (hermetic CIFAR stand-in)."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, n)
+    imgs = rng.standard_normal((n, size, size, 3)).astype(np.float32) * 0.3
+    for i, lab in enumerate(labels):
+        imgs[i, :, :, lab % 3] += 0.5 + 0.15 * lab
+    return {"image": imgs, "label": labels.astype(np.int32)}
+
+
+class CIFARDataModule(LightningDataModule):
+    def __init__(self, batch_size: int = 32, n_train: int = 512, n_val: int = 128,
+                 image_size: int = 32):
+        super().__init__()
+        self.batch_size = batch_size
+        self.n_train = n_train
+        self.n_val = n_val
+        self.image_size = image_size
+
+    def setup(self, stage):
+        self.train_data = DictDataset(**synthetic_cifar(self.n_train, self.image_size, seed=0))
+        self.val_data = DictDataset(**synthetic_cifar(self.n_val, self.image_size, seed=1))
+        self.test_data = DictDataset(**synthetic_cifar(self.n_val, self.image_size, seed=2))
+
+    def train_dataloader(self):
+        return DataLoader(self.train_data, batch_size=self.batch_size, shuffle=True,
+                          drop_last=True)
+
+    def val_dataloader(self):
+        return DataLoader(self.val_data, batch_size=self.batch_size, drop_last=True)
+
+    def test_dataloader(self):
+        return DataLoader(self.test_data, batch_size=self.batch_size, drop_last=True)
+
+    def predict_dataloader(self):
+        return self.test_dataloader()

@@ -354,10 +354,16 @@ def attention(
     skv = k.shape[2]
     scale = sm_scale if sm_scale is not None else float(1.0 / np.sqrt(d))
     bq, bk = _pick_blocks(sq)
-    # the flash kernels assume last-aligned self-attention (sq == skv) and
-    # block-divisible lengths; anything else must take the reference path
+    # the flash kernels assume last-aligned self-attention (sq == skv),
+    # block-divisible lengths and TPU-tileable blocks (rows % 8, lanes %
+    # 128); anything else must take the reference path
     flash_ok = (
-        sq == skv and sq % bq == 0 and skv % bk == 0 and d % 128 == 0
+        sq == skv
+        and sq % bq == 0
+        and skv % bk == 0
+        and bq % 8 == 0
+        and bk % 8 == 0
+        and d % 128 == 0
     )
     if impl is None:
         impl = "flash" if flash_ok else "reference"

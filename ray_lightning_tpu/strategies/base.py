@@ -160,13 +160,6 @@ class Strategy:
             lambda x, s: jax.device_put(x, s), params, shardings
         )
 
-    def place_optstate(self, opt_state: Any) -> Any:
-        shardings = self.optstate_shardings(opt_state)
-        if shardings is None:
-            return jax.device_put(opt_state)
-        return jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(x, s), opt_state, shardings
-        )
 
     # ------------------------------------------------------------------ #
     # data movement
