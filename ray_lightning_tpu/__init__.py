@@ -26,7 +26,9 @@ from ray_lightning_tpu.callbacks import (
     EarlyStopping,
     ThroughputMonitor,
     ProfilerCallback,
+    OrbaxModelCheckpoint,
 )
+from ray_lightning_tpu.cli import LightningCLI
 from ray_lightning_tpu.utils.seed import seed_everything
 from ray_lightning_tpu.strategies.ray_strategies import (
     RayStrategy,
@@ -58,6 +60,8 @@ __all__ = [
     "EarlyStopping",
     "ThroughputMonitor",
     "ProfilerCallback",
+    "OrbaxModelCheckpoint",
+    "LightningCLI",
     "seed_everything",
     "RayStrategy",
     "RayTPUStrategy",

@@ -3,6 +3,10 @@ from ray_lightning_tpu.callbacks.checkpoint import ModelCheckpoint
 from ray_lightning_tpu.callbacks.early_stopping import EarlyStopping
 from ray_lightning_tpu.callbacks.throughput import ThroughputMonitor
 from ray_lightning_tpu.callbacks.profiler import ProfilerCallback
+from ray_lightning_tpu.callbacks.orbax_checkpoint import (
+    ORBAX_AVAILABLE,
+    OrbaxModelCheckpoint,
+)
 
 __all__ = [
     "Callback",
@@ -10,4 +14,6 @@ __all__ = [
     "EarlyStopping",
     "ThroughputMonitor",
     "ProfilerCallback",
+    "OrbaxModelCheckpoint",
+    "ORBAX_AVAILABLE",
 ]

@@ -1,0 +1,107 @@
+"""Async sharded checkpointing via orbax — the TPU-native upgrade of the
+reference's byte-stream checkpoints (SURVEY §5 checkpoint/resume: "orbax-style
+async checkpointing of sharded arrays" is the designed-for equivalent).
+
+Unlike the msgpack stream path (which gathers to host), orbax writes each
+shard from the process that owns it and overlaps I/O with the next training
+steps (async). Restoring with a different mesh/worker count reshards
+transparently — the ZeRO "checkpoint downsizing" capability the reference
+tests via FairScale (reference: tests/test_ddp_sharded.py:118-137).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+
+from ray_lightning_tpu.callbacks.base import Callback
+
+try:
+    import orbax.checkpoint as ocp
+
+    ORBAX_AVAILABLE = True
+except Exception:  # pragma: no cover
+    ocp = None
+    ORBAX_AVAILABLE = False
+
+
+class OrbaxModelCheckpoint(Callback):
+    """Periodic async checkpoints of (params, opt_state, step) with
+    retention, via ocp.CheckpointManager."""
+
+    def __init__(
+        self,
+        dirpath: Optional[str] = None,
+        every_n_epochs: int = 1,
+        max_to_keep: int = 3,
+        async_save: bool = True,
+    ):
+        if not ORBAX_AVAILABLE:
+            raise RuntimeError("orbax-checkpoint is not installed")
+        self.dirpath = dirpath
+        self.every_n_epochs = max(1, every_n_epochs)
+        self.max_to_keep = max_to_keep
+        self.async_save = async_save
+        self._manager: Optional["ocp.CheckpointManager"] = None
+
+    def setup(self, trainer, module, stage: str) -> None:
+        if self.dirpath is None:
+            self.dirpath = os.path.join(trainer.default_root_dir, "orbax_ckpt")
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=self.max_to_keep,
+            enable_async_checkpointing=self.async_save,
+        )
+        self._manager = ocp.CheckpointManager(
+            os.path.abspath(self.dirpath), options=options
+        )
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        if trainer.sanity_checking or self._manager is None:
+            return
+        if trainer.current_epoch % self.every_n_epochs != 0:
+            return
+        items = {"params": ocp.args.StandardSave(trainer._params)}
+        if trainer._opt_state is not None:
+            items["opt_state"] = ocp.args.StandardSave(trainer._opt_state)
+        self._manager.save(trainer.global_step, args=ocp.args.Composite(**items))
+
+    def on_fit_end(self, trainer, module) -> None:
+        if self._manager is not None:
+            self._manager.wait_until_finished()
+
+    def teardown(self, trainer, module, stage: str) -> None:
+        if self._manager is not None:
+            self._manager.close()
+            self._manager = None
+
+    # ------------------------------------------------------------------ #
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step() if self._manager else None
+
+    @staticmethod
+    def restore(
+        dirpath: str,
+        params_template: Any,
+        opt_state_template: Any = None,
+        step: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Restore onto the templates' shardings — templates may use a
+        DIFFERENT mesh than the save ran on; orbax reshards on read."""
+        manager = ocp.CheckpointManager(os.path.abspath(dirpath))
+        try:
+            step = step if step is not None else manager.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no orbax checkpoints under {dirpath}")
+            to_abstract = lambda tree: jax.tree_util.tree_map(
+                ocp.utils.to_shape_dtype_struct, tree
+            )
+            items = {"params": ocp.args.StandardRestore(to_abstract(params_template))}
+            if opt_state_template is not None:
+                items["opt_state"] = ocp.args.StandardRestore(
+                    to_abstract(opt_state_template)
+                )
+            restored = manager.restore(step, args=ocp.args.Composite(**items))
+            return dict(restored.items())
+        finally:
+            manager.close()

@@ -1,0 +1,161 @@
+"""LightningCLI-equivalent: build a Trainer + LightningModule (+ optional
+DataModule and strategy) from command-line flags and/or a YAML config.
+
+Role parity: the reference proves its strategies instantiate from
+LightningCLI/jsonargparse configs (reference:
+ray_lightning/tests/test_lightning_cli.py:9-27). This is a dependency-free
+equivalent: ``--model.lr 0.01 --trainer.max_epochs 3
+--strategy.class_name RayStrategy --strategy.num_workers 2`` or
+``--config cfg.yaml`` with the same dotted keys.
+"""
+from __future__ import annotations
+
+import argparse
+import inspect
+from typing import Any, Dict, Optional, Type
+
+from ray_lightning_tpu.core.datamodule import LightningDataModule
+from ray_lightning_tpu.core.module import LightningModule
+from ray_lightning_tpu.core.trainer import Trainer
+
+_STRATEGIES = {}
+
+
+def _strategy_registry() -> Dict[str, type]:
+    global _STRATEGIES
+    if not _STRATEGIES:
+        from ray_lightning_tpu.strategies.base import SingleDeviceStrategy, XLAStrategy
+        from ray_lightning_tpu.strategies.ray_strategies import (
+            HorovodRayStrategy,
+            RayShardedStrategy,
+            RayStrategy,
+            RayTPUStrategy,
+        )
+
+        _STRATEGIES = {
+            "XLAStrategy": XLAStrategy,
+            "SingleDeviceStrategy": SingleDeviceStrategy,
+            "RayStrategy": RayStrategy,
+            "RayTPUStrategy": RayTPUStrategy,
+            "RayShardedStrategy": RayShardedStrategy,
+            "HorovodRayStrategy": HorovodRayStrategy,
+        }
+    return _STRATEGIES
+
+
+def _coerce(value: str) -> Any:
+    """Best-effort string -> python value (bool/int/float/str/None)."""
+    if not isinstance(value, str):
+        return value
+    low = value.lower()
+    if low in ("true", "yes"):
+        return True
+    if low in ("false", "no"):
+        return False
+    if low in ("none", "null"):
+        return None
+    for cast in (int, float):
+        try:
+            return cast(value)
+        except ValueError:
+            pass
+    return value
+
+
+def _accepts(cls: type, key: str) -> bool:
+    try:
+        sig = inspect.signature(cls.__init__)
+    except (TypeError, ValueError):
+        return True
+    params = sig.parameters
+    return key in params or any(
+        p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values()
+    )
+
+
+class LightningCLI:
+    """Parse args, build the components, and (by default) run ``fit``."""
+
+    def __init__(
+        self,
+        model_class: Type[LightningModule],
+        datamodule_class: Optional[Type[LightningDataModule]] = None,
+        args: Optional[list] = None,
+        run: bool = True,
+    ):
+        parser = argparse.ArgumentParser(add_help=True)
+        parser.add_argument("--config", type=str, default=None,
+                            help="YAML file with model/trainer/data/strategy sections")
+        known, unknown = parser.parse_known_args(args)
+
+        sections: Dict[str, Dict[str, Any]] = {
+            "model": {}, "trainer": {}, "data": {}, "strategy": {},
+        }
+        if known.config:
+            import yaml
+
+            with open(known.config) as f:
+                loaded = yaml.safe_load(f) or {}
+            for section, content in loaded.items():
+                if section in sections and isinstance(content, dict):
+                    sections[section].update(content)
+
+        # dotted CLI flags override the config file
+        it = iter(unknown)
+        for token in it:
+            if not token.startswith("--") or "." not in token:
+                raise SystemExit(f"unrecognized argument: {token}")
+            key = token[2:]
+            if "=" in key:
+                key, raw = key.split("=", 1)
+            else:
+                raw = next(it, None)
+                if raw is None:
+                    raise SystemExit(f"missing value for {token}")
+            section, _, field = key.partition(".")
+            if section not in sections:
+                raise SystemExit(f"unknown section {section!r} in {token}")
+            sections[section][field] = _coerce(raw)
+
+        strategy = None
+        strat_cfg = dict(sections["strategy"])
+        if strat_cfg:
+            cls_name = strat_cfg.pop("class_name", "RayStrategy")
+            registry = _strategy_registry()
+            if cls_name not in registry:
+                raise SystemExit(
+                    f"unknown strategy {cls_name!r}; options: {sorted(registry)}"
+                )
+            strategy = registry[cls_name](**strat_cfg)
+
+        model_cfg = dict(sections["model"])
+        unknown_keys = [k for k in model_cfg if not _accepts(model_class, k)]
+        if unknown_keys:
+            sig_params = list(inspect.signature(model_class.__init__).parameters)[1:]
+            if len(sig_params) == 1:
+                # single-config-dict models (reference MNISTClassifier style)
+                self.model = model_class(model_cfg)
+            else:
+                raise SystemExit(
+                    f"unknown --model keys {unknown_keys}; "
+                    f"{model_class.__name__} accepts {sig_params}"
+                )
+        else:
+            self.model = model_class(**model_cfg)
+
+        self.datamodule = None
+        if datamodule_class is not None:
+            bad = [k for k in sections["data"] if not _accepts(datamodule_class, k)]
+            if bad:
+                raise SystemExit(
+                    f"unknown --data keys {bad} for {datamodule_class.__name__}"
+                )
+            self.datamodule = datamodule_class(**sections["data"])
+
+        trainer_kwargs = dict(sections["trainer"])
+        if strategy is not None:
+            trainer_kwargs["strategy"] = strategy
+        self.trainer = Trainer(**trainer_kwargs)
+
+        if run:
+            self.trainer.fit(self.model, datamodule=self.datamodule)

@@ -74,9 +74,8 @@ def _wrapping_function(
     strategy.set_remote(True)
     strategy._set_worker_context(global_rank, num_workers)
 
-    queue = rt.QueueClient(queue_handle) if queue_handle is not None else None
     reset_session()
-    init_session(rank=global_rank, queue=queue)
+    init_session(rank=global_rank, queue=queue_handle)
 
     # fn_args[0] is the module; it and trainer._module are the same object
     # (one cloudpickle memo), so driver-side identity is preserved — the
@@ -130,14 +129,33 @@ class RayLauncher:
         # the env that setup_workers propagates (the reference's
         # PL_GLOBAL_SEED flow, ray_launcher.py:159-175).
         seed_everything(trainer.seed if trainer is not None else None)
-        self.setup_workers()
-        try:
-            output = self.run_function_on_workers(function, *args, trainer=trainer)
-            if trainer is not None and output is not None:
-                self._recover_results_in_main_process(output, trainer)
-            return output.trainer_results if output is not None else None
-        finally:
-            self.teardown_workers()
+        # Failure handling: the reference surfaces a worker crash only as a
+        # failed future and gives up (SURVEY §5 "a deliberate gap to improve
+        # on, not replicate"); here a crashed worker group is torn down and
+        # relaunched up to strategy.max_failures times.
+        max_failures = getattr(self._strategy, "max_failures", 0)
+        attempt = 0
+        while True:
+            try:
+                self.setup_workers()
+                output = self.run_function_on_workers(function, *args, trainer=trainer)
+                if trainer is not None and output is not None:
+                    self._recover_results_in_main_process(output, trainer)
+                return output.trainer_results if output is not None else None
+            except rt.ActorError as e:
+                # only infrastructure failures (dead workers) are worth a
+                # relaunch; a deterministic user exception would just fail
+                # again against a fresh worker group
+                if attempt >= max_failures or not e.is_process_failure:
+                    raise
+                attempt += 1
+                rank_zero_info(
+                    "worker failure; relaunching (attempt %d/%d)",
+                    attempt,
+                    max_failures,
+                )
+            finally:
+                self.teardown_workers()
 
     # ------------------------------------------------------------------ #
     def setup_workers(self) -> None:
@@ -182,7 +200,7 @@ class RayLauncher:
                 rank_zero_info("collective smoke test: %s", sums)
 
         if self._is_tune_session():
-            self._tune_queue = rt.Queue()
+            self._tune_queue = rt.make_queue()
 
     @staticmethod
     def _is_tune_session() -> bool:
@@ -210,7 +228,7 @@ class RayLauncher:
             trainer._tx = tx
             trainer._opt_state = opt
 
-        queue_handle = self._tune_queue.actor if self._tune_queue else None
+        queue_handle = self._tune_queue.handle() if self._tune_queue else None
         try:
             futures = [
                 w.execute.remote(

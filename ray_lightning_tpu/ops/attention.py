@@ -69,7 +69,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale, causal, block_q, bl
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
-    q = q_ref[:].astype(jnp.float32) * scale  # [BQ, D]
+    q = q_ref[:]  # [BQ, D] input dtype; dots accumulate in fp32
     skv = k_ref.shape[0]
     n_kv = skv // block_k
     if causal:
@@ -80,11 +80,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale, causal, block_q, bl
 
     def body(j, carry):
         acc, m, l = carry
-        ks = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vs = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [BQ, BK]
+        ks = k_ref[pl.ds(j * block_k, block_k), :]
+        vs = v_ref[pl.ds(j * block_k, block_k), :]
+        s = (
+            jax.lax.dot_general(
+                q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )  # [BQ, BK] fp32
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -94,7 +97,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale, causal, block_q, bl
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
-            p, vs, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(vs.dtype), vs, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return acc, m_new, l
 
@@ -120,9 +124,9 @@ def _bwd_dq_kernel(
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
-    q = q_ref[:].astype(jnp.float32)  # [BQ, D]
-    do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:]  # [BQ, 1]
+    q = q_ref[:]  # [BQ, D] input dtype
+    do = do_ref[:]
+    lse = lse_ref[:]  # [BQ, 1] fp32
     delta = delta_ref[:]
     skv = k_ref.shape[0]
     n_kv = skv // block_k
@@ -132,8 +136,8 @@ def _bwd_dq_kernel(
         hi = n_kv
 
     def body(j, dq):
-        ks = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vs = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        ks = k_ref[pl.ds(j * block_k, block_k), :]
+        vs = v_ref[pl.ds(j * block_k, block_k), :]
         s = (
             jax.lax.dot_general(
                 q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -148,7 +152,7 @@ def _bwd_dq_kernel(
         dp = jax.lax.dot_general(
             do, vs, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(ks.dtype)
         return dq + jax.lax.dot_general(
             ds, ks, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -168,16 +172,16 @@ def _bwd_dkv_kernel(
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
-    ks = k_ref[:].astype(jnp.float32)  # [BK, D]
-    vs = v_ref[:].astype(jnp.float32)
+    ks = k_ref[:]  # [BK, D] input dtype
+    vs = v_ref[:]
     sq = q_ref.shape[0]
     n_q = sq // block_q
     lo = (ki * block_k) // block_q if causal else 0
 
     def body(i, carry):
         dk, dv = carry
-        qs = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        qs = q_ref[pl.ds(i * block_q, block_q), :]
+        do = do_ref[pl.ds(i * block_q, block_q), :]
         lse = lse_ref[pl.ds(i * block_q, block_q), :]
         delta = delta_ref[pl.ds(i * block_q, block_q), :]
         s = (
@@ -192,12 +196,13 @@ def _bwd_dkv_kernel(
             s = jnp.where(rows >= cols, s, -jnp.inf)
         p = jnp.exp(s - lse)  # [BQ, BK]
         dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, vs, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(qs.dtype)
         dk = dk + jax.lax.dot_general(
             ds, qs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )

@@ -13,7 +13,13 @@ from ray_lightning_tpu.runtime.api import (
 )
 from ray_lightning_tpu.runtime.actor import ActorError, ActorHandle, CallFuture
 from ray_lightning_tpu.runtime.object_store import ObjectRef
-from ray_lightning_tpu.runtime.queue import Queue, QueueClient
+from ray_lightning_tpu.runtime.queue import (
+    Queue,
+    QueueClient,
+    ShmQueue,
+    ShmQueueHandle,
+    make_queue,
+)
 
 __all__ = [
     "init",
@@ -33,4 +39,7 @@ __all__ = [
     "ObjectRef",
     "Queue",
     "QueueClient",
+    "ShmQueue",
+    "ShmQueueHandle",
+    "make_queue",
 ]

@@ -62,7 +62,15 @@ def _recv_msg(sock: socket.socket) -> bytes:
 class ActorError(RuntimeError):
     """Raised on the caller when the actor method raised; carries the remote
     traceback (parity with ray.exceptions.RayTaskError surfacing in
-    ``ray.get``, reference: ray_lightning/util.py:57-70)."""
+    ``ray.get``, reference: ray_lightning/util.py:57-70).
+
+    ``is_process_failure`` distinguishes a dead/unreachable worker process
+    (retryable infrastructure failure) from an ordinary exception raised by
+    user code inside the actor (deterministic; retrying won't help)."""
+
+    def __init__(self, message: str, is_process_failure: bool = False):
+        super().__init__(message)
+        self.is_process_failure = is_process_failure
 
 
 # --------------------------------------------------------------------- #
@@ -152,6 +160,11 @@ class CallFuture:
 
     def result(self, timeout: Optional[float] = None) -> Any:
         status, value = self._fut.result(timeout)
+        if status == "connection_lost":
+            raise ActorError(
+                f"{self.actor.name}.{self.method}: worker process failed: {value}",
+                is_process_failure=True,
+            )
         if status == "error":
             raise ActorError(
                 f"{self.actor.name}.{self.method} raised remotely:\n{value}"
@@ -190,7 +203,7 @@ class _Connection:
                 self._pending.clear()
             for fut in pending:
                 if not fut.done():
-                    fut.set_result(("error", f"actor connection lost: {e!r}"))
+                    fut.set_result(("connection_lost", repr(e)))
 
     def call(self, method: str, args, kwargs) -> Future:
         fut: Future = Future()

@@ -176,7 +176,9 @@ def create_actors(
     if errors:
         for h in handles:
             kill(h)
-        raise ActorError("actor startup failed:\n" + "\n".join(errors))
+        raise ActorError(
+            "actor startup failed:\n" + "\n".join(errors), is_process_failure=True
+        )
     return handles
 
 

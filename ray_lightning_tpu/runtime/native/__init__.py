@@ -1,0 +1,99 @@
+"""ctypes bindings for the native runtime core (librlt_shm.so).
+
+Builds lazily on first use with the image's g++ (make -C this directory);
+falls back cleanly when no toolchain is present — callers check
+:func:`available` and use the pure-Python paths otherwise.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "librlt_shm.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.rlt_store_create.argtypes = [ctypes.c_char_p, u8p, ctypes.c_uint64]
+    lib.rlt_store_create.restype = ctypes.c_int
+    lib.rlt_store_size.argtypes = [ctypes.c_char_p]
+    lib.rlt_store_size.restype = ctypes.c_int64
+    lib.rlt_store_map.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.rlt_store_map.restype = ctypes.c_void_p
+    lib.rlt_store_unmap.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64]
+    lib.rlt_store_unmap.restype = ctypes.c_int64
+    lib.rlt_store_release.argtypes = [ctypes.c_char_p]
+    lib.rlt_store_release.restype = ctypes.c_int64
+    lib.rlt_queue_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.rlt_queue_create.restype = ctypes.c_int
+    lib.rlt_queue_attach.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.rlt_queue_attach.restype = ctypes.c_void_p
+    lib.rlt_queue_detach.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.rlt_queue_unlink.argtypes = [ctypes.c_char_p]
+    lib.rlt_queue_push.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint32]
+    lib.rlt_queue_push.restype = ctypes.c_int
+    lib.rlt_queue_pop.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint32]
+    lib.rlt_queue_pop.restype = ctypes.c_int64
+    lib.rlt_queue_slot_bytes.argtypes = [ctypes.c_void_p]
+    lib.rlt_queue_slot_bytes.restype = ctypes.c_uint64
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO):
+            # cross-process build guard: compile under an flock so N
+            # simultaneously-starting processes don't write the same .so
+            try:
+                import fcntl
+
+                lock_path = os.path.join(_HERE, ".build.lock")
+                with open(lock_path, "w") as lock_file:
+                    fcntl.flock(lock_file, fcntl.LOCK_EX)
+                    try:
+                        if not os.path.exists(_SO):
+                            subprocess.run(
+                                ["make", "-C", _HERE],
+                                check=True,
+                                capture_output=True,
+                                timeout=120,
+                            )
+                    finally:
+                        fcntl.flock(lock_file, fcntl.LOCK_UN)
+            except Exception:
+                _build_failed = True
+                return None
+        try:
+            _lib = _configure(ctypes.CDLL(_SO))
+        except OSError:
+            _build_failed = True
+            return None
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

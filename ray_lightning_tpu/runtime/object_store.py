@@ -2,34 +2,39 @@
 
 Role parity: Ray's plasma object store as used by the reference
 (``ray.put(model)`` shipping the model once per node instead of per worker,
-reference: ray_lightning/launchers/ray_launcher.py:234-237). Single-host
-implementation over POSIX shared memory: ``put`` pickles once into a shm
-segment, every local worker maps the same pages — no per-worker copies of
-model/trainer state.
+reference: ray_lightning/launchers/ray_launcher.py:234-237).
 
-Backend is pluggable: the default is Python ``multiprocessing.shared_memory``;
-a C++ backend (``runtime/native``) provides the same segment layout with
-lock-free refcounts when built.
+Two backends, same API:
+- **native** (preferred): the C++ ``librlt_shm`` store — POSIX shm segments
+  with a cross-process atomic refcount in the header, so a segment survives
+  its creator and is unlinked by whichever process drops the last reference
+  (runtime/native/rlt_shm.cpp).
+- **python**: ``multiprocessing.shared_memory``; the owner must outlive all
+  readers and explicitly unlink.
 """
 from __future__ import annotations
 
+import ctypes
 import os
 import pickle
 import secrets
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from multiprocessing import shared_memory
 from typing import Any, Dict
 
 import cloudpickle
 
+from ray_lightning_tpu.runtime import native
+
 
 @dataclass(frozen=True)
 class ObjectRef:
     """Handle to an object in the store. Picklable; resolvable anywhere on
-    the host via :func:`get`."""
+    the host via :func:`get_object`."""
 
     name: str
     size: int
+    backend: str = "python"
 
     def hex(self) -> str:
         return self.name
@@ -40,35 +45,94 @@ class ObjectStore:
 
     def __init__(self, prefix: str = "rlt"):
         self._prefix = prefix
-        self._owned: Dict[str, shared_memory.SharedMemory] = {}
+        self._lib = native.get_lib()
+        self._owned_py: Dict[str, shared_memory.SharedMemory] = {}
+        self._owned_native: list = []
+
+    def _new_name(self) -> str:
+        return f"{self._prefix}_{os.getpid()}_{secrets.token_hex(8)}"
 
     def put(self, obj: Any) -> ObjectRef:
         payload = cloudpickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-        name = f"{self._prefix}_{os.getpid()}_{secrets.token_hex(8)}"
-        shm = shared_memory.SharedMemory(name=name, create=True, size=max(1, len(payload)))
+        name = self._new_name()
+        if self._lib is not None:
+            buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+            rc = self._lib.rlt_store_create(
+                ("/" + name).encode(), buf, len(payload)
+            )
+            if rc == 0:
+                self._owned_native.append(name)
+                return ObjectRef(name=name, size=len(payload), backend="native")
+            # fall through to the python backend on any native failure
+        shm = shared_memory.SharedMemory(
+            name=name, create=True, size=max(1, len(payload))
+        )
         shm.buf[: len(payload)] = payload
-        self._owned[name] = shm
-        return ObjectRef(name=name, size=len(payload))
+        self._owned_py[name] = shm
+        return ObjectRef(name=name, size=len(payload), backend="python")
 
     def delete(self, ref: ObjectRef) -> None:
-        shm = self._owned.pop(ref.name, None)
-        if shm is not None:
-            shm.close()
+        """Drop the creator reference. Works from ANY process for the native
+        backend (consumers of queue-spilled payloads free them without a
+        round-trip to the producer)."""
+        if ref.backend == "native":
+            if ref.name in self._owned_native:
+                self._owned_native.remove(ref.name)
+            if self._lib is not None:
+                self._lib.rlt_store_release(("/" + ref.name).encode())
+            return
+        shm = self._owned_py.pop(ref.name, None)
+        if shm is None:
             try:
-                shm.unlink()
+                shm = shared_memory.SharedMemory(name=ref.name)
             except FileNotFoundError:
+                return
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(shm._name, "shared_memory")
+            except Exception:
                 pass
+        shm.close()
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
 
     def shutdown(self) -> None:
-        for name in list(self._owned):
-            self.delete(ObjectRef(name=name, size=0))
+        for name in list(self._owned_py):
+            self.delete(ObjectRef(name=name, size=0, backend="python"))
+        for name in list(self._owned_native):
+            self.delete(ObjectRef(name=name, size=0, backend="native"))
 
 
 def get_object(ref: ObjectRef) -> Any:
     """Attach the segment (any process on the host) and deserialize."""
-    # Readers must not register the segment with their own resource tracker
-    # — the owner unlinks it (SharedMemory(track=False) is 3.13+, so
-    # unregister manually).
+    if ref.backend == "native":
+        lib = native.get_lib()
+        if lib is None:
+            raise RuntimeError(
+                "object was stored with the native backend but librlt_shm "
+                "is unavailable in this process"
+            )
+        size = ctypes.c_uint64()
+        base = ctypes.c_void_p()
+        length = ctypes.c_uint64()
+        payload = lib.rlt_store_map(
+            ("/" + ref.name).encode(), ctypes.byref(size),
+            ctypes.byref(base), ctypes.byref(length),
+        )
+        if not payload:
+            raise FileNotFoundError(f"shm object {ref.name} not found")
+        try:
+            data = ctypes.string_at(payload, size.value)
+        finally:
+            lib.rlt_store_unmap(("/" + ref.name).encode(), base, length)
+        return cloudpickle.loads(data)
+
+    # python backend: readers must not register the segment with their own
+    # resource tracker — the owner unlinks it (SharedMemory(track=False) is
+    # 3.13+, so unregister manually).
     shm = shared_memory.SharedMemory(name=ref.name)
     try:
         from multiprocessing import resource_tracker

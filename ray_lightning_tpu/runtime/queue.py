@@ -1,22 +1,38 @@
-"""Cross-process queue, implemented as an actor (like ray.util.queue.Queue,
-which the reference uses to tunnel tune.report lambdas from workers to the
-driver: reference: ray_lightning/launchers/ray_launcher.py:101-103,
+"""Cross-process queues for tunneling tune.report lambdas / checkpoint
+streams from workers to the driver (reference role:
+ray.util.queue.Queue used at ray_lightning/launchers/ray_launcher.py:101-103,
 session.py:61-63, util.py:49-54).
 
-The handle is picklable: workers and driver each talk to the queue actor
-over their own connection.
+Two implementations behind one API (``put`` / ``get_all`` / ``handle()`` /
+``shutdown``):
+
+- :class:`ShmQueue` (preferred): the native lock-free MPMC ring buffer in
+  shared memory (runtime/native/rlt_shm.cpp) — no server process, no socket
+  hops; oversized payloads spill into the object store and travel by ref.
+- :class:`Queue`: an actor-hosted deque (pure-Python fallback; handles are
+  socket clients of the queue actor).
+
+``make_queue()`` picks the best available.
 """
 from __future__ import annotations
 
 import collections
+import ctypes
+import os
 import queue as _queue_mod
+import secrets
 from typing import Any, List, Optional
 
-from ray_lightning_tpu.runtime import api
+import cloudpickle
+
+from ray_lightning_tpu.runtime import api, native
 
 Full = _queue_mod.Full
 
 
+# --------------------------------------------------------------------- #
+# actor-based fallback queue
+# --------------------------------------------------------------------- #
 class _QueueActor:
     def __init__(self, maxsize: int = 0):
         self.maxsize = maxsize
@@ -55,6 +71,9 @@ class Queue:
     def actor(self):
         return self._actor
 
+    def handle(self) -> "QueueClient":
+        return QueueClient(self._actor)
+
     def put(self, item: Any) -> None:
         if not self._actor.call("put", item).result():
             raise Full("queue is full")
@@ -73,7 +92,7 @@ class Queue:
 
 
 class QueueClient:
-    """Worker-side view of a queue from a pickled ActorHandle."""
+    """Worker-side view of an actor queue from a pickled ActorHandle."""
 
     def __init__(self, actor_handle):
         self._actor = actor_handle
@@ -81,3 +100,130 @@ class QueueClient:
     def put(self, item: Any) -> None:
         if not self._actor.call("put", item).result():
             raise Full("queue is full")
+
+
+# --------------------------------------------------------------------- #
+# native shm queue
+# --------------------------------------------------------------------- #
+_SPILL_KEY = "__rlt_spilled_ref__"
+
+
+class _ShmQueueBase:
+    def __init__(self, name: str):
+        self._name = name
+        self._queue = None
+        self._base = None
+        self._len = None
+
+    def _attach(self):
+        if self._queue is None:
+            lib = native.get_lib()
+            if lib is None:
+                raise RuntimeError("native shm queue requires librlt_shm")
+            base = ctypes.c_void_p()
+            length = ctypes.c_uint64()
+            q = lib.rlt_queue_attach(
+                ("/" + self._name).encode(), ctypes.byref(base), ctypes.byref(length)
+            )
+            if not q:
+                raise FileNotFoundError(f"shm queue {self._name} not found")
+            self._queue = ctypes.c_void_p(q)
+            self._base = base
+            self._len = length
+        return native.get_lib()
+
+    def put(self, item: Any) -> None:
+        lib = self._attach()
+        payload = cloudpickle.dumps(item)
+        slot_bytes = lib.rlt_queue_slot_bytes(self._queue)
+        spill_ref = None
+        if len(payload) > slot_bytes:
+            # spill the big payload to the object store; queue carries a ref
+            spill_ref = api.put(payload)
+            payload = cloudpickle.dumps({_SPILL_KEY: spill_ref})
+            if len(payload) > slot_bytes:
+                api.delete(spill_ref)
+                raise Full("queue slot too small even for a spill ref")
+        buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        rc = lib.rlt_queue_push(self._queue, buf, len(payload))
+        if rc != 0 and spill_ref is not None:
+            api.delete(spill_ref)  # the ref never made it into the ring
+        if rc == -11:  # -EAGAIN
+            raise Full("queue is full")
+        if rc != 0:
+            raise RuntimeError(f"rlt_queue_push failed: {rc}")
+
+    def _detach(self):
+        lib = native.get_lib()
+        if self._queue is not None and lib is not None:
+            lib.rlt_queue_detach(self._base, self._len)
+            self._queue = None
+
+
+class ShmQueueHandle(_ShmQueueBase):
+    """Picklable producer handle: attaches lazily in each process."""
+
+    def __getstate__(self):
+        return {"_name": self._name}
+
+    def __setstate__(self, state):
+        self.__init__(state["_name"])
+
+
+class ShmQueue(_ShmQueueBase):
+    def __init__(self, capacity: int = 1024, slot_bytes: int = 16384):
+        lib = native.get_lib()
+        if lib is None:
+            raise RuntimeError("native shm queue requires librlt_shm")
+        name = f"rltq_{os.getpid()}_{secrets.token_hex(6)}"
+        rc = lib.rlt_queue_create(("/" + name).encode(), capacity, slot_bytes)
+        if rc != 0:
+            raise RuntimeError(f"rlt_queue_create failed: {rc}")
+        super().__init__(name)
+        self._spilled_refs: list = []
+
+    def handle(self) -> ShmQueueHandle:
+        return ShmQueueHandle(self._name)
+
+    def get_all(self) -> List[Any]:
+        lib = self._attach()
+        slot_bytes = int(lib.rlt_queue_slot_bytes(self._queue))
+        out = (ctypes.c_uint8 * slot_bytes)()
+        items: List[Any] = []
+        while True:
+            n = lib.rlt_queue_pop(self._queue, out, slot_bytes)
+            if n == -11:  # -EAGAIN: empty
+                break
+            if n < 0:
+                raise RuntimeError(f"rlt_queue_pop failed: {n}")
+            item = cloudpickle.loads(bytes(out[: n]))
+            if isinstance(item, dict) and _SPILL_KEY in item:
+                ref = item[_SPILL_KEY]
+                item = cloudpickle.loads(api.get(ref))
+                api.delete(ref)  # free the spilled segment (consumer-side)
+            items.append(item)
+        return items
+
+    def empty(self) -> bool:
+        # non-destructive emptiness probing isn't supported by the ring;
+        # callers use get_all() batches
+        return False
+
+    def shutdown(self) -> None:
+        lib = native.get_lib()
+        self._detach()
+        if lib is not None:
+            lib.rlt_queue_unlink(("/" + self._name).encode())
+
+
+def make_queue(**kwargs):
+    """Best-available queue: native shm ring if the toolchain built it,
+    else the actor-hosted fallback."""
+    if native.available():
+        try:
+            return ShmQueue(**kwargs)
+        except Exception:
+            pass
+    kwargs.pop("capacity", None)
+    kwargs.pop("slot_bytes", None)
+    return Queue(**kwargs)

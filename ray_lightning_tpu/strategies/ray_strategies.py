@@ -55,6 +55,7 @@ class RayStrategy(XLAStrategy):
         mesh_spec: Optional[MeshSpec] = None,
         sharding_policy: Optional[ShardingPolicy] = None,
         debug_collectives: bool = False,
+        max_failures: int = 0,
         **kwargs: Any,
     ):
         super().__init__(mesh_spec, sharding_policy)
@@ -69,6 +70,7 @@ class RayStrategy(XLAStrategy):
         self.platform = platform
         self.devices_per_worker = devices_per_worker
         self.debug_collectives = debug_collectives
+        self.max_failures = int(max_failures)
         if kwargs:
             rank_zero_warn("ignoring unsupported strategy kwargs: %s", sorted(kwargs))
         self._launcher = None

@@ -81,7 +81,6 @@ class _TrialRunner:
     def run(self, trainable_bytes, config, trial_id, trial_dir, queue_handle):
         import os
 
-        from ray_lightning_tpu.runtime.queue import QueueClient
         from ray_lightning_tpu.tune.session import (
             TrialSession,
             clear_trial_session,
@@ -89,7 +88,7 @@ class _TrialRunner:
         )
 
         os.makedirs(trial_dir, exist_ok=True)
-        queue = QueueClient(queue_handle)
+        queue = queue_handle  # ShmQueueHandle or QueueClient; both .put()
         trainable = cloudpickle.loads(trainable_bytes)
 
         def report_fn(metrics, iteration):
@@ -204,7 +203,7 @@ def run(
         max_concurrent_trials = max(1, int((os.cpu_count() or 4) // max(1, cpus_per_trial)))
     max_concurrent_trials = min(max_concurrent_trials, len(trials)) or 1
 
-    queue = rt.Queue()
+    queue = rt.make_queue()
     trainable_bytes = cloudpickle.dumps(trainable)
 
     def start_trial(trial: Trial):
@@ -216,7 +215,7 @@ def run(
             env=trial_env,
         )
         trial._future = trial._actor.run.remote(
-            trainable_bytes, trial.config, trial.trial_id, trial.logdir, queue.actor
+            trainable_bytes, trial.config, trial.trial_id, trial.logdir, queue.handle()
         )
 
     def stop_trial(trial: Trial, status: str):

@@ -1,0 +1,76 @@
+"""Native runtime core (C++ shm store + MPMC queue). Skipped when the
+toolchain can't build librlt_shm (pure-Python fallbacks cover the API)."""
+import queue as queue_mod
+
+import pytest
+
+from ray_lightning_tpu.runtime import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native librlt_shm unavailable"
+)
+
+
+def test_store_refcount_lifecycle():
+    from ray_lightning_tpu import runtime as rt
+
+    rt.init()
+    ref = rt.put({"x": list(range(1000))})
+    assert ref.backend == "native"
+    assert rt.get(ref)["x"][-1] == 999
+    rt.delete(ref)
+    with pytest.raises((FileNotFoundError, RuntimeError)):
+        rt.get(ref)
+
+
+def test_shm_queue_fifo_and_full():
+    from ray_lightning_tpu import runtime as rt
+
+    q = rt.ShmQueue(capacity=4, slot_bytes=128)
+    try:
+        q.put(1)
+        q.put(2)
+        assert q.get_all() == [1, 2]
+        for i in range(4):
+            q.put(i)
+        with pytest.raises(queue_mod.Full):
+            q.put(99)
+        assert q.get_all() == [0, 1, 2, 3]
+    finally:
+        q.shutdown()
+
+
+def test_shm_queue_spills_large_payloads():
+    from ray_lightning_tpu import runtime as rt
+
+    rt.init()
+    q = rt.ShmQueue(capacity=4, slot_bytes=256)
+    try:
+        big = {"blob": b"z" * 50_000}
+        q.put(big)
+        (item,) = q.get_all()
+        assert item["blob"] == big["blob"]
+    finally:
+        q.shutdown()
+
+
+@pytest.mark.slow
+def test_shm_queue_cross_process():
+    from ray_lightning_tpu import runtime as rt
+
+    rt.init()
+    q = rt.ShmQueue()
+
+    class Pusher:
+        def push(self, handle, n):
+            for i in range(n):
+                handle.put(("w", i))
+            return True
+
+    actor = rt.create_actor(Pusher, env={"JAX_PLATFORMS": "cpu"})
+    try:
+        assert actor.push.remote(q.handle(), 3).result()
+        assert q.get_all() == [("w", 0), ("w", 1), ("w", 2)]
+    finally:
+        rt.kill(actor)
+        q.shutdown()
