@@ -1,0 +1,11 @@
+from ray_lightning_tpu.accelerators.delayed_tpu import (
+    DelayedTPUAccelerator,
+    ensure_driver_off_accelerator,
+    ACCELERATOR_REGISTRY,
+)
+
+__all__ = [
+    "DelayedTPUAccelerator",
+    "ensure_driver_off_accelerator",
+    "ACCELERATOR_REGISTRY",
+]

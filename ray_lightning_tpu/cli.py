@@ -44,9 +44,15 @@ def _strategy_registry() -> Dict[str, type]:
 
 
 def _coerce(value: str) -> Any:
-    """Best-effort string -> python value (bool/int/float/str/None)."""
+    """Best-effort string -> python value (bool/int/float/str/None).
+
+    Quote a value to force a literal string: ``--model.name '"none"'`` or
+    ``--model.version "'1.10'"`` keep the exact text.
+    """
     if not isinstance(value, str):
         return value
+    if len(value) >= 2 and value[0] == value[-1] and value[0] in ("'", '"'):
+        return value[1:-1]
     low = value.lower()
     if low in ("true", "yes"):
         return True

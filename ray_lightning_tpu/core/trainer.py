@@ -144,6 +144,20 @@ class Trainer:
 
         self.strategy: Strategy = strategy or XLAStrategy()
         self.accelerator = accelerator
+        if accelerator in ("_tpu", "tpu") and hasattr(self.strategy, "num_workers"):
+            # delayed accelerator: only launcher strategies train in REMOTE
+            # workers — for those, keep the driver off the chip (reference
+            # _GPUAccelerator role; accelerators/delayed_tpu.py). In-process
+            # strategies must keep their accelerator.
+            from ray_lightning_tpu.accelerators import DelayedTPUAccelerator
+            from ray_lightning_tpu.utils.common import rank_zero_warn
+
+            if not DelayedTPUAccelerator.setup_driver():
+                rank_zero_warn(
+                    "accelerator='_tpu' requested but a non-CPU backend is "
+                    "already initialized in the driver; workers may fail to "
+                    "acquire the TPU"
+                )
 
         self.callbacks: List[Callback] = list(callbacks or [])
         if self.enable_checkpointing and not any(

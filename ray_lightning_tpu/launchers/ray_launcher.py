@@ -17,6 +17,7 @@ TPU-native substitutions:
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, List, Optional
 
 import cloudpickle
@@ -47,12 +48,36 @@ def _drain_queue(queue) -> None:
 
 def process_results(futures: List[rt.CallFuture], queue=None) -> List[Any]:
     """Poll worker futures while draining the tune queue (reference:
-    util.py:57-70). Raises the first worker error."""
+    util.py:57-70). Raises a worker error, preferring a PROCESS failure
+    over a collective-abort exception from a surviving peer — when one
+    worker dies, its peers typically also error (all-reduce abort) and
+    whichever future settles first is a race; only the process failure is
+    the retryable root cause."""
     remaining = list(futures)
+    first_error: Optional[rt.ActorError] = None
     while remaining:
         ready, remaining = rt.wait(remaining, num_returns=1, timeout=0.1)
         for fut in ready:
-            fut.result()  # surface worker exceptions immediately
+            try:
+                fut.result()
+            except rt.ActorError as e:
+                if e.is_process_failure:
+                    raise
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            # grace window: let the crashed peer's connection-loss surface
+            # so the failure classifies as retryable
+            deadline = time.monotonic() + 3.0
+            while remaining and time.monotonic() < deadline:
+                ready, remaining = rt.wait(remaining, num_returns=1, timeout=0.2)
+                for fut in ready:
+                    try:
+                        fut.result()
+                    except rt.ActorError as e:
+                        if e.is_process_failure:
+                            raise
+            raise first_error
         _drain_queue(queue)
     _drain_queue(queue)
     return [f.result() for f in futures]

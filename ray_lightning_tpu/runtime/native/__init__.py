@@ -52,6 +52,8 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rlt_queue_pop.restype = ctypes.c_int64
     lib.rlt_queue_slot_bytes.argtypes = [ctypes.c_void_p]
     lib.rlt_queue_slot_bytes.restype = ctypes.c_uint64
+    lib.rlt_queue_size.argtypes = [ctypes.c_void_p]
+    lib.rlt_queue_size.restype = ctypes.c_uint64
     return lib
 
 

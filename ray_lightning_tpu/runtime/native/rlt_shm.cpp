@@ -288,4 +288,12 @@ uint64_t rlt_queue_slot_bytes(void* queue) {
   return reinterpret_cast<QueueHeader*>(queue)->slot_bytes;
 }
 
+// Approximate occupancy (racy by nature; exact when quiescent).
+uint64_t rlt_queue_size(void* queue) {
+  auto* header = reinterpret_cast<QueueHeader*>(queue);
+  uint64_t enq = header->enqueue_pos.load(std::memory_order_acquire);
+  uint64_t deq = header->dequeue_pos.load(std::memory_order_acquire);
+  return enq >= deq ? enq - deq : 0;
+}
+
 }  // extern "C"

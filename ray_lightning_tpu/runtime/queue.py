@@ -180,7 +180,6 @@ class ShmQueue(_ShmQueueBase):
         if rc != 0:
             raise RuntimeError(f"rlt_queue_create failed: {rc}")
         super().__init__(name)
-        self._spilled_refs: list = []
 
     def handle(self) -> ShmQueueHandle:
         return ShmQueueHandle(self._name)
@@ -205,11 +204,20 @@ class ShmQueue(_ShmQueueBase):
         return items
 
     def empty(self) -> bool:
-        # non-destructive emptiness probing isn't supported by the ring;
-        # callers use get_all() batches
-        return False
+        lib = self._attach()
+        return lib.rlt_queue_size(self._queue) == 0
+
+    def qsize(self) -> int:
+        lib = self._attach()
+        return int(lib.rlt_queue_size(self._queue))
 
     def shutdown(self) -> None:
+        # drain before unlinking: undrained spilled payloads hold object
+        # store segments whose refs live only in the ring
+        try:
+            self.get_all()
+        except Exception:
+            pass
         lib = native.get_lib()
         self._detach()
         if lib is not None:

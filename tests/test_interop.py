@@ -1,0 +1,86 @@
+"""Interop + session-reuse behaviors the reference advertises: torch
+dataloaders/datasets feed the JAX step; fit() can be called repeatedly in
+one process (the reference's headline advantage over PTL's own spawn,
+README "Calling fit or test multiple times in the same script")."""
+import numpy as np
+import pytest
+
+import ray_lightning_tpu as rlt
+from ray_lightning_tpu.models.mnist import MNISTClassifier
+
+from tests.utils import BoringModel, get_trainer
+
+
+def test_torch_dataset_through_our_loader(tmp_root):
+    torch = pytest.importorskip("torch")
+
+    class TorchDS(torch.utils.data.Dataset):
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            x = torch.randn(32)
+            return x
+
+    loader = rlt.DataLoader(TorchDS(), batch_size=8, drop_last=True)
+    model = BoringModel()
+    trainer = get_trainer(tmp_root, max_epochs=1, checkpoint_callback=False)
+    trainer.fit(model, train_dataloaders=loader)
+    assert model.params is not None
+
+
+def test_torch_dataloader_passthrough(tmp_root):
+    torch = pytest.importorskip("torch")
+    xs = torch.randn(64, 32)
+    torch_loader = torch.utils.data.DataLoader(
+        torch.utils.data.TensorDataset(xs), batch_size=8, drop_last=True
+    )
+
+    class Model(BoringModel):
+        def training_step(self, params, batch, batch_idx):
+            (x,) = batch if isinstance(batch, (list, tuple)) else (batch,)
+            return self.loss_fn(params, x)
+
+        def val_dataloader(self):
+            return None
+
+    model = Model()
+    trainer = get_trainer(tmp_root, max_epochs=1, checkpoint_callback=False)
+    trainer.fit(model, train_dataloaders=torch_loader)
+    assert model.params is not None
+
+
+def test_repeated_fit_same_process(tmp_root):
+    """fit / validate / fit again in one interpreter (notebook pattern)."""
+    model = BoringModel()
+    trainer = get_trainer(tmp_root, max_epochs=1, checkpoint_callback=False)
+    trainer.fit(model)
+    first = np.asarray(
+        list(trainer.callback_metrics.values())[0]
+    ).copy() if trainer.callback_metrics else None
+
+    trainer2 = get_trainer(tmp_root, max_epochs=2, checkpoint_callback=False)
+    trainer2.fit(model)  # warm start from previous params
+    assert trainer2.current_epoch == 2
+    assert model.params is not None
+
+
+@pytest.mark.slow
+def test_repeated_fit_with_ray_strategy(tmp_root):
+    """Two launches in one session: worker groups spin up, run, tear down,
+    and spin up again cleanly (the reference's repeated-fit guarantee)."""
+    strategy = rlt.RayStrategy(num_workers=1, platform="cpu", devices_per_worker=2)
+    model = MNISTClassifier({"lr": 1e-2})
+    from ray_lightning_tpu.models.mnist import MNISTDataModule
+
+    dm = MNISTDataModule(batch_size=16, n_train=64, n_val=32)
+    trainer = get_trainer(tmp_root, max_epochs=1, strategy=strategy,
+                          checkpoint_callback=False, limit_train_batches=None)
+    trainer.fit(model, datamodule=dm)
+    loss1 = float(trainer.callback_metrics["ptl/val_loss"])
+
+    trainer2 = get_trainer(tmp_root, max_epochs=1, strategy=strategy,
+                           checkpoint_callback=False, limit_train_batches=None)
+    trainer2.fit(model, datamodule=dm)  # second launch, warm params
+    loss2 = float(trainer2.callback_metrics["ptl/val_loss"])
+    assert loss2 <= loss1 + 1e-3

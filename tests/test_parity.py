@@ -1,0 +1,76 @@
+"""Behavioral-parity tests mirroring specific reference test concerns
+(SURVEY §4 table): actor count observed from inside training, sampler
+injection semantics, delayed accelerator, resource overrides."""
+import os
+
+import pytest
+
+import ray_lightning_tpu as rlt
+from ray_lightning_tpu.accelerators import (
+    DelayedTPUAccelerator,
+    ensure_driver_off_accelerator,
+)
+from ray_lightning_tpu.core.data import DataLoader, RandomDataset
+from ray_lightning_tpu.strategies.ray_strategies import RayStrategy
+
+from tests.utils import BoringModel, get_trainer
+
+
+def test_sampler_injection_semantics(tmp_root):
+    """DistributedSampler kwargs: replicas = world size, rank = worker rank,
+    shuffle only for train (reference: tests/test_ddp.py:179-211)."""
+    strategy = RayStrategy(num_workers=4, platform="cpu")
+    trainer = get_trainer(tmp_root, strategy=strategy)
+    trainer._module = BoringModel()
+    loader = DataLoader(RandomDataset(32, 64), batch_size=8)
+
+    train_loader = trainer._maybe_shard_loader(loader, shuffle=True)
+    assert train_loader.sampler is not None
+    assert train_loader.sampler.num_replicas == 4
+    assert train_loader.sampler.rank == 0
+    assert train_loader.sampler.shuffle is True
+
+    val_loader = trainer._maybe_shard_loader(loader, shuffle=False)
+    assert val_loader.sampler.shuffle is False
+
+    # each rank sees a disjoint 1/4 shard
+    strategy._set_worker_context(2, 4)
+    shard2 = trainer._maybe_shard_loader(loader, shuffle=False)
+    assert shard2.sampler.rank == 2
+    idx0 = set(iter(val_loader.sampler))
+    idx2 = set(iter(shard2.sampler))
+    assert idx0.isdisjoint(idx2)
+    assert len(idx0) == 16
+
+
+def test_resources_per_worker_recorded():
+    s = RayStrategy(num_workers=2, num_cpus_per_worker=3,
+                    resources_per_worker={"CPU": 5})
+    assert s.resources_per_worker["CPU"] == 5
+    assert s.num_cpus_per_worker == 3
+
+
+def test_delayed_accelerator_driver_off_chip():
+    # under the test conftest the driver is already CPU: the pin reports ok
+    assert ensure_driver_off_accelerator() is True
+    assert DelayedTPUAccelerator.is_available() is True
+
+
+@pytest.mark.slow
+def test_actor_count_observed_from_training(tmp_root):
+    """Every expected worker actually runs the fit loop (reference:
+    tests/test_ddp.py:65-77 asserts actor count from inside a callback)."""
+    marker_dir = os.path.join(tmp_root, "markers")
+    os.makedirs(marker_dir, exist_ok=True)
+
+    class MarkingModel(BoringModel):
+        def on_train_start(self):
+            rank = os.environ.get("RLT_GLOBAL_RANK", "?")
+            open(os.path.join(marker_dir, f"worker_{rank}"), "w").close()
+
+    strategy = RayStrategy(num_workers=2, platform="cpu", devices_per_worker=1)
+    trainer = get_trainer(tmp_root, max_epochs=1, strategy=strategy,
+                          checkpoint_callback=False, limit_train_batches=2,
+                          limit_val_batches=1)
+    trainer.fit(MarkingModel())
+    assert sorted(os.listdir(marker_dir)) == ["worker_0", "worker_1"]

@@ -1,0 +1,60 @@
+"""PBT end-to-end (BASELINE config 4 shape: PBT sweep over a learning
+rate): bottom-quantile trials exploit a top trial's checkpoint and explore a
+mutated config via the __checkpoint_path__ contract."""
+import json
+import os
+
+import pytest
+
+from ray_lightning_tpu import tune as rlt_tune
+
+
+@pytest.mark.slow
+def test_pbt_exploits_and_improves(tmp_root):
+    """Trainable whose 'loss' depends directly on lr: PBT should migrate
+    the population toward the good lr and restore exploited state."""
+
+    def trainable(config):
+        from ray_lightning_tpu.tune.session import get_trial_session
+
+        sess = get_trial_session()
+        # restored trials resume from the donor's saved iteration count
+        state = {"it": 0}
+        ckpt = config.get("__checkpoint_path__")
+        if ckpt and os.path.exists(ckpt):
+            state = json.loads(open(ckpt).read())
+        for _ in range(6):
+            state["it"] += 1
+            sess.checkpoint(json.dumps(state).encode(), "state.json")
+            # loss improves with iterations, scaled by how good lr is
+            loss = 10.0 * config["lr"] + 1.0 / state["it"]
+            sess.report(loss=loss, lr=config["lr"])
+
+    scheduler = rlt_tune.PopulationBasedTraining(
+        metric="loss",
+        mode="min",
+        perturbation_interval=2,
+        hyperparam_mutations={"lr": rlt_tune.loguniform(1e-3, 1.0)},
+        quantile_fraction=0.34,
+        seed=0,
+    )
+    analysis = rlt_tune.run(
+        trainable,
+        config={"lr": rlt_tune.grid_search([0.001, 0.5, 0.9])},
+        metric="loss",
+        mode="min",
+        scheduler=scheduler,
+        local_dir=tmp_root,
+        name="pbt",
+        trial_env={"JAX_PLATFORMS": "cpu"},
+        max_concurrent_trials=3,
+        verbose=0,
+    )
+    assert analysis.best_config is not None
+    assert analysis.best_config["lr"] <= 0.01  # population found the low lr
+    # at least one trial exploited (checkpoint-path contract exercised)
+    exploited = [
+        t for t in analysis.trials if "__checkpoint_path__" in t.config
+    ]
+    statuses = {t.trial_id: t.status for t in analysis.trials}
+    assert all(s in ("TERMINATED", "STOPPED") for s in statuses.values()), statuses
