@@ -26,14 +26,27 @@ def ensure_driver_off_accelerator() -> bool:
     non-CPU backend was already live (too late to delay — caller should
     warn). Safe to call multiple times.
     """
-    try:
-        # jax exposes whether backends were created without creating one
-        initialized = jax._src.xla_bridge._backends  # noqa: SLF001
-        if initialized:
-            return jax.default_backend() == "cpu"
-    except Exception:
-        pass
+    # probe without creating a backend; prefer the semi-public helper,
+    # fall back to the registry dict, and treat an unreadable probe as
+    # "unknown" rather than "safe"
+    initialized = None
+    for probe in (
+        lambda: jax._src.xla_bridge.backends_are_initialized(),  # noqa: SLF001
+        lambda: bool(jax._src.xla_bridge._backends),  # noqa: SLF001
+    ):
+        try:
+            initialized = bool(probe())
+            break
+        except Exception:
+            continue
+    if initialized:
+        return jax.default_backend() == "cpu"
     jax.config.update("jax_platforms", "cpu")
+    if initialized is None:
+        # probes unavailable (jax internals moved): the pin was applied but
+        # we cannot prove no backend pre-existed — report success only if
+        # the config stuck
+        return jax.config.jax_platforms == "cpu"
     return True
 
 

@@ -54,17 +54,26 @@ def process_results(futures: List[rt.CallFuture], queue=None) -> List[Any]:
     whichever future settles first is a race; only the process failure is
     the retryable root cause."""
     remaining = list(futures)
-    first_error: Optional[rt.ActorError] = None
+    first_error: Optional[Exception] = None
+
+    def check(fut) -> None:
+        """Raise immediately on a process failure; record anything else."""
+        nonlocal first_error
+        try:
+            fut.result()
+        except rt.ActorError as e:
+            if e.is_process_failure:
+                raise
+            if first_error is None:
+                first_error = e
+        except Exception as e:  # non-actor errors must not mask the root cause
+            if first_error is None:
+                first_error = e
+
     while remaining:
         ready, remaining = rt.wait(remaining, num_returns=1, timeout=0.1)
         for fut in ready:
-            try:
-                fut.result()
-            except rt.ActorError as e:
-                if e.is_process_failure:
-                    raise
-                if first_error is None:
-                    first_error = e
+            check(fut)
         if first_error is not None:
             # grace window: let the crashed peer's connection-loss surface
             # so the failure classifies as retryable
@@ -72,11 +81,7 @@ def process_results(futures: List[rt.CallFuture], queue=None) -> List[Any]:
             while remaining and time.monotonic() < deadline:
                 ready, remaining = rt.wait(remaining, num_returns=1, timeout=0.2)
                 for fut in ready:
-                    try:
-                        fut.result()
-                    except rt.ActorError as e:
-                        if e.is_process_failure:
-                            raise
+                    check(fut)
             raise first_error
         _drain_queue(queue)
     _drain_queue(queue)

@@ -44,6 +44,11 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     attn_impl: Optional[str] = None  # None=auto, "flash", "reference"
+    # mixture-of-experts MLP (0 = dense); experts shard over the 'ep' axis
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.5
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -65,11 +70,15 @@ class LlamaConfig:
 
     def num_params(self) -> int:
         d, f, v = self.dim, self.ffn_dim, self.vocab_size
+        if self.n_experts:
+            mlp = d * self.n_experts + 3 * self.n_experts * d * f  # router+experts
+        else:
+            mlp = 3 * d * f  # gate, up, down
         per_layer = (
             d * (self.n_heads * self.head_dim)  # wq
             + 2 * d * (self.n_kv_heads * self.head_dim)  # wk, wv
             + (self.n_heads * self.head_dim) * d  # wo
-            + 3 * d * f  # gate, up, down
+            + mlp
             + 2 * d  # norms
         )
         return v * d * 2 + self.n_layers * per_layer + d
@@ -95,6 +104,13 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def tiny_moe() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=512, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=256, max_seq=128, remat=False, n_experts=4,
+        )
+
+    @staticmethod
     def llama3_8b() -> "LlamaConfig":
         return LlamaConfig(
             vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
@@ -115,7 +131,7 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
         return (jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)).astype(dt)
 
     L = cfg.n_layers
-    lk = jax.random.split(k_layers, 7)
+    lk = jax.random.split(k_layers, 8)
     layers = {
         "attn_norm": jnp.ones((L, d), dt),
         "wq": dense(lk[0], (L, d, cfg.n_heads * hd), d),
@@ -123,10 +139,19 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
         "wv": dense(lk[2], (L, d, cfg.n_kv_heads * hd), d),
         "wo": dense(lk[3], (L, cfg.n_heads * hd, d), cfg.n_heads * hd),
         "mlp_norm": jnp.ones((L, d), dt),
-        "w_gate": dense(lk[4], (L, d, cfg.ffn_dim), d),
-        "w_up": dense(lk[5], (L, d, cfg.ffn_dim), d),
-        "w_down": dense(lk[6], (L, cfg.ffn_dim, d), cfg.ffn_dim),
     }
+    if cfg.n_experts:
+        from ray_lightning_tpu.parallel.moe import init_moe_params
+
+        layers["moe"] = init_moe_params(
+            lk[4], d, cfg.ffn_dim, cfg.n_experts, dtype=dt, n_layers=L
+        )
+    else:
+        layers.update(
+            w_gate=dense(lk[4], (L, d, cfg.ffn_dim), d),
+            w_up=dense(lk[5], (L, d, cfg.ffn_dim), d),
+            w_down=dense(lk[6], (L, cfg.ffn_dim, d), cfg.ffn_dim),
+        )
     return {
         "embed": dense(k_embed, (cfg.vocab_size, d), d),
         "layers": layers,
@@ -140,22 +165,30 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
     column-parallel in-projections, row-parallel out-projections; fsdp
     shards the other big axis. Specs reference axis names that may or may
     not exist in a given mesh; filter with :func:`shardings_for_mesh`."""
+    layer_specs = {
+        "attn_norm": P(None, None),
+        "wq": P(None, "fsdp", "tp"),
+        "wk": P(None, "fsdp", "tp"),
+        "wv": P(None, "fsdp", "tp"),
+        "wo": P(None, "tp", "fsdp"),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.n_experts:
+        from ray_lightning_tpu.parallel.moe import moe_param_specs
+
+        layer_specs["moe"] = moe_param_specs(n_layers=cfg.n_layers)
+    else:
+        layer_specs.update(
+            w_gate=P(None, "fsdp", "tp"),
+            w_up=P(None, "fsdp", "tp"),
+            w_down=P(None, "tp", "fsdp"),
+        )
     return {
         # vocab axis replicated: token gather must stay local (a
         # vocab-sharded gather forces involuntary full remat in SPMD);
         # the model dim shards over both axes instead
         "embed": P(None, ("fsdp", "tp")),
-        "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, "fsdp", "tp"),
-            "wk": P(None, "fsdp", "tp"),
-            "wv": P(None, "fsdp", "tp"),
-            "wo": P(None, "tp", "fsdp"),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, "fsdp", "tp"),
-            "w_up": P(None, "fsdp", "tp"),
-            "w_down": P(None, "tp", "fsdp"),
-        },
+        "layers": layer_specs,
         "final_norm": P(None),
         "lm_head": P("fsdp", "tp"),
     }
@@ -237,31 +270,46 @@ def forward(
         att = att.swapaxes(1, 2).reshape(B, S, cfg.n_heads * hd)
         x = x + att @ lp["wo"]
         h2 = rmsnorm(x, lp["mlp_norm"])
-        gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
-        x = x + gated @ lp["w_down"]
+        if cfg.n_experts:
+            from ray_lightning_tpu.parallel.moe import moe_ffn
+
+            moe_out, aux = moe_ffn(
+                lp["moe"], h2, top_k=cfg.expert_top_k,
+                capacity_factor=cfg.capacity_factor,
+            )
+            x = x + moe_out
+        else:
+            gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
+            x = x + gated @ lp["w_down"]
+            aux = jnp.float32(0.0)
         x = _act_constraint(x, mesh, ("dp", "fsdp"), "sp", None)
-        return x, None
+        return x, aux
 
     scanned = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
-    x, _ = jax.lax.scan(scanned, x, params["layers"])
+    x, aux_losses = jax.lax.scan(scanned, x, params["layers"])
     x = rmsnorm(x, params["final_norm"])
     logits = x @ params["lm_head"]
-    return logits
+    return logits, jnp.mean(aux_losses)
 
 
 def lm_loss(
     params, tokens, cfg: LlamaConfig, mesh: Optional[Mesh] = None
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Next-token cross entropy. The full sequence is fed (so sequence
-    sharding stays divisible) and the last position is masked out."""
-    logits = forward(params, tokens, cfg, mesh)
+    sharding stays divisible) and the last position is masked out. MoE
+    configs add the weighted load-balancing auxiliary loss."""
+    logits, moe_aux = forward(params, tokens, cfg, mesh)
     targets = jnp.roll(tokens, -1, axis=1)
     losses = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), targets
     )
     mask = jnp.ones_like(losses).at[:, -1].set(0.0)
-    loss = jnp.sum(losses * mask) / jnp.sum(mask)
-    return loss, {"loss": loss, "ppl": jnp.exp(loss)}
+    ce = jnp.sum(losses * mask) / jnp.sum(mask)
+    loss = ce + (cfg.moe_aux_weight * moe_aux if cfg.n_experts else 0.0)
+    logs = {"loss": loss, "ppl": jnp.exp(ce)}
+    if cfg.n_experts:
+        logs["moe_aux"] = moe_aux
+    return loss, logs
 
 
 # --------------------------------------------------------------------- #
@@ -308,15 +356,20 @@ class LlamaModule(LightningModule):
         loss, logs = lm_loss(params, self._tokens_of(batch), self.config, self.mesh)
         self.log("train_loss", loss, on_step=True, on_epoch=True)
         self.log("train_ppl", logs["ppl"], on_step=True, on_epoch=False)
+        if "moe_aux" in logs:
+            self.log("moe_aux", logs["moe_aux"], on_step=False, on_epoch=True)
         return loss
 
     def validation_step(self, params, batch, batch_idx):
         loss, logs = lm_loss(params, self._tokens_of(batch), self.config, self.mesh)
         self.log("val_loss", loss)
         self.log("val_ppl", logs["ppl"])
+        if "moe_aux" in logs:
+            self.log("moe_aux", logs["moe_aux"])
 
     def predict_step(self, params, batch, batch_idx):
-        return forward(params, self._tokens_of(batch), self.config, self.mesh)
+        logits, _ = forward(params, self._tokens_of(batch), self.config, self.mesh)
+        return logits
 
     def configure_optimizers(self):
         schedule = optax.warmup_cosine_decay_schedule(

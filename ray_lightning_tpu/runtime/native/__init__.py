@@ -91,6 +91,22 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 return None
         try:
             _lib = _configure(ctypes.CDLL(_SO))
+        except AttributeError:
+            # stale .so from an older source revision (missing a newly
+            # added symbol): rebuild once, then fall back cleanly
+            try:
+                subprocess.run(
+                    ["make", "-C", _HERE, "clean"], check=True,
+                    capture_output=True, timeout=30,
+                )
+                subprocess.run(
+                    ["make", "-C", _HERE], check=True,
+                    capture_output=True, timeout=120,
+                )
+                _lib = _configure(ctypes.CDLL(_SO))
+            except Exception:
+                _build_failed = True
+                return None
         except OSError:
             _build_failed = True
             return None

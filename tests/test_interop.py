@@ -2,6 +2,7 @@
 dataloaders/datasets feed the JAX step; fit() can be called repeatedly in
 one process (the reference's headline advantage over PTL's own spawn,
 README "Calling fit or test multiple times in the same script")."""
+import jax
 import numpy as np
 import pytest
 
@@ -55,14 +56,18 @@ def test_repeated_fit_same_process(tmp_root):
     model = BoringModel()
     trainer = get_trainer(tmp_root, max_epochs=1, checkpoint_callback=False)
     trainer.fit(model)
-    first = np.asarray(
-        list(trainer.callback_metrics.values())[0]
-    ).copy() if trainer.callback_metrics else None
+    params_after_first = jax.device_get(model.params)
 
     trainer2 = get_trainer(tmp_root, max_epochs=2, checkpoint_callback=False)
     trainer2.fit(model)  # warm start from previous params
     assert trainer2.current_epoch == 2
-    assert model.params is not None
+    # the second fit continued from (not re-initialized) the first's params
+    delta = jax.tree_util.tree_map(
+        lambda a, b: np.max(np.abs(np.asarray(a) - np.asarray(b))),
+        jax.device_get(model.params),
+        params_after_first,
+    )
+    assert max(jax.tree_util.tree_leaves(delta)) > 0.0
 
 
 @pytest.mark.slow

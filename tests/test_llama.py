@@ -24,8 +24,9 @@ def test_forward_shapes():
     cfg = LlamaConfig.tiny()
     params = init_params(jax.random.key(0), cfg)
     tokens = jnp.zeros((2, cfg.max_seq), jnp.int32)
-    logits = jax.jit(lambda p, t: forward(p, t, cfg))(params, tokens)
+    logits, aux = jax.jit(lambda p, t: forward(p, t, cfg))(params, tokens)
     assert logits.shape == (2, cfg.max_seq, cfg.vocab_size)
+    assert float(aux) == 0.0  # dense config has no MoE aux loss
 
 
 def test_param_count_formula():
@@ -107,3 +108,33 @@ def test_graft_entry_contract():
     fn, args = graft.entry()
     out = jax.jit(fn)(*args)
     assert out.ndim == 3
+
+
+def test_moe_llama_trains(tmp_root):
+    """The MoE flagship variant (expert-parallel MLP, aux loss) trains and
+    the aux loss is logged."""
+    cfg = LlamaConfig.tiny_moe()
+    module = LlamaModule(cfg, lr=3e-3, warmup_steps=2, total_steps=100)
+    dm = SyntheticLMDataModule(cfg, batch_size=8, n_train=64)
+    trainer = get_trainer(tmp_root, max_epochs=1, limit_train_batches=None,
+                          checkpoint_callback=False)
+    trainer.fit(module, datamodule=dm)
+    assert "val_loss" in trainer.callback_metrics
+    assert "moe_aux" in trainer.callback_metrics
+
+
+def test_moe_llama_ep_mesh(tmp_root):
+    """MoE flagship on a mesh with an 'ep' axis: expert weights shard over
+    ep, the dispatch einsums become all-to-alls."""
+    cfg = LlamaConfig.tiny_moe()
+    strategy = rlt.XLAStrategy(
+        mesh_spec=MeshSpec(axes={"dp": 2, "ep": 4}),
+        sharding_policy=ShardingPolicy(data_axes=("dp",)),
+    )
+    module = LlamaModule(cfg, lr=3e-3)
+    dm = SyntheticLMDataModule(cfg, batch_size=8, n_train=32)
+    trainer = get_trainer(tmp_root, max_epochs=1, strategy=strategy,
+                          limit_train_batches=None, checkpoint_callback=False)
+    trainer.fit(module, datamodule=dm)
+    spec = trainer.params["layers"]["moe"]["w_gate"].sharding.spec
+    assert "ep" in str(spec)

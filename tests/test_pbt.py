@@ -15,6 +15,8 @@ def test_pbt_exploits_and_improves(tmp_root):
     the population toward the good lr and restore exploited state."""
 
     def trainable(config):
+        import time
+
         from ray_lightning_tpu.tune.session import get_trial_session
 
         sess = get_trial_session()
@@ -29,6 +31,9 @@ def test_pbt_exploits_and_improves(tmp_root):
             # loss improves with iterations, scaled by how good lr is
             loss = 10.0 * config["lr"] + 1.0 / state["it"]
             sess.report(loss=loss, lr=config["lr"])
+            # pace reports so the controller can act mid-trial (real
+            # training steps are far slower than the poll interval)
+            time.sleep(0.4)
 
     scheduler = rlt_tune.PopulationBasedTraining(
         metric="loss",
@@ -52,9 +57,11 @@ def test_pbt_exploits_and_improves(tmp_root):
     )
     assert analysis.best_config is not None
     assert analysis.best_config["lr"] <= 0.01  # population found the low lr
-    # at least one trial exploited (checkpoint-path contract exercised)
+    # the exploit path actually ran: some trial restarted from a donor
+    # checkpoint (the __checkpoint_path__ contract)
     exploited = [
         t for t in analysis.trials if "__checkpoint_path__" in t.config
     ]
+    assert exploited, "no trial exploited a donor checkpoint"
     statuses = {t.trial_id: t.status for t in analysis.trials}
     assert all(s in ("TERMINATED", "STOPPED") for s in statuses.values()), statuses
