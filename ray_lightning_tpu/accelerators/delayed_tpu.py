@@ -44,9 +44,9 @@ def ensure_driver_off_accelerator() -> bool:
     jax.config.update("jax_platforms", "cpu")
     if initialized is None:
         # probes unavailable (jax internals moved): the pin was applied but
-        # we cannot prove no backend pre-existed — report success only if
-        # the config stuck
-        return jax.config.jax_platforms == "cpu"
+        # we cannot prove no backend pre-existed — report failure so the
+        # caller warns rather than trusting an unverifiable pin
+        return False
     return True
 
 

@@ -357,7 +357,7 @@ class LlamaModule(LightningModule):
         self.log("train_loss", loss, on_step=True, on_epoch=True)
         self.log("train_ppl", logs["ppl"], on_step=True, on_epoch=False)
         if "moe_aux" in logs:
-            self.log("moe_aux", logs["moe_aux"], on_step=False, on_epoch=True)
+            self.log("train_moe_aux", logs["moe_aux"], on_step=False, on_epoch=True)
         return loss
 
     def validation_step(self, params, batch, batch_idx):
@@ -365,7 +365,7 @@ class LlamaModule(LightningModule):
         self.log("val_loss", loss)
         self.log("val_ppl", logs["ppl"])
         if "moe_aux" in logs:
-            self.log("moe_aux", logs["moe_aux"])
+            self.log("val_moe_aux", logs["moe_aux"])
 
     def predict_step(self, params, batch, batch_idx):
         logits, _ = forward(params, self._tokens_of(batch), self.config, self.mesh)

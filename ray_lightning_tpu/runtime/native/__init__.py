@@ -67,25 +67,32 @@ def get_lib() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO):
-            # cross-process build guard: compile under an flock so N
-            # simultaneously-starting processes don't write the same .so
-            try:
-                import fcntl
 
-                lock_path = os.path.join(_HERE, ".build.lock")
-                with open(lock_path, "w") as lock_file:
-                    fcntl.flock(lock_file, fcntl.LOCK_EX)
-                    try:
-                        if not os.path.exists(_SO):
-                            subprocess.run(
-                                ["make", "-C", _HERE],
-                                check=True,
-                                capture_output=True,
-                                timeout=120,
-                            )
-                    finally:
-                        fcntl.flock(lock_file, fcntl.LOCK_UN)
+        def build(clean: bool) -> None:
+            # cross-process guard: compile under an flock so N
+            # simultaneously-starting processes don't clobber the same .so
+            import fcntl
+
+            lock_path = os.path.join(_HERE, ".build.lock")
+            with open(lock_path, "w") as lock_file:
+                fcntl.flock(lock_file, fcntl.LOCK_EX)
+                try:
+                    if clean:
+                        subprocess.run(
+                            ["make", "-C", _HERE, "clean"], check=True,
+                            capture_output=True, timeout=30,
+                        )
+                    if not os.path.exists(_SO):
+                        subprocess.run(
+                            ["make", "-C", _HERE], check=True,
+                            capture_output=True, timeout=120,
+                        )
+                finally:
+                    fcntl.flock(lock_file, fcntl.LOCK_UN)
+
+        if not os.path.exists(_SO):
+            try:
+                build(clean=False)
             except Exception:
                 _build_failed = True
                 return None
@@ -93,16 +100,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
             _lib = _configure(ctypes.CDLL(_SO))
         except AttributeError:
             # stale .so from an older source revision (missing a newly
-            # added symbol): rebuild once, then fall back cleanly
+            # added symbol): rebuild once under the lock, then fall back
             try:
-                subprocess.run(
-                    ["make", "-C", _HERE, "clean"], check=True,
-                    capture_output=True, timeout=30,
-                )
-                subprocess.run(
-                    ["make", "-C", _HERE], check=True,
-                    capture_output=True, timeout=120,
-                )
+                build(clean=True)
                 _lib = _configure(ctypes.CDLL(_SO))
             except Exception:
                 _build_failed = True

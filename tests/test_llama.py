@@ -120,7 +120,8 @@ def test_moe_llama_trains(tmp_root):
                           checkpoint_callback=False)
     trainer.fit(module, datamodule=dm)
     assert "val_loss" in trainer.callback_metrics
-    assert "moe_aux" in trainer.callback_metrics
+    assert "val_moe_aux" in trainer.callback_metrics
+    assert "train_moe_aux" in trainer.callback_metrics
 
 
 def test_moe_llama_ep_mesh(tmp_root):
