@@ -6,30 +6,49 @@ Metric is the north-star from BASELINE.json — LightningModule tokens/sec/chip
 on a full training step (fwd + bwd + adamw, bf16, remat, flash attention).
 The reference publishes no numbers (BASELINE.md), so vs_baseline is measured
 MFU relative to the 40% MFU target BASELINE.md sets for the stretch config.
+
+Robustness contract (the part rounds are judged on): this script must emit a
+JSON line and exit 0 even when the TPU backend is wedged — backend init here
+can hang *forever*, not just fail. Structure:
+
+  orchestrator (this process, never imports jax)
+    ├─ probe child  (--_probe): jax.devices + tiny matmul, short timeout
+    ├─ bench child  (--_child): the actual measurement, generous timeout
+    └─ CPU fallback (--_child --platform cpu): config-level platform pin,
+       tiny preset, result labeled platform=cpu + "error" explaining why
+
+Timeouts via env: RLT_BENCH_PROBE_TIMEOUT (default 150s),
+RLT_BENCH_TIMEOUT (default 1500s).
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 
-def main() -> int:
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--preset", default="mini", choices=["tiny", "mini"])
-    parser.add_argument("--batch", type=int, default=None)
-    parser.add_argument("--steps", type=int, default=10)
-    parser.add_argument("--warmup", type=int, default=2)
-    args = parser.parse_args()
+def _probe() -> int:
+    """Child: touch the native backend; print its platform if alive."""
+    import jax
+    import jax.numpy as jnp
 
-    import os
+    dev = jax.devices()[0]
+    x = jnp.ones((512, 512), jnp.bfloat16)
+    (x @ x).block_until_ready()
+    print(json.dumps({"platform": dev.platform}))
+    return 0
 
+
+def _child(args: argparse.Namespace) -> int:
+    """Child: run the measurement and print one JSON line."""
     import jax
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
+    if args.platform == "cpu" or os.environ.get("JAX_PLATFORMS") == "cpu":
         # the image's sitecustomize prepends its TPU plugin to jax_platforms
-        # regardless of env; honor an explicit CPU request at config level
+        # regardless of env; only a config-level pin keeps us off the backend
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
@@ -105,6 +124,147 @@ def main() -> int:
             "device_kind": getattr(dev, "device_kind", "?"),
         },
     }
+    print(json.dumps(result))
+    return 0
+
+
+def _last_json_dict(stdout: str):
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict):
+            return parsed
+    return None
+
+
+def _run(cmd: list, timeout: float, env: dict) -> tuple:
+    """Run a child; return (ok, last_json_or_None, error_string_or_None).
+
+    stdout/stderr go to temp files, not pipes: a grandchild holding an
+    inherited pipe fd (or a child wedged in uninterruptible device I/O that
+    SIGKILL cannot reap) must never block the orchestrator on a drain. The
+    child runs in its own session so the whole process group can be killed.
+    """
+    import signal
+    import tempfile
+
+    with tempfile.TemporaryFile(mode="w+") as out_f, \
+            tempfile.TemporaryFile(mode="w+") as err_f:
+        proc = subprocess.Popen(
+            cmd, stdout=out_f, stderr=err_f, env=env,
+            start_new_session=True,
+        )
+        timed_out = False
+        try:
+            rc = proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                rc = proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                rc = -9  # unreapable (D-state); files are still readable
+        out_f.seek(0)
+        stdout = out_f.read()
+        err_f.seek(0)
+        stderr = err_f.read()
+    result = _last_json_dict(stdout)
+    if timed_out:
+        return False, None, f"timeout after {timeout:.0f}s"
+    if rc != 0:
+        # a child may print a valid result and then die in backend teardown;
+        # keep the measurement rather than rerunning on CPU
+        if result is not None and "metric" in result:
+            return True, result, None
+        tail = (stderr or stdout or "").strip().splitlines()[-6:]
+        return False, None, f"rc={rc}: " + " | ".join(tail)
+    if result is None:
+        return False, None, "child produced no JSON"
+    return True, result, None
+
+
+def _fail_result(detail: dict) -> dict:
+    return {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "detail": dict(detail, platform="none"),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", default="mini", choices=["tiny", "mini"])
+    parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--warmup", type=int, default=2)
+    parser.add_argument("--platform", default=None, choices=[None, "cpu", "native"])
+    parser.add_argument("--_probe", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
+    args = parser.parse_args()
+
+    if args._probe:
+        return _probe()
+    if args._child:
+        return _child(args)
+
+    def _env_timeout(name: str, default: float) -> float:
+        try:
+            return float(os.environ.get(name, default))
+        except ValueError:
+            return default
+
+    probe_timeout = _env_timeout("RLT_BENCH_PROBE_TIMEOUT", 150.0)
+    bench_timeout = _env_timeout("RLT_BENCH_TIMEOUT", 1500.0)
+    here = os.path.abspath(__file__)
+    env = dict(os.environ)
+    passthrough = [
+        "--preset", args.preset, "--steps", str(args.steps),
+        "--warmup", str(args.warmup),
+    ] + (["--batch", str(args.batch)] if args.batch else [])
+
+    error = None
+    # explicit --platform beats the ambient env var
+    force_cpu = args.platform == "cpu" or (
+        args.platform != "native" and env.get("JAX_PLATFORMS") == "cpu"
+    )
+    if not force_cpu:
+        ok, _, perr = _run(
+            [sys.executable, here, "--_probe"], probe_timeout, env
+        )
+        if ok:
+            ok, result, berr = _run(
+                [sys.executable, here, "--_child"] + passthrough,
+                bench_timeout, env,
+            )
+            if ok:
+                print(json.dumps(result))
+                return 0
+            error = f"native bench failed ({berr})"
+        else:
+            error = f"native backend probe failed ({perr})"
+        if args.platform == "native":
+            # explicit native pin: fail honestly instead of a silent CPU run
+            print(json.dumps(_fail_result({"error": error})))
+            return 0
+        error += "; CPU fallback"
+
+    cpu_env = dict(env)
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    ok, result, cerr = _run(
+        [sys.executable, here, "--_child", "--platform", "cpu"] + passthrough,
+        bench_timeout, cpu_env,
+    )
+    if not ok:
+        result = _fail_result({"cpu_error": cerr})
+    if error:
+        result.setdefault("detail", {})["error"] = error
     print(json.dumps(result))
     return 0
 
