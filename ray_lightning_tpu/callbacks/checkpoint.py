@@ -78,6 +78,15 @@ class ModelCheckpoint(Callback):
             score = None
 
         path = os.path.join(self.dirpath, self._format_name(trainer, metrics))
+        if score is not None and path in self.best_k_models:
+            # filename lacks {epoch}/{step} tokens: de-duplicate like PTL
+            # (-v1, -v2, ...) so top-k accounting never collapses onto one
+            # path / silently overwrites the previous best
+            stem = path[: -len(self.CHECKPOINT_EXT)]
+            version = 1
+            while f"{stem}-v{version}{self.CHECKPOINT_EXT}" in self.best_k_models:
+                version += 1
+            path = f"{stem}-v{version}{self.CHECKPOINT_EXT}"
 
         if score is None:
             # unmonitored: keep only the newest checkpoint (PTL save_top_k=1

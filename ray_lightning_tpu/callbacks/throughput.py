@@ -46,13 +46,22 @@ class ThroughputMonitor(Callback):
         tokens_per_sample: Optional[int] = None,
         window: int = 20,
         log_every_n_steps: int = 0,
+        sync_every: int = 4,
     ):
         self.flops_per_sample = flops_per_sample
         self.tokens_per_sample = tokens_per_sample
         self.window = window
         self.log_every_n_steps = log_every_n_steps
-        self._times: list = []
-        self._t0: Optional[float] = None
+        # JAX dispatch is async: a per-step timestamp records enqueue time,
+        # which is wildly optimistic until the pipeline backpressures. But a
+        # per-step device sync would serialize host and device for the whole
+        # run. Compromise: block on the outputs once every `sync_every`
+        # steps and record the interval's MEAN step time — honest numbers,
+        # 1/sync_every of the stall.
+        self.sync_every = max(1, sync_every)
+        self._times: list = []  # per-interval mean step times
+        self._last_sync_t: Optional[float] = None
+        self._steps_since_sync = 0
         self._batch_size: Optional[int] = None
 
     @staticmethod
@@ -62,15 +71,25 @@ class ThroughputMonitor(Callback):
 
     def on_train_batch_start(self, trainer, module, batch, batch_idx) -> None:
         self._batch_size = self._infer_batch_size(batch)
-        self._t0 = time.perf_counter()
+
+    def _record_interval(self, now: float) -> None:
+        if self._last_sync_t is not None and self._steps_since_sync:
+            self._times.append(
+                (now - self._last_sync_t) / self._steps_since_sync
+            )
+            if len(self._times) > self.window:
+                self._times.pop(0)
+        self._last_sync_t = now
+        self._steps_since_sync = 0
 
     def on_train_batch_end(self, trainer, module, outputs, batch, batch_idx) -> None:
-        if self._t0 is None:
+        self._steps_since_sync += 1
+        if self._steps_since_sync < self.sync_every:
             return
-        dt = time.perf_counter() - self._t0
-        self._times.append(dt)
-        if len(self._times) > self.window:
-            self._times.pop(0)
+        leaves = jax.tree_util.tree_leaves(outputs)
+        if leaves:
+            jax.block_until_ready(leaves)
+        self._record_interval(time.perf_counter())
         if (
             self.log_every_n_steps
             and trainer.global_step % self.log_every_n_steps == 0
@@ -81,9 +100,10 @@ class ThroughputMonitor(Callback):
     def summary(self, trainer) -> dict:
         if not self._times or not self._batch_size:
             return {}
-        # skip the first (compile-laden) step when possible
-        times = self._times[1:] if len(self._times) > 1 else self._times
-        step_time = float(np.mean(times))
+        # the first interval absorbs compilation only when training started
+        # there; _record_interval never measures from t=0, so all retained
+        # intervals are steady-state
+        step_time = float(np.mean(self._times))
         n_chips = max(1, trainer.world_size * jax.local_device_count())
         global_batch = self._batch_size * max(1, trainer.world_size)
         out = {

@@ -23,7 +23,7 @@ from __future__ import annotations
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -101,12 +101,12 @@ class Trainer:
         default_root_dir: Optional[str] = None,
         log_every_n_steps: int = 50,
         check_val_every_n_epoch: int = 1,
-        val_check_interval: Optional[int] = None,
+        val_check_interval: Optional[Union[int, float]] = None,
         num_sanity_val_steps: int = 0,
-        limit_train_batches: Optional[int] = None,
-        limit_val_batches: Optional[int] = None,
-        limit_test_batches: Optional[int] = None,
-        limit_predict_batches: Optional[int] = None,
+        limit_train_batches: Optional[Union[int, float]] = None,
+        limit_val_batches: Optional[Union[int, float]] = None,
+        limit_test_batches: Optional[Union[int, float]] = None,
+        limit_predict_batches: Optional[Union[int, float]] = None,
         gradient_clip_val: Optional[float] = None,
         accumulate_grad_batches: int = 1,
         precision: str = "32-true",
@@ -120,6 +120,24 @@ class Trainer:
         self.max_steps = max_steps
         self.log_every_n_steps = log_every_n_steps
         self.check_val_every_n_epoch = check_val_every_n_epoch
+        # PTL semantics: ints are batch/step counts, floats are fractions of
+        # the epoch (reference inherits this from PTL 1.6 Trainer args)
+        for _name in (
+            "val_check_interval",
+            "limit_train_batches",
+            "limit_val_batches",
+            "limit_test_batches",
+            "limit_predict_batches",
+        ):
+            _v = locals()[_name]
+            if _v is not None and not isinstance(_v, int):
+                if not isinstance(_v, float):
+                    raise TypeError(f"{_name} must be int, float, or None, got {_v!r}")
+                if not 0.0 <= _v <= 1.0:
+                    raise ValueError(
+                        f"{_name}={_v}: float values are epoch fractions and "
+                        "must be in [0.0, 1.0]; pass an int for a batch count"
+                    )
         self.val_check_interval = val_check_interval
         self.num_sanity_val_steps = num_sanity_val_steps
         self.limit_train_batches = limit_train_batches
@@ -523,15 +541,45 @@ class Trainer:
         self.val_enabled = val_loader is not None
         self._val_ran_this_epoch = False
         self.num_val_batches = (
-            self._loader_len(val_loader, self.limit_val_batches) if val_loader else 0
+            self._loader_len(val_loader, self.limit_val_batches, "limit_val_batches")
+            if val_loader
+            else 0
         )
         self._hook("on_train_epoch_start")
         aggregator = _EpochAggregator()
         t_epoch = time.perf_counter()
         n_batches = 0
+        limit_train = self._resolve_limit(
+            self.limit_train_batches, train_loader, "limit_train_batches"
+        )
+        # float val_check_interval = validate every fraction of this epoch's
+        # train batches (PTL); int = every N global steps. Like PTL, the
+        # fractional path still honors check_val_every_n_epoch.
+        val_every_n_batches = None
+        if (
+            isinstance(self.val_check_interval, float)
+            and val_loader is not None
+            and (self.current_epoch + 1) % self.check_val_every_n_epoch == 0
+        ):
+            n_train = self._loader_len(
+                train_loader, limit_train, "limit_train_batches"
+            )
+            if not n_train:
+                raise ValueError(
+                    f"val_check_interval={self.val_check_interval}: a float "
+                    "fraction requires a sized train dataloader"
+                )
+            val_every_n_batches = int(n_train * self.val_check_interval)
+            if val_every_n_batches == 0:
+                raise ValueError(
+                    f"val_check_interval={self.val_check_interval} of a "
+                    f"{n_train}-batch epoch resolves to every 0 batches; "
+                    f"use a fraction >= {1.0 / n_train:.4g} or an int step "
+                    "interval"
+                )
 
         for batch_idx, batch in enumerate(train_loader):
-            if self.limit_train_batches is not None and batch_idx >= self.limit_train_batches:
+            if limit_train is not None and batch_idx >= limit_train:
                 break
             device_batch = self.strategy.shard_batch(batch)
             self._cb("on_train_batch_start", batch, batch_idx)
@@ -548,10 +596,16 @@ class Trainer:
             self.global_step += 1
             n_batches += 1
 
-            if (
-                self.val_check_interval
-                and val_loader is not None
-                and self.global_step % self.val_check_interval == 0
+            if val_loader is not None and (
+                (
+                    val_every_n_batches is not None
+                    and (batch_idx + 1) % val_every_n_batches == 0
+                )
+                or (
+                    isinstance(self.val_check_interval, int)
+                    and self.val_check_interval
+                    and self.global_step % self.val_check_interval == 0
+                )
             ):
                 self._run_validation(val_loader, val_step)
 
@@ -638,6 +692,7 @@ class Trainer:
         if hasattr(loader, "set_epoch"):
             loader.set_epoch(self.current_epoch)
         aggregator = _EpochAggregator()
+        limit = self._resolve_limit(limit, loader, f"limit_{phase}_batches")
         for batch_idx, batch in enumerate(loader):
             if limit is not None and batch_idx >= limit:
                 break
@@ -664,12 +719,31 @@ class Trainer:
         return 1
 
     @staticmethod
-    def _loader_len(loader, limit) -> int:
+    def _resolve_limit(limit, loader, name: str):
+        """PTL semantics: int = batch count, float = fraction of len(loader)."""
+        if limit is None or isinstance(limit, int):
+            return limit
+        try:
+            n = len(loader)
+        except TypeError:
+            raise ValueError(
+                f"{name}={limit}: a float fraction requires a sized dataloader"
+            )
+        resolved = int(n * limit)
+        if resolved == 0 and limit > 0.0:
+            raise ValueError(
+                f"{name}={limit} of a {n}-batch dataloader resolves to 0 "
+                "batches; use a larger fraction or an int batch count"
+            )
+        return resolved
+
+    def _loader_len(self, loader, limit, name: str = "limit") -> int:
         try:
             n = len(loader)
         except TypeError:
             n = 0
-        if limit is not None:
+        limit = self._resolve_limit(limit, loader, name) if n else limit
+        if isinstance(limit, int):
             n = min(n, limit)
         return n
 
@@ -745,11 +819,11 @@ class Trainer:
 
         self._cb("on_predict_start")
         outputs = []
+        limit_predict = self._resolve_limit(
+            self.limit_predict_batches, loader, "limit_predict_batches"
+        )
         for batch_idx, batch in enumerate(loader):
-            if (
-                self.limit_predict_batches is not None
-                and batch_idx >= self.limit_predict_batches
-            ):
+            if limit_predict is not None and batch_idx >= limit_predict:
                 break
             device_batch = self.strategy.shard_batch(batch)
             out = predict_step(self._params, device_batch, np.int32(batch_idx))

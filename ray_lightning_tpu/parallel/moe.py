@@ -54,8 +54,8 @@ def moe_ffn(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """x: [B, S, D] -> (out [B, S, D], aux_loss scalar).
 
-    aux_loss is the standard load-balancing loss (mean gate fraction x mean
-    dispatch fraction x n_experts).
+    aux_loss is the Switch-Transformer load-balancing loss: n_experts x
+    sum_i(mean gate probability_i x raw pre-capacity assignment fraction_i).
     """
     b, s, d = x.shape
     e = params["router"].shape[-1]
@@ -92,8 +92,11 @@ def moe_ffn(
     expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_down"])  # [E, C, D]
     out = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
 
-    # load-balancing auxiliary loss
-    frac_tokens = jnp.mean(disp.sum(axis=-1), axis=0)  # [E] dispatch fraction
+    # load-balancing auxiliary loss. f_i uses the raw router assignments
+    # (pre-capacity, Switch-Transformer style): the capacity-truncated disp
+    # saturates for hot experts, under-penalizing them exactly when
+    # balancing matters most.
+    frac_tokens = jnp.mean(sel.sum(axis=1), axis=0)  # [E] assignment fraction
     frac_gates = jnp.mean(gates, axis=0)  # [E]
     aux = e * jnp.sum(frac_tokens * frac_gates) / top_k
 

@@ -41,7 +41,9 @@ struct StoreHeader {
 
 struct QueueSlot {
   std::atomic<uint64_t> sequence;
-  uint32_t size;
+  // atomic so a not-yet-claiming consumer may peek it for the capacity
+  // check without a formal data race against a producer recycling the slot
+  std::atomic<uint32_t> size;
   // payload bytes follow
 };
 
@@ -245,14 +247,15 @@ int rlt_queue_push(void* queue, const uint8_t* data, uint32_t size) {
       pos = header->enqueue_pos.load(std::memory_order_relaxed);
     }
   }
-  slot->size = size;
+  slot->size.store(size, std::memory_order_relaxed);
   std::memcpy(reinterpret_cast<char*>(slot) + sizeof(QueueSlot), data, size);
   slot->sequence.store(pos + 1, std::memory_order_release);
   return 0;
 }
 
 // Vyukov MPMC pop into caller buffer. Returns payload size, -EAGAIN empty,
-// -EMSGSIZE buffer too small.
+// -EMSGSIZE buffer too small (message NOT consumed — retry with a buffer of
+// at least rlt_queue_slot_bytes()).
 int64_t rlt_queue_pop(void* queue, uint8_t* out, uint32_t out_capacity) {
   auto* header = reinterpret_cast<QueueHeader*>(queue);
   uint64_t pos = header->dequeue_pos.load(std::memory_order_relaxed);
@@ -263,6 +266,22 @@ int64_t rlt_queue_pop(void* queue, uint8_t* out, uint32_t out_capacity) {
     intptr_t diff =
         static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos + 1);
     if (diff == 0) {
+      // Capacity check BEFORE the claim so -EMSGSIZE never consumes the
+      // message. The peeked size must be validated: between the two loads
+      // another consumer may pop this slot and a producer recycle it with
+      // a different size. Re-reading sequence after the size load closes
+      // that window — a recycled slot carries seq = pos + capacity + 1, so
+      // observing seq == pos+1 again proves the size belongs to the head
+      // message at pos (2^64 ABA wrap is unreachable in practice).
+      uint32_t size = slot->size.load(std::memory_order_relaxed);
+      // the fence keeps the size load from sinking past the validating
+      // re-load of sequence below
+      std::atomic_thread_fence(std::memory_order_acquire);
+      if (slot->sequence.load(std::memory_order_relaxed) != pos + 1) {
+        pos = header->dequeue_pos.load(std::memory_order_relaxed);
+        continue;
+      }
+      if (size > out_capacity) return -EMSGSIZE;  // not consumed
       if (header->dequeue_pos.compare_exchange_weak(pos, pos + 1,
                                                     std::memory_order_relaxed))
         break;
@@ -272,16 +291,10 @@ int64_t rlt_queue_pop(void* queue, uint8_t* out, uint32_t out_capacity) {
       pos = header->dequeue_pos.load(std::memory_order_relaxed);
     }
   }
-  uint32_t size = slot->size;
-  int64_t result;
-  if (size > out_capacity) {
-    result = -EMSGSIZE;
-  } else {
-    std::memcpy(out, reinterpret_cast<char*>(slot) + sizeof(QueueSlot), size);
-    result = static_cast<int64_t>(size);
-  }
+  uint32_t size = slot->size.load(std::memory_order_relaxed);
+  std::memcpy(out, reinterpret_cast<char*>(slot) + sizeof(QueueSlot), size);
   slot->sequence.store(pos + header->capacity, std::memory_order_release);
-  return result;
+  return static_cast<int64_t>(size);
 }
 
 uint64_t rlt_queue_slot_bytes(void* queue) {

@@ -165,3 +165,67 @@ def test_gradient_clip_and_accumulate(tmp_root):
     )
     trainer.fit(model)
     assert model.params is not None
+
+
+def test_val_check_interval_fraction(tmp_root):
+    """PTL semantics: float val_check_interval = fraction of the epoch's
+    train batches (reference inherits from PTL 1.6; ADVICE r1 medium)."""
+    model = BoringModel()  # 8 train batches/epoch
+    trainer = get_trainer(
+        tmp_root,
+        max_epochs=1,
+        limit_train_batches=None,
+        val_check_interval=0.25,
+        checkpoint_callback=False,
+    )
+    trainer.fit(model)
+    assert model.hook_calls.count("on_validation_epoch_end") == 4
+
+
+def test_limit_train_batches_fraction(tmp_root):
+    """limit_train_batches=0.5 of an 8-batch loader runs exactly 4 batches."""
+    model = BoringModel()
+    trainer = get_trainer(
+        tmp_root,
+        max_epochs=1,
+        limit_train_batches=0.5,
+        checkpoint_callback=False,
+    )
+    trainer.fit(model)
+    assert trainer.global_step == 4
+
+
+def test_float_trainer_args_validated(tmp_root):
+    with pytest.raises(ValueError, match="val_check_interval"):
+        Trainer(default_root_dir=tmp_root, val_check_interval=2.5)
+    with pytest.raises(ValueError, match="limit_train_batches"):
+        Trainer(default_root_dir=tmp_root, limit_train_batches=-0.5)
+    with pytest.raises(TypeError, match="limit_val_batches"):
+        Trainer(default_root_dir=tmp_root, limit_val_batches="half")
+
+
+def test_checkpoint_fixed_filename_versioned(tmp_root):
+    """A monitored checkpoint with a token-less filename must version paths
+    (-v1, -v2) instead of overwriting the previous best (ADVICE r1 low)."""
+    model = BoringModel()
+    ckpt = ModelCheckpoint(
+        dirpath=os.path.join(tmp_root, "ckpts"),
+        filename="fixed",
+        monitor="val_loss",
+        mode="min",
+        save_top_k=-1,
+    )
+    trainer = get_trainer(
+        tmp_root,
+        max_epochs=3,
+        callbacks=[ckpt],
+        checkpoint_callback=False,
+    )
+    trainer.fit(model)
+    paths = sorted(ckpt.best_k_models)
+    assert len(paths) == 3
+    assert len(set(paths)) == 3
+    for p in paths:
+        assert os.path.exists(p)
+    names = {os.path.basename(p) for p in paths}
+    assert names == {"fixed.ckpt", "fixed-v1.ckpt", "fixed-v2.ckpt"}
