@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 import jax
@@ -88,21 +88,69 @@ def process_results(futures: List[rt.CallFuture], queue=None) -> List[Any]:
     return [f.result() for f in futures]
 
 
+def compute_local_ranks(node_ips: List[str]) -> List[Tuple[int, int]]:
+    """global_rank -> (node_rank, local_rank) by grouping worker node IPs
+    (reference: ray_launcher.py:130-157 get_local_ranks). Node ranks follow
+    first-appearance order of each IP; local ranks count up within a node."""
+    node_rank_of: dict = {}
+    counts: dict = {}
+    out: List[Tuple[int, int]] = []
+    for ip in node_ips:
+        if ip not in node_rank_of:
+            node_rank_of[ip] = len(node_rank_of)
+            counts[ip] = 0
+        out.append((node_rank_of[ip], counts[ip]))
+        counts[ip] += 1
+    return out
+
+
+def partition_host_chips(num_workers_on_host: int, chips_per_host: int) -> List[str]:
+    """Disjoint TPU_VISIBLE_CHIPS values for workers sharing one host — the
+    TPU analogue of the reference's CUDA_VISIBLE_DEVICES control
+    (reference: ray_launcher.py:177-219 _share_cuda_visible_devices; NCCL
+    wants the union visible everywhere, the TPU runtime wants each process
+    to own a disjoint chip subset)."""
+    if num_workers_on_host < 1:
+        return []
+    if chips_per_host % num_workers_on_host != 0:
+        raise ValueError(
+            f"{num_workers_on_host} workers cannot evenly split "
+            f"{chips_per_host} chips on one host"
+        )
+    per = chips_per_host // num_workers_on_host
+    return [
+        ",".join(str(c) for c in range(i * per, (i + 1) * per))
+        for i in range(num_workers_on_host)
+    ]
+
+
 def _wrapping_function(
     global_rank: int,
     num_workers: int,
     payload_ref,
     queue_handle,
+    local_rank: int = 0,
+    node_rank: Optional[int] = None,
 ) -> Optional[WorkerOutput]:
     """Runs inside the worker actor (via ``RayExecutor.execute``): rebuild
     the trainer, join the session, run the requested trainer stage, and on
     rank 0 collect the results (reference: ray_launcher.py:252-349)."""
     os.environ["RLT_GLOBAL_RANK"] = str(global_rank)
-    trainer, fn_name, fn_args = rt.get(payload_ref)
+    if isinstance(payload_ref, bytes):
+        # cross-host path: shared memory cannot leave the driver's machine,
+        # so remote workers receive the payload inline over the socket
+        trainer, fn_name, fn_args = cloudpickle.loads(payload_ref)
+    else:
+        trainer, fn_name, fn_args = rt.get(payload_ref)
 
     strategy = trainer.strategy
     strategy.set_remote(True)
-    strategy._set_worker_context(global_rank, num_workers)
+    strategy._set_worker_context(
+        global_rank,
+        num_workers,
+        local_rank=local_rank,
+        node_rank=node_rank if node_rank is not None else global_rank,
+    )
 
     reset_session()
     init_session(rank=global_rank, queue=queue_handle)
@@ -147,7 +195,21 @@ class RayLauncher:
     def __init__(self, strategy):
         self._strategy = strategy
         self._workers: List[rt.ActorHandle] = []
+        self._worker_ranks: List[Tuple[int, int]] = []  # (node_rank, local_rank)
+        self._any_remote = False
         self._tune_queue = None
+
+    def get_local_ranks(self) -> List[Tuple[int, int]]:
+        """global_rank -> (node_rank, local_rank) for the current worker set
+        (reference: ray_launcher.py:130-157)."""
+
+        def resolve(value):
+            return value.result() if hasattr(value, "result") else value
+
+        # fire every RPC before resolving any: one overlapped round-trip
+        # instead of N sequential cross-host hops
+        futures = [w.get_node_ip.remote() for w in self._workers]
+        return compute_local_ranks([resolve(f) for f in futures])
 
     # ------------------------------------------------------------------ #
     def launch(self, function, *args, trainer=None) -> Any:
@@ -188,16 +250,83 @@ class RayLauncher:
                 self.teardown_workers()
 
     # ------------------------------------------------------------------ #
+    def _worker_demand(self) -> Dict[str, float]:
+        """Per-worker resource demand with the reference's override
+        precedence: ``resources_per_worker['CPU']`` beats
+        ``num_cpus_per_worker``; ``use_tpu`` adds a TPU slot unless
+        ``resources_per_worker`` overrides it (reference semantics:
+        ray_ddp.py:77-102, tests/test_ddp.py:138-176)."""
+        strategy = self._strategy
+        resources = dict(strategy.resources_per_worker)
+        demand: Dict[str, float] = {
+            "CPU": float(resources.pop("CPU", strategy.num_cpus_per_worker))
+        }
+        if "TPU" in resources:
+            demand["TPU"] = float(resources.pop("TPU"))
+        elif strategy.use_tpu and strategy.platform != "cpu":
+            total_tpu = rt.cluster_resources().get("TPU", 0.0)
+            if total_tpu:
+                # opportunistic: claim TPU only where the cluster advertises
+                # it (CPU-only dev machines keep working). Default share =
+                # an even split of the fleet, capped at one host's worth —
+                # so N workers on one TPU host co-schedule (and the chip
+                # partitioning below splits the chips) while N workers on N
+                # hosts take a full host each. Override with
+                # resources_per_worker={"TPU": ...}.
+                demand["TPU"] = min(1.0, total_tpu / strategy.num_workers)
+        demand.update({k: float(v) for k, v in resources.items()})
+        return demand
+
     def setup_workers(self) -> None:
         strategy = self._strategy
         n = strategy.num_workers
         env = strategy.worker_env()
         specs = [(RayExecutor, (), {}) for _ in range(n)]
+        if not rt.is_initialized():
+            rt.init()
+
+        demands = [self._worker_demand() for _ in range(n)]
+        # one worker per TPU host is the design stance (SURVEY §7); with
+        # several nodes attached, spread workers across them
+        placement = "spread" if len(rt.nodes()) > 1 else None
+        assignments = rt.plan_placement(demands, placement)
+
+        # chip partitioning: workers sharing a host must own disjoint chips
+        # (the reference's CUDA_VISIBLE_DEVICES role, ray_launcher.py:177-219)
+        per_actor_env: Optional[List[Dict[str, str]]] = None
+        workers_by_node: Dict[int, List[int]] = {}
+        for i, node_id in enumerate(assignments):
+            workers_by_node.setdefault(node_id, []).append(i)
+        if strategy.platform != "cpu" and any(
+            len(idxs) > 1 for idxs in workers_by_node.values()
+        ):
+            per_actor_env = [{} for _ in range(n)]
+            chips = strategy.chips_per_host or int(
+                os.environ.get("RLT_CHIPS_PER_HOST", "4")
+            )
+            for idxs in workers_by_node.values():
+                if len(idxs) == 1:
+                    continue
+                for local_idx, chip_ids in zip(
+                    idxs, partition_host_chips(len(idxs), chips)
+                ):
+                    per_actor_env[local_idx]["TPU_VISIBLE_CHIPS"] = chip_ids
+
+        import secrets as _secrets
+
+        run_tag = _secrets.token_hex(3)
         self._workers = rt.create_actors(
             specs,
-            names=[f"rlt-worker-{i}-{os.getpid()}" for i in range(n)],
+            names=[f"rlt-worker-{i}-{os.getpid()}-{run_tag}" for i in range(n)],
             env=env,
+            per_actor_env=per_actor_env,
+            demands=demands,
+            assignments=assignments,
         )
+        self._any_remote = any(
+            rt.actor_node_id(w) != 0 for w in self._workers
+        )
+        self._worker_ranks = self.get_local_ranks()
 
         seed = os.environ.get(GLOBAL_SEED_ENV)
         env_keys, env_vals = [], []
@@ -230,7 +359,8 @@ class RayLauncher:
                 rank_zero_info("collective smoke test: %s", sums)
 
         if self._is_tune_session():
-            self._tune_queue = rt.make_queue()
+            # shared-memory queues cannot cross machines
+            self._tune_queue = rt.make_queue(cross_host=self._any_remote)
 
     @staticmethod
     def _is_tune_session() -> bool:
@@ -251,7 +381,12 @@ class RayLauncher:
         if trainer._module is not None and trainer._module._params is not None:
             trainer._module._params = jax.device_get(trainer._module._params)
         try:
-            payload_ref = rt.put((trainer, fn_name, args))
+            if self._any_remote:
+                # shm segments are host-local; remote workers get the
+                # payload inline over their control sockets instead
+                payload_ref: Any = cloudpickle.dumps((trainer, fn_name, args))
+            else:
+                payload_ref = rt.put((trainer, fn_name, args))
         finally:
             trainer.strategy.launcher = launcher
             trainer.strategy._mesh = mesh
@@ -262,7 +397,13 @@ class RayLauncher:
         try:
             futures = [
                 w.execute.remote(
-                    _wrapping_function, rank, self._strategy.num_workers, payload_ref, queue_handle
+                    _wrapping_function,
+                    rank,
+                    self._strategy.num_workers,
+                    payload_ref,
+                    queue_handle,
+                    self._worker_ranks[rank][1] if self._worker_ranks else 0,
+                    self._worker_ranks[rank][0] if self._worker_ranks else rank,
                 )
                 for rank, w in enumerate(self._workers)
             ]
@@ -270,7 +411,8 @@ class RayLauncher:
         finally:
             # free the trainer+params shm segment once workers have consumed
             # it (repeated fit/tune launches would otherwise exhaust /dev/shm)
-            rt.delete(payload_ref)
+            if not isinstance(payload_ref, bytes):
+                rt.delete(payload_ref)
         output = next((r for r in results if r is not None), None)
         return output
 

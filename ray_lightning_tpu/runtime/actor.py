@@ -76,14 +76,30 @@ class ActorError(RuntimeError):
 # --------------------------------------------------------------------- #
 # server side (runs inside the spawned actor process)
 # --------------------------------------------------------------------- #
-def serve_instance(instance, authkey: bytes, ready_stream) -> None:
+def serve_instance(
+    instance,
+    authkey: bytes,
+    ready_stream,
+    bind_host: Optional[str] = None,
+    port: int = 0,
+) -> None:
     """Serve a constructed actor instance: bind, announce readiness on
-    ``ready_stream`` (``RLT_ACTOR_READY <port>``), then loop forever."""
+    ``ready_stream`` (``RLT_ACTOR_READY <port>``), then loop forever.
+
+    ``bind_host`` defaults to the ``RLT_BIND_HOST`` env var, else loopback.
+    Agent-spawned actors on remote hosts bind ``0.0.0.0`` so driver
+    connections can arrive over the network; the authkey handshake is what
+    gates access, not the interface.
+    """
+    bind_host = bind_host or os.environ.get("RLT_BIND_HOST") or "127.0.0.1"
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    server.bind(("127.0.0.1", 0))
+    server.bind((bind_host, port))
     server.listen(64)
     address = server.getsockname()
+    # where to dial ourselves (the shutdown unblocker): a wildcard bind is
+    # reachable on loopback
+    self_host = "127.0.0.1" if bind_host in ("0.0.0.0", "::") else bind_host
     ready_stream.write(f"RLT_ACTOR_READY {address[1]}\n")
     ready_stream.flush()
 
@@ -106,7 +122,7 @@ def serve_instance(instance, authkey: bytes, ready_stream) -> None:
                     stop.set()
                     # unblock accept loop
                     try:
-                        socket.create_connection(("127.0.0.1", address[1]), timeout=1).close()
+                        socket.create_connection((self_host, address[1]), timeout=1).close()
                     except OSError:
                         pass
                     return

@@ -1,9 +1,24 @@
-"""Runtime API: init/shutdown, actor creation with env control, futures.
+"""Runtime API: init/shutdown, node registry, actor creation with env
+control and resource-aware placement, futures.
 
 Role parity with the Ray-core surface the reference consumes
 (``ray.init``/``ray.remote``/``ray.get``/``ray.put``/``ray.wait``/
-``ray.kill``; reference: ray_lightning/launchers/ray_launcher.py:41-42,
-105-128,234-245; util.py:57-70).
+``ray.kill`` plus actor resource options and multi-node placement;
+reference: ray_lightning/launchers/ray_launcher.py:41-42,105-128,234-245;
+util.py:57-70).
+
+Topology model: a list of **nodes**. Node 0 is always the local machine
+(actors spawn as direct subprocesses). Further nodes are remote hosts
+running a :class:`~ray_lightning_tpu.runtime.node.NodeAgent`
+(``python -m ray_lightning_tpu.runtime.node`` — the ``ray start`` role);
+the driver attaches with :func:`connect_node` and actors placed there are
+spawned by the agent and dialed directly over the node's IP.
+
+Resource accounting: every node advertises ``{"CPU": n, ...}`` plus custom
+resources; every actor carries a demand dict. Placement is first-fit
+("pack") or round-robin ("spread"); an unsatisfiable demand raises
+immediately with per-node availability in the message (the reference's Ray
+would queue forever instead — failing loudly is kinder for training jobs).
 
 TPU-critical detail — environment control at spawn: a child interpreter runs
 the image's sitecustomize (which imports jax and registers the TPU plugin)
@@ -38,12 +53,53 @@ _LEN = struct.Struct("!Q")
 from ray_lightning_tpu.runtime.object_store import ObjectRef, ObjectStore, get_object
 
 
+class _Node:
+    """One schedulable host: capacity bookkeeping + (for remote nodes) the
+    agent handle actors are spawned through."""
+
+    def __init__(
+        self,
+        node_id: int,
+        ip: str,
+        num_cpus: float,
+        resources: Optional[Dict[str, float]] = None,
+        agent: Optional[ActorHandle] = None,
+    ):
+        self.node_id = node_id
+        self.ip = ip
+        self.total: Dict[str, float] = {"CPU": float(num_cpus)}
+        for key, value in (resources or {}).items():
+            self.total[key] = float(value)
+        self.available: Dict[str, float] = dict(self.total)
+        self.agent = agent  # None => local subprocess spawn
+        self.actor_demands: Dict[str, Dict[str, float]] = {}
+
+    def fits(self, demand: Dict[str, float]) -> bool:
+        return all(self.available.get(k, 0.0) >= v for k, v in demand.items())
+
+    def reserve(self, name: str, demand: Dict[str, float]) -> None:
+        for key, value in demand.items():
+            self.available[key] = self.available.get(key, 0.0) - value
+        self.actor_demands[name] = dict(demand)
+
+    def release(self, name: str) -> None:
+        demand = self.actor_demands.pop(name, None)
+        if demand:
+            for key, value in demand.items():
+                self.available[key] = min(
+                    self.total.get(key, 0.0), self.available.get(key, 0.0) + value
+                )
+
+
 class _RuntimeState:
     def __init__(self):
         self.initialized = False
         self.store: Optional[ObjectStore] = None
-        self.actors: Dict[str, Tuple[ActorHandle, subprocess.Popen]] = {}
-        self.num_cpus: int = os.cpu_count() or 1
+        # name -> (handle, local Popen or None, node_id)
+        self.actors: Dict[str, Tuple[ActorHandle, Optional[subprocess.Popen], int]] = {}
+        self.nodes: List[_Node] = []
+        # monotonic so ids never recycle across disconnect/connect cycles
+        self.next_node_id = 1
 
 
 _state = _RuntimeState()
@@ -53,16 +109,97 @@ def is_initialized() -> bool:
     return _state.initialized
 
 
-def init(num_cpus: Optional[int] = None, **_ignored) -> None:
+def _local_default_resources() -> Dict[str, float]:
+    res: Dict[str, float] = {}
+    # TPU presence is advertised per-host; the launcher schedules one worker
+    # per TPU host (SURVEY §7 design stance).
+    if os.environ.get("JAX_PLATFORMS", "").startswith(("tpu", "axon")):
+        res["TPU"] = 1.0
+    return res
+
+
+def init(
+    num_cpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    **_ignored,
+) -> None:
     """Idempotent runtime bring-up (the reference calls ``ray.init`` lazily
-    from the launcher, ray_launcher.py:41-42)."""
+    from the launcher, ray_launcher.py:41-42). Registers the local machine
+    as node 0."""
     if _state.initialized:
         return
     _state.store = ObjectStore()
-    if num_cpus:
-        _state.num_cpus = num_cpus
+    merged = _local_default_resources()
+    merged.update(resources or {})
+    if num_cpus is None:
+        # CPU is a LOGICAL resource (Ray semantics): bookkeeping for
+        # placement, not a cgroup. RLT_NUM_CPUS overrides detection — small
+        # containers under-report cores while actors are mostly I/O-bound.
+        env_cpus = os.environ.get("RLT_NUM_CPUS")
+        num_cpus = float(env_cpus) if env_cpus else float(os.cpu_count() or 1)
+    _state.nodes = [_Node(0, "127.0.0.1", float(num_cpus), merged)]
     _state.initialized = True
     atexit.register(shutdown)
+
+
+def connect_node(
+    address: Tuple[str, int], authkey: bytes, timeout: float = 30.0
+) -> int:
+    """Attach a remote host running a NodeAgent; returns its node id.
+
+    The agent's advertised IP/resources come from its ``node_info()`` — the
+    driver never guesses the remote topology.
+    """
+    if not _state.initialized:
+        init()
+    agent = ActorHandle(
+        name=f"node-agent-{address[0]}:{address[1]}",
+        address=tuple(address),
+        authkey=authkey,
+    )
+    info = agent.node_info.remote().result(timeout=timeout)
+    node = _Node(
+        node_id=_state.next_node_id,
+        ip=info["node_ip"],
+        num_cpus=info["num_cpus"],
+        resources=info.get("resources"),
+        agent=agent,
+    )
+    _state.next_node_id += 1
+    _state.nodes.append(node)
+    return node.node_id
+
+
+def disconnect_node(node_id: int) -> None:
+    """Detach a remote node (its agent process stays up, like ray.shutdown
+    leaving the cluster running). Actors placed there must be killed first."""
+    node = _get_node(node_id)
+    if node.agent is None:
+        raise ValueError("cannot disconnect the local node")
+    still = [n for n, (_, _, nid) in _state.actors.items() if nid == node_id]
+    if still:
+        raise RuntimeError(f"node {node_id} still hosts actors: {still}")
+    _state.nodes = [n for n in _state.nodes if n.node_id != node_id]
+
+
+def _get_node(node_id: int) -> _Node:
+    for node in _state.nodes:
+        if node.node_id == node_id:
+            return node
+    raise KeyError(f"unknown node id {node_id}")
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return [
+        {
+            "node_id": n.node_id,
+            "ip": n.ip,
+            "total": dict(n.total),
+            "available": dict(n.available),
+            "remote": n.agent is not None,
+        }
+        for n in _state.nodes
+    ]
 
 
 def shutdown() -> None:
@@ -73,18 +210,95 @@ def shutdown() -> None:
     if _state.store is not None:
         _state.store.shutdown()
         _state.store = None
+    _state.nodes = []
     _state.initialized = False
 
 
 def cluster_resources() -> Dict[str, float]:
-    res: Dict[str, float] = {"CPU": float(_state.num_cpus)}
-    # TPU presence is advertised per-host; the launcher schedules one worker
-    # per TPU host (SURVEY §7 design stance).
-    if os.environ.get("JAX_PLATFORMS", "").startswith(("tpu", "axon")):
-        res["TPU"] = 1.0
-    return res
+    if not _state.initialized:
+        init()
+    out: Dict[str, float] = {}
+    for node in _state.nodes:
+        for key, value in node.total.items():
+            out[key] = out.get(key, 0.0) + value
+    return out
 
 
+def available_resources() -> Dict[str, float]:
+    if not _state.initialized:
+        init()
+    out: Dict[str, float] = {}
+    for node in _state.nodes:
+        for key, value in node.available.items():
+            out[key] = out.get(key, 0.0) + value
+    return out
+
+
+# --------------------------------------------------------------------- #
+# placement
+# --------------------------------------------------------------------- #
+def plan_placement(
+    demands: Sequence[Dict[str, float]],
+    placement: Any = None,
+) -> List[int]:
+    """Assign one node id per demand without spawning anything.
+
+    ``placement``: None/"pack" fills nodes in id order; "spread"
+    round-robins across nodes that fit; an explicit sequence of node ids
+    pins each actor. Raises :class:`ActorError` when a demand fits nowhere
+    (message includes per-node availability).
+    """
+    if not _state.initialized:
+        init()
+    avail = {n.node_id: dict(n.available) for n in _state.nodes}
+    order = [n.node_id for n in _state.nodes]
+
+    def try_reserve(node_id: int, demand: Dict[str, float]) -> bool:
+        a = avail[node_id]
+        if all(a.get(k, 0.0) >= v for k, v in demand.items()):
+            for k, v in demand.items():
+                a[k] = a.get(k, 0.0) - v
+            return True
+        return False
+
+    assignments: List[int] = []
+    rr = 0
+    for i, demand in enumerate(demands):
+        chosen: Optional[int] = None
+        if placement is not None and not isinstance(placement, str):
+            node_id = list(placement)[i]
+            if try_reserve(node_id, demand):
+                chosen = node_id
+        elif placement == "spread":
+            for j in range(len(order)):
+                node_id = order[(rr + j) % len(order)]
+                if try_reserve(node_id, demand):
+                    chosen = node_id
+                    rr = (order.index(node_id) + 1) % len(order)
+                    break
+        else:  # pack
+            for node_id in order:
+                if try_reserve(node_id, demand):
+                    chosen = node_id
+                    break
+        if chosen is None:
+            detail = ", ".join(
+                f"node{n.node_id}({n.ip}): "
+                + " ".join(f"{k}={avail[n.node_id].get(k, 0.0):g}" for k in sorted(set(demand) | set(n.total)))
+                for n in _state.nodes
+            )
+            raise ActorError(
+                f"cannot place actor {i} with demand {demand}: no node has "
+                f"capacity [{detail}]. Reduce num_cpus/resources_per_worker "
+                "or connect more nodes."
+            )
+        assignments.append(chosen)
+    return assignments
+
+
+# --------------------------------------------------------------------- #
+# spawn
+# --------------------------------------------------------------------- #
 def create_actor(
     cls: type,
     args: Sequence[Any] = (),
@@ -100,10 +314,70 @@ def create_actor(
     ``env`` is applied to the parent's environ around spawn so the child's
     interpreter (and its sitecustomize-driven jax import) sees it.
     """
+    demand = {"CPU": float(num_cpus)}
+    for key, value in (resources or {}).items():
+        demand[key] = float(value)
     handles = create_actors(
-        [(cls, args, kwargs)], names=[name] if name else None, env=env, timeout=timeout
+        [(cls, args, kwargs)],
+        names=[name] if name else None,
+        env=env,
+        timeout=timeout,
+        demands=[demand],
     )
     return handles[0]
+
+
+def _spawn_local_proc(
+    cls: type,
+    args: Sequence[Any],
+    kwargs: Optional[Dict[str, Any]],
+    authkey: bytes,
+    child_env: Dict[str, str],
+) -> subprocess.Popen:
+    """Boot one actor interpreter on THIS host (also reused inside the
+    NodeAgent for remote spawns)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_lightning_tpu.runtime.actor_boot"],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        stderr=None,  # actor stderr flows to the spawner's terminal
+        env=child_env,
+    )
+
+    def send(payload: bytes):
+        proc.stdin.write(_LEN.pack(len(payload)) + payload)
+
+    try:
+        import json
+
+        send(authkey)
+        send(json.dumps({"sys_path": sys.path, "cwd": os.getcwd()}).encode())
+        send(cloudpickle.dumps(cls))
+        send(cloudpickle.dumps((tuple(args), dict(kwargs or {}))))
+        proc.stdin.flush()
+    except BrokenPipeError:
+        pass
+    return proc
+
+
+def _merge_child_env(
+    env: Optional[Dict[str, str]],
+    actor_env: Optional[Dict[str, str]],
+) -> Dict[str, str]:
+    child_env = dict(os.environ)
+    merged = dict(env or {})
+    if actor_env:
+        merged.update(actor_env)
+    if merged.get("JAX_PLATFORMS"):
+        # make the platform request stick even against sitecustomize
+        # platform-priority rewrites (see actor_boot)
+        merged.setdefault("RLT_FORCE_JAX_PLATFORM", merged["JAX_PLATFORMS"])
+    for key, value in merged.items():
+        if value is None:
+            child_env.pop(key, None)
+        else:
+            child_env[key] = str(value)
+    return child_env
 
 
 def create_actors(
@@ -112,73 +386,141 @@ def create_actors(
     env: Optional[Dict[str, str]] = None,
     per_actor_env: Optional[Sequence[Dict[str, str]]] = None,
     timeout: float = 180.0,
+    demands: Optional[Sequence[Dict[str, float]]] = None,
+    placement: Any = None,
+    assignments: Optional[Sequence[int]] = None,
 ) -> List[ActorHandle]:
     """Spawn many actors concurrently (one interpreter boot each, overlapped
     — interpreter boot on this image costs seconds because sitecustomize
-    imports jax, so serial spawn of N workers would be N× that)."""
+    imports jax, so serial spawn of N workers would be N× that).
+
+    ``demands``/``placement``/``assignments`` drive resource-aware
+    multi-node placement; with a single local node and default demands the
+    behavior is the classic local spawn.
+    """
     if not _state.initialized:
         init()
-    procs = []
-    for i, (cls, args, kwargs) in enumerate(specs):
-        name = (
-            names[i]
-            if names is not None
-            else f"actor-{len(_state.actors) + i}-{os.getpid()}"
-        )
-        authkey = make_authkey()
-        child_env = dict(os.environ)
-        merged = dict(env or {})
-        if per_actor_env is not None:
-            merged.update(per_actor_env[i])
-        if merged.get("JAX_PLATFORMS"):
-            # make the platform request stick even against sitecustomize
-            # platform-priority rewrites (see actor_boot)
-            merged.setdefault("RLT_FORCE_JAX_PLATFORM", merged["JAX_PLATFORMS"])
-        for key, value in merged.items():
-            if value is None:
-                child_env.pop(key, None)
-            else:
-                child_env[key] = str(value)
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_lightning_tpu.runtime.actor_boot"],
-            stdin=subprocess.PIPE,
-            stdout=subprocess.PIPE,
-            stderr=None,  # actor stderr flows to the driver's terminal
-            env=child_env,
-        )
+    n = len(specs)
+    if names is None:
+        names = [f"actor-{len(_state.actors) + i}-{os.getpid()}" for i in range(n)]
+    if demands is None:
+        demands = [{"CPU": 1.0} for _ in range(n)]
+    if assignments is None:
+        assignments = plan_placement(demands, placement)
 
-        def send(p, payload: bytes):
-            p.stdin.write(_LEN.pack(len(payload)) + payload)
-
-        try:
-            import json
-
-            send(proc, authkey)
-            send(proc, json.dumps({"sys_path": sys.path, "cwd": os.getcwd()}).encode())
-            send(proc, cloudpickle.dumps(cls))
-            send(proc, cloudpickle.dumps((tuple(args), dict(kwargs or {}))))
-            proc.stdin.flush()
-        except BrokenPipeError:
-            pass
-        procs.append((name, authkey, proc))
+    # reserve capacity up front; released on failure or kill
+    for name, demand, node_id in zip(names, demands, assignments):
+        _get_node(node_id).reserve(name, demand)
 
     handles: List[ActorHandle] = []
     errors: List[str] = []
-    for name, authkey, proc in procs:
-        port = _handshake(name, proc, timeout, errors)
-        if port is None:
-            continue
-        handle = ActorHandle(
-            name=name, address=("127.0.0.1", port), authkey=authkey, pid=proc.pid
-        )
-        _state.actors[name] = (handle, proc)
-        handles.append(handle)
+    local_pending: List[Tuple[str, bytes, subprocess.Popen, int]] = []
+    remote_groups: Dict[int, List[int]] = {}
+    try:
+        for i, ((cls, args, kwargs), name, node_id) in enumerate(
+            zip(specs, names, assignments)
+        ):
+            node = _get_node(node_id)
+            if node.agent is None:
+                authkey = make_authkey()
+                child_env = _merge_child_env(
+                    env, per_actor_env[i] if per_actor_env else None
+                )
+                proc = _spawn_local_proc(cls, args, kwargs, authkey, child_env)
+                local_pending.append((name, authkey, proc, node_id))
+            else:
+                remote_groups.setdefault(node_id, []).append(i)
+
+        # remote groups: one agent.spawn round-trip per node
+        remote_futures: List[Tuple[int, List[int], CallFuture]] = []
+        for node_id, idxs in remote_groups.items():
+            node = _get_node(node_id)
+            blob = cloudpickle.dumps([specs[i] for i in idxs])
+            authkeys = [make_authkey() for _ in idxs]
+            fut = node.agent.spawn.remote(
+                blob,
+                [names[i] for i in idxs],
+                [k.hex() for k in authkeys],
+                dict(env or {}),
+                [per_actor_env[i] if per_actor_env else None for i in idxs],
+                timeout,
+            )
+            remote_futures.append((node_id, idxs, fut))
+            for i, key in zip(idxs, authkeys):
+                _state.actors[names[i]] = (
+                    ActorHandle(names[i], (node.ip, 0), key),  # port patched below
+                    None,
+                    node_id,
+                )
+
+        for name, authkey, proc, node_id in local_pending:
+            port = _handshake(name, proc, timeout, errors)
+            if port is None:
+                _get_node(node_id).release(name)
+                continue
+            handle = ActorHandle(
+                name=name, address=("127.0.0.1", port), authkey=authkey, pid=proc.pid
+            )
+            _state.actors[name] = (handle, proc, node_id)
+            handles.append(handle)
+
+        for node_id, idxs, fut in remote_futures:
+            node = _get_node(node_id)
+            try:
+                spawned = fut.result(timeout=timeout + 30)
+            except Exception as e:
+                # ActorError AND transport failures (e.g. futures.TimeoutError
+                # on a hung agent) isolate to THIS node; other nodes' workers
+                # stay up and the error classifies as a process failure so
+                # the launcher's max_failures retry applies
+                for i in idxs:
+                    node.release(names[i])
+                    _state.actors.pop(names[i], None)
+                errors.append(f"agent on node {node_id} ({node.ip}): {e!r}")
+                continue
+            by_name = {entry["name"]: entry for entry in spawned}
+            for i in idxs:
+                name = names[i]
+                entry = by_name.get(name)
+                stub, _, _ = _state.actors[name]
+                if entry is None or entry.get("error"):
+                    node.release(name)
+                    _state.actors.pop(name, None)
+                    errors.append(
+                        f"{name}: {entry.get('error') if entry else 'agent reported no result'}"
+                    )
+                    continue
+                handle = ActorHandle(
+                    name=name,
+                    address=(node.ip, entry["port"]),
+                    authkey=stub._authkey,
+                    pid=entry.get("pid", 0),
+                )
+                _state.actors[name] = (handle, None, node_id)
+                handles.append(handle)
+    except BaseException:
+        for h in handles:
+            try:
+                kill(h, timeout=1.0)
+            except Exception:
+                pass
+        for name, _, node_id in zip(names, demands, assignments):
+            try:
+                _get_node(node_id).release(name)
+            except KeyError:
+                pass
+            _state.actors.pop(name, None)
+        raise
+
     if errors:
         for h in handles:
             kill(h)
         raise ActorError(
             "actor startup failed:\n" + "\n".join(errors), is_process_failure=True
         )
+    # preserve caller order (local + remote interleavings)
+    order = {name: i for i, name in enumerate(names)}
+    handles.sort(key=lambda h: order[h.name])
     return handles
 
 
@@ -227,21 +569,49 @@ def _handshake(name: str, proc: subprocess.Popen, timeout: float, errors: List[s
     return port
 
 
+def actor_node_id(handle: ActorHandle) -> int:
+    """Node id an actor was placed on (0 = local machine)."""
+    entry = _state.actors.get(handle.name)
+    return entry[2] if entry is not None else 0
+
+
 def kill(handle: ActorHandle, no_restart: bool = True, timeout: float = 5.0) -> None:
     """Graceful-then-hard actor kill (reference kills workers with
     ``ray.kill(no_restart=True)``, ray_launcher.py:116-128)."""
     entry = _state.actors.pop(handle.name, None)
+    node_id = entry[2] if entry is not None else None
+    node = None
+    if node_id is not None:
+        try:
+            node = _get_node(node_id)
+        except KeyError:
+            node = None
+    if node is not None and node.agent is not None:
+        # graceful shutdown over the actor's own socket FIRST — the agent's
+        # kill_actor only reaps (or force-kills after its grace window)
+        handle.shutdown(timeout=timeout)
+        try:
+            node.agent.kill_actor.remote(handle.name, timeout).result(
+                timeout=timeout + 10
+            )
+        except Exception:
+            pass
+        node.release(handle.name)
+        return
     handle.shutdown(timeout=timeout)
     if entry is not None:
-        _, proc = entry
-        try:
-            proc.wait(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            proc.terminate()
+        _, proc, _ = entry
+        if node is not None:
+            node.release(handle.name)
+        if proc is not None:
             try:
                 proc.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
-                proc.kill()
+                proc.terminate()
+                try:
+                    proc.wait(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
 
 
 def put(obj: Any) -> ObjectRef:

@@ -56,23 +56,44 @@ class _QueueActor:
 
 
 class Queue:
-    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+    def __init__(
+        self,
+        maxsize: int = 0,
+        actor_options: Optional[dict] = None,
+        cross_host: bool = False,
+    ):
         options = dict(actor_options or {})
+        env = {"JAX_PLATFORMS": "cpu"}  # queue actor never touches devices
+        if cross_host:
+            # workers on other hosts must be able to dial in: bind the
+            # wildcard interface and advertise this machine's routable IP
+            env["RLT_BIND_HOST"] = "0.0.0.0"
         self._actor = api.create_actor(
             _QueueActor,
             args=(maxsize,),
             name=options.get("name"),
             num_cpus=options.get("num_cpus", 0),
-            # queue actor never touches devices
-            env={"JAX_PLATFORMS": "cpu"},
+            env=env,
         )
+        self._cross_host = cross_host
 
     @property
     def actor(self):
         return self._actor
 
     def handle(self) -> "QueueClient":
-        return QueueClient(self._actor)
+        handle = self._actor
+        if self._cross_host:
+            from ray_lightning_tpu.runtime.actor import ActorHandle
+            from ray_lightning_tpu.utils.ports import node_ip_address
+
+            handle = ActorHandle(
+                name=handle.name,
+                address=(node_ip_address(), handle._address[1]),
+                authkey=handle._authkey,
+                pid=handle._pid,
+            )
+        return QueueClient(handle)
 
     def put(self, item: Any) -> None:
         if not self._actor.call("put", item).result():
@@ -224,14 +245,15 @@ class ShmQueue(_ShmQueueBase):
             lib.rlt_queue_unlink(("/" + self._name).encode())
 
 
-def make_queue(**kwargs):
+def make_queue(cross_host: bool = False, **kwargs):
     """Best-available queue: native shm ring if the toolchain built it,
-    else the actor-hosted fallback."""
-    if native.available():
+    else the actor-hosted fallback. ``cross_host=True`` forces the
+    socket-reachable actor queue — shared memory cannot cross machines."""
+    if not cross_host and native.available():
         try:
             return ShmQueue(**kwargs)
         except Exception:
             pass
     kwargs.pop("capacity", None)
     kwargs.pop("slot_bytes", None)
-    return Queue(**kwargs)
+    return Queue(cross_host=cross_host, **kwargs)

@@ -52,6 +52,7 @@ class RayStrategy(XLAStrategy):
         resources_per_worker: Optional[Dict[str, float]] = None,
         platform: Optional[str] = None,
         devices_per_worker: Optional[int] = None,
+        chips_per_host: Optional[int] = None,
         mesh_spec: Optional[MeshSpec] = None,
         sharding_policy: Optional[ShardingPolicy] = None,
         debug_collectives: bool = False,
@@ -69,12 +70,14 @@ class RayStrategy(XLAStrategy):
         self.resources_per_worker = dict(resources_per_worker or {})
         self.platform = platform
         self.devices_per_worker = devices_per_worker
+        self.chips_per_host = chips_per_host
         self.debug_collectives = debug_collectives
         self.max_failures = int(max_failures)
         if kwargs:
             rank_zero_warn("ignoring unsupported strategy kwargs: %s", sorted(kwargs))
         self._launcher = None
-        self._worker_ctx: Optional[Tuple[int, int]] = None  # (rank, world)
+        # (global_rank, world, local_rank, node_rank)
+        self._worker_ctx: Optional[Tuple[int, int, int, int]] = None
 
     # ------------------------------------------------------------------ #
     # pickling: the launcher (driver-side actor handles) and mesh never ship
@@ -107,9 +110,21 @@ class RayStrategy(XLAStrategy):
     def launcher(self, value):
         self._launcher = value
 
-    def _set_worker_context(self, global_rank: int, num_workers: int) -> None:
-        self._worker_ctx = (global_rank, num_workers)
+    def _set_worker_context(
+        self,
+        global_rank: int,
+        num_workers: int,
+        local_rank: int = 0,
+        node_rank: Optional[int] = None,
+    ) -> None:
+        self._worker_ctx = (
+            global_rank,
+            num_workers,
+            local_rank,
+            node_rank if node_rank is not None else global_rank,
+        )
         os.environ["RLT_GLOBAL_RANK"] = str(global_rank)
+        os.environ["RLT_LOCAL_RANK"] = str(local_rank)
 
     def worker_env(self) -> Dict[str, Optional[str]]:
         """Env for worker actor interpreters (decided before spawn because
@@ -145,10 +160,16 @@ class RayStrategy(XLAStrategy):
 
     @property
     def local_rank(self) -> int:
-        return 0  # one actor per host: host-local rank is always 0
+        """Host-local rank from the launcher's node-IP mapping (reference:
+        ray_launcher.py:130-157); 0 in the common one-actor-per-host layout."""
+        if self._worker_ctx is not None:
+            return self._worker_ctx[2]
+        return 0
 
     @property
     def node_rank(self) -> int:
+        if self._worker_ctx is not None:
+            return self._worker_ctx[3]
         return self.global_rank
 
     @property

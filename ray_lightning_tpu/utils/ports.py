@@ -7,6 +7,7 @@ coordinator address.
 """
 from __future__ import annotations
 
+import os
 import socket
 
 
@@ -18,7 +19,14 @@ def find_free_port(host: str = "") -> int:
 
 
 def node_ip_address() -> str:
-    """Best-effort IP of this host as seen by peers."""
+    """Best-effort IP of this host as seen by peers.
+
+    ``RLT_NODE_IP`` overrides autodetection — node agents propagate their
+    ``--advertise-ip`` to spawned workers through it (also how tests model
+    several "hosts" on one machine)."""
+    override = os.environ.get("RLT_NODE_IP")
+    if override:
+        return override
     try:
         with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
             # No packets are sent; this just selects the outbound interface.

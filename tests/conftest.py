@@ -22,6 +22,11 @@ if not os.environ.get("RLT_TEST_ON_TPU"):
 
     jax.config.update("jax_platforms", "cpu")
 
+# CPU is a logical scheduling resource (Ray semantics); CI containers may
+# report 1 core, which would serialize every multi-actor test. The reference
+# does the same thing by passing num_cpus=2/4 to ray.init in its fixtures.
+os.environ.setdefault("RLT_NUM_CPUS", "64")
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,225 @@
+"""Multi-host runtime: rank/topology mapping, resource-aware placement,
+node agents, and a 2-"host" distributed fit over non-loopback-style sockets.
+
+Mirrors the reference's four multi-node test mechanisms (SURVEY §4):
+mock actors for topology logic (reference tests/test_ddp.py:80-114),
+resource override precedence (tests/test_ddp.py:138-176), and a local
+"cluster" that runs the real distributed path — here two distinct loopback
+IPs stand in for two hosts, with one worker group spawned through a real
+NodeAgent process.
+"""
+import os
+import secrets
+import subprocess
+import sys
+
+import pytest
+
+from ray_lightning_tpu import runtime as rt
+from ray_lightning_tpu.launchers.ray_launcher import (
+    RayLauncher,
+    compute_local_ranks,
+    partition_host_chips,
+)
+from ray_lightning_tpu.strategies.ray_strategies import RayStrategy
+
+
+# --------------------------------------------------------------------- #
+# pure topology logic (reference mock-actor tests, test_ddp.py:80-114)
+# --------------------------------------------------------------------- #
+def test_compute_local_ranks_two_nodes():
+    # global ranks 0..4 over hosts "1","1","2","1","2"
+    out = compute_local_ranks(["1", "1", "2", "1", "2"])
+    #            (node_rank, local_rank)
+    assert out == [(0, 0), (0, 1), (1, 0), (0, 2), (1, 1)]
+
+
+def test_compute_local_ranks_single_node():
+    assert compute_local_ranks(["h"] * 3) == [(0, 0), (0, 1), (0, 2)]
+
+
+def test_get_local_ranks_with_mock_actors():
+    """Inject fake node actors into the launcher (the reference's
+    Node1Actor/Node2Actor pattern)."""
+
+    class _FakeFuture:
+        def __init__(self, value):
+            self._value = value
+
+        def result(self, timeout=None):
+            return self._value
+
+    class _FakeWorker:
+        def __init__(self, ip):
+            class _M:
+                def remote(_self):
+                    return _FakeFuture(ip)
+
+            self.get_node_ip = _M()
+
+    launcher = RayLauncher(RayStrategy(num_workers=4, platform="cpu"))
+    launcher._workers = [
+        _FakeWorker("1"), _FakeWorker("2"), _FakeWorker("1"), _FakeWorker("2")
+    ]
+    assert launcher.get_local_ranks() == [(0, 0), (1, 0), (0, 1), (1, 1)]
+
+
+def test_partition_host_chips():
+    assert partition_host_chips(2, 4) == ["0,1", "2,3"]
+    assert partition_host_chips(4, 4) == ["0", "1", "2", "3"]
+    assert partition_host_chips(1, 4) == ["0,1,2,3"]
+    with pytest.raises(ValueError, match="evenly"):
+        partition_host_chips(3, 4)
+
+
+# --------------------------------------------------------------------- #
+# resource-aware scheduling (reference test_ddp.py:138-176 semantics)
+# --------------------------------------------------------------------- #
+def test_worker_demand_override_precedence():
+    """resources_per_worker['CPU'] beats num_cpus_per_worker; custom
+    resources pass through; explicit TPU fraction is honored."""
+    launcher = RayLauncher(
+        RayStrategy(
+            num_workers=2,
+            num_cpus_per_worker=1,
+            resources_per_worker={"CPU": 2, "custom": 3},
+            platform="cpu",
+        )
+    )
+    demand = launcher._worker_demand()
+    assert demand["CPU"] == 2.0
+    assert demand["custom"] == 3.0
+    assert "TPU" not in demand  # cpu platform never claims chips
+
+    launcher = RayLauncher(
+        RayStrategy(num_workers=2, resources_per_worker={"TPU": 0.5})
+    )
+    assert launcher._worker_demand()["TPU"] == 0.5
+
+
+def test_plan_placement_pack_spread_and_reject():
+    rt.init()
+    base_cpus = rt.cluster_resources()["CPU"]
+    # pack fills node 0 first
+    assert rt.plan_placement([{"CPU": 1.0}] * 2) == [0, 0]
+    # an unsatisfiable demand raises with the availability detail
+    with pytest.raises(rt.ActorError, match="cannot place"):
+        rt.plan_placement([{"CPU": base_cpus + 1}])
+    # custom resources are enforced too
+    with pytest.raises(rt.ActorError, match="cannot place"):
+        rt.plan_placement([{"CPU": 1.0, "accelerator_x": 1.0}])
+
+
+def test_oversubscription_rejected_at_spawn():
+    rt.init()
+    total = rt.cluster_resources()["CPU"]
+
+    class _Tiny:
+        pass
+
+    with pytest.raises(rt.ActorError, match="cannot place"):
+        rt.create_actors(
+            [(_Tiny, (), {})],
+            demands=[{"CPU": total + 1}],
+        )
+
+
+# --------------------------------------------------------------------- #
+# real node agent over a second loopback IP (slow: spawns interpreters)
+# --------------------------------------------------------------------- #
+AGENT_IP = "127.1.0.2"
+
+
+@pytest.fixture
+def node_agent():
+    authkey = secrets.token_bytes(16)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RLT_FORCE_JAX_PLATFORM"] = "cpu"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_lightning_tpu.runtime.node",
+            "--host", AGENT_IP, "--advertise-ip", AGENT_IP,
+            "--authkey-hex", authkey.hex(), "--num-cpus", "8",
+        ],
+        stdout=subprocess.PIPE,
+        env=env,
+    )
+    line = proc.stdout.readline().decode().strip()
+    assert line.startswith("RLT_ACTOR_READY"), line
+    port = int(line.split()[1])
+    yield (AGENT_IP, port), authkey
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def _make_echo_cls():
+    # defined inside a function so cloudpickle ships it BY VALUE — the agent
+    # host cannot import this test module (same rule as real Ray clusters:
+    # module-level driver classes must be importable on every node)
+    class _Echo:
+        def who(self):
+            import os as _os
+
+            from ray_lightning_tpu.utils.ports import node_ip_address
+
+            return (_os.getpid(), node_ip_address())
+
+    return _Echo
+
+
+@pytest.mark.slow
+def test_node_agent_spawn_call_kill(node_agent):
+    address, authkey = node_agent
+    rt.init()
+    node_id = rt.connect_node(address, authkey)
+    _Echo = _make_echo_cls()
+    try:
+        before = rt.available_resources()["CPU"]
+        handles = rt.create_actors(
+            [(_Echo, (), {}), (_Echo, (), {})],
+            env={"JAX_PLATFORMS": "cpu"},
+            placement=[node_id, 0],
+        )
+        remote_h, local_h = handles
+        # the remote actor is dialed at the agent's advertised IP, and its
+        # own view of the node identity matches (rank mapping depends on it)
+        assert remote_h._address[0] == AGENT_IP
+        rpid, rip = remote_h.who.remote().result(timeout=60)
+        assert rip == AGENT_IP
+        lpid, _ = local_h.who.remote().result(timeout=60)
+        assert rpid != lpid
+        assert rt.available_resources()["CPU"] == before - 2
+        for h in handles:
+            rt.kill(h)
+        assert rt.available_resources()["CPU"] == before
+    finally:
+        for name in [w for w, (_, _, nid) in rt.api._state.actors.items() if nid == node_id]:
+            rt.kill(rt.api._state.actors[name][0])
+        rt.disconnect_node(node_id)
+
+
+@pytest.mark.slow
+def test_two_host_fit(node_agent, tmp_root):
+    """Distributed fit across two 'hosts': worker 0 local, worker 1 spawned
+    by the NodeAgent at a different IP; jax.distributed rendezvous and the
+    rank-0 result protocol both cross real non-loopback-style sockets."""
+    from ray_lightning_tpu.models.mnist import MNISTClassifier, MNISTDataModule
+    from tests.utils import get_trainer
+
+    address, authkey = node_agent
+    rt.init()
+    node_id = rt.connect_node(address, authkey)
+    try:
+        model = MNISTClassifier({"lr": 1e-2})
+        dm = MNISTDataModule(batch_size=32)
+        strategy = RayStrategy(num_workers=2, platform="cpu", devices_per_worker=2)
+        trainer = get_trainer(
+            tmp_root, max_epochs=1, strategy=strategy, limit_train_batches=None
+        )
+        trainer.fit(model, datamodule=dm)
+        assert trainer.state.status == "finished"
+        assert model.params is not None
+        assert "ptl/val_loss" in trainer.callback_metrics
+    finally:
+        rt.disconnect_node(node_id)
