@@ -297,7 +297,7 @@ class RayLauncher:
         workers_by_node: Dict[int, List[int]] = {}
         for i, node_id in enumerate(assignments):
             workers_by_node.setdefault(node_id, []).append(i)
-        if strategy.platform != "cpu" and any(
+        if any("TPU" in d for d in demands) and any(
             len(idxs) > 1 for idxs in workers_by_node.values()
         ):
             per_actor_env = [{} for _ in range(n)]

@@ -267,6 +267,12 @@ def plan_placement(
         chosen: Optional[int] = None
         if placement is not None and not isinstance(placement, str):
             node_id = list(placement)[i]
+            if node_id not in avail:
+                raise ActorError(
+                    f"cannot place actor {i}: pinned node id {node_id} is "
+                    f"not attached (known: {sorted(avail)}) — it may have "
+                    "been disconnected"
+                )
             if try_reserve(node_id, demand):
                 chosen = node_id
         elif placement == "spread":
@@ -289,8 +295,10 @@ def plan_placement(
             )
             raise ActorError(
                 f"cannot place actor {i} with demand {demand}: no node has "
-                f"capacity [{detail}]. Reduce num_cpus/resources_per_worker "
-                "or connect more nodes."
+                f"capacity [{detail}]. Reduce num_cpus/resources_per_worker, "
+                "connect more nodes, or raise the logical CPU count "
+                "(rt.init(num_cpus=...) or the RLT_NUM_CPUS env var — CPU "
+                "here is scheduling bookkeeping, not a cgroup)."
             )
         assignments.append(chosen)
     return assignments

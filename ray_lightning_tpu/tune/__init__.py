@@ -10,7 +10,13 @@ from ray_lightning_tpu.tune.search import (
     randint,
     uniform,
 )
-from ray_lightning_tpu.tune.tune import run, get_tune_resources, ExperimentAnalysis
+from ray_lightning_tpu.tune.tune import (
+    ExperimentAnalysis,
+    PlacementGroupFactory,
+    get_tune_resources,
+    max_concurrent_for,
+    run,
+)
 from ray_lightning_tpu.tune.schedulers import ASHAScheduler, PopulationBasedTraining
 
 __all__ = [
@@ -25,6 +31,8 @@ __all__ = [
     "uniform",
     "run",
     "get_tune_resources",
+    "PlacementGroupFactory",
+    "max_concurrent_for",
     "ExperimentAnalysis",
     "ASHAScheduler",
     "PopulationBasedTraining",

@@ -19,7 +19,7 @@ import os
 import time
 import traceback
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import cloudpickle
 
@@ -35,19 +35,66 @@ from ray_lightning_tpu.tune.schedulers import (
 from ray_lightning_tpu.tune.search import generate_trial_configs, mutate_config
 
 
+@dataclass(frozen=True)
+class PlacementGroupFactory:
+    """Trial resource bundles (reference: tune.py:49-56 — a head bundle for
+    the trial driver plus one bundle per worker, strategy="PACK").
+
+    The controller reserves ``total()`` from the runtime for the whole
+    trial: the trial-driver actor and the worker actors its nested launcher
+    spawns live in ONE accounting unit, exactly what PACK expresses."""
+
+    bundles: Tuple[Dict[str, float], ...]
+    strategy: str = "PACK"
+
+    def total(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for bundle in self.bundles:
+            for key, value in bundle.items():
+                out[key] = out.get(key, 0.0) + float(value)
+        return out
+
+
 def get_tune_resources(
     num_workers: int = 1,
     num_cpus_per_worker: int = 1,
     use_gpu: bool = False,
     use_tpu: bool = False,
-) -> Dict[str, float]:
-    """Resource bundle for one trial (reference: tune.py:32-56 builds a
-    PlacementGroupFactory of 1 driver CPU + num_workers bundles; here the
-    single-host runtime consumes a flat dict with the same accounting)."""
-    resources: Dict[str, float] = {"CPU": 1 + num_workers * num_cpus_per_worker}
+) -> PlacementGroupFactory:
+    """Bundles for one trial, mirroring the reference's shape
+    (reference: tune.py:32-56): ``[{CPU: 1}] + num_workers * [{CPU: c,
+    TPU: share}]``. The TPU share is an even split of one host per trial's
+    worker group (workers sharing a host split the chips)."""
+    head: Dict[str, float] = {"CPU": 1.0}
+    worker: Dict[str, float] = {"CPU": float(num_cpus_per_worker)}
     if use_tpu or use_gpu:
-        resources["TPU_HOST"] = float(num_workers)
-    return resources
+        worker["TPU"] = 1.0 / num_workers
+    return PlacementGroupFactory(
+        bundles=(head,) + (dict(worker),) * num_workers, strategy="PACK"
+    )
+
+
+def _normalize_trial_demand(resources_per_trial) -> Dict[str, float]:
+    if resources_per_trial is None:
+        return {"CPU": 1.0}
+    if isinstance(resources_per_trial, PlacementGroupFactory):
+        return resources_per_trial.total()
+    return {k: float(v) for k, v in dict(resources_per_trial).items()}
+
+
+def max_concurrent_for(
+    demand: Dict[str, float], cluster: Dict[str, float]
+) -> int:
+    """How many trials of ``demand`` fit in ``cluster`` at once (>= 1 so a
+    single over-sized trial still runs rather than deadlocking)."""
+    cap = None
+    for key, value in demand.items():
+        if value <= 0:
+            continue
+        have = cluster.get(key, 0.0)
+        this = int(have // value)
+        cap = this if cap is None else min(cap, this)
+    return max(1, cap if cap is not None else 1)
 
 
 @dataclass
@@ -198,10 +245,30 @@ def run(
     ]
     by_id = {t.trial_id: t for t in trials}
 
-    cpus_per_trial = (resources_per_trial or {}).get("CPU", 1)
+    trial_demand = _normalize_trial_demand(resources_per_trial)
     if max_concurrent_trials is None:
-        max_concurrent_trials = max(1, int((os.cpu_count() or 4) // max(1, cpus_per_trial)))
+        max_concurrent_trials = max_concurrent_for(
+            trial_demand, rt.cluster_resources()
+        )
     max_concurrent_trials = min(max_concurrent_trials, len(trials)) or 1
+
+    def _demand_fits_now() -> bool:
+        # the trial actor's reservation must land on ONE node — aggregate
+        # availability across nodes is not placeable
+        for node in rt.nodes():
+            if all(
+                node["available"].get(k, 0.0) >= v
+                for k, v in trial_demand.items()
+            ):
+                return True
+        return False
+
+    def _largest_node_total() -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for node in rt.nodes():
+            for key, value in node["total"].items():
+                out[key] = max(out.get(key, 0.0), value)
+        return out
 
     queue = rt.make_queue()
     trainable_bytes = cloudpickle.dumps(trainable)
@@ -209,10 +276,30 @@ def run(
     def start_trial(trial: Trial):
         trial.status = "RUNNING"
         trial._stopping = False
+        # the trial actor carries the WHOLE bundle's demand: its nested
+        # worker actors spawn inside the trial process (whose runtime is
+        # process-local), so the driver-level reservation is what keeps
+        # concurrent trials from oversubscribing the host (reference:
+        # PlacementGroupFactory PACK semantics, tune.py:49-56)
+        demand = dict(trial_demand)
+        biggest = _largest_node_total()
+        clamped = {k: v for k, v in demand.items() if v > biggest.get(k, 0.0)}
+        if clamped:
+            # a demand no single node can hold would hang forever in the
+            # reference (placement group never satisfied); run it at the
+            # largest node's capacity and say so
+            if verbose:
+                print(
+                    f"[tune] {trial.trial_id}: demand {clamped} exceeds "
+                    f"every node (largest: {biggest}); clamping (trial "
+                    "runs alone on the biggest node)"
+                )
+            demand = {k: min(v, biggest.get(k, 0.0)) for k, v in demand.items()}
         (trial._actor,) = rt.create_actors(
             [(_TrialRunner, (), {})],
             names=[f"tune-{name}-{trial.trial_id}-{time.monotonic_ns()}"],
             env=trial_env,
+            demands=[demand],
         )
         trial._future = trial._actor.run.remote(
             trainable_bytes, trial.config, trial.trial_id, trial.logdir, queue.handle()
@@ -265,28 +352,36 @@ def run(
             trial.config = new_config
             trial.status = "PENDING"
 
+    def drain_messages():
+        for msg in queue.get_all():
+            kind, trial_id, payload, iteration = msg
+            trial = by_id[trial_id]
+            if kind == "report":
+                trial.results.append(payload)
+                trial.last_iteration = iteration
+                decision, extra = scheduler.on_result(trial_id, payload, iteration)
+                if decision != CONTINUE and trial.status == "RUNNING":
+                    handle_decision(trial, decision, extra)
+            elif kind == "checkpoint":
+                trial.checkpoints.append({"path": payload, "iteration": iteration})
+
     try:
         pending = list(trials)
         while True:
             running = [t for t in trials if t.status == "RUNNING"]
             pending = [t for t in trials if t.status == "PENDING"]
-            while pending and len(running) < max_concurrent_trials:
+            while (
+                pending
+                and len(running) < max_concurrent_trials
+                and (_demand_fits_now() or not running)
+            ):
+                # queue (don't crash) when capacity is taken; an over-sized
+                # demand still runs alone rather than deadlocking
                 trial = pending.pop(0)
                 start_trial(trial)
                 running.append(trial)
 
-            # drain result/checkpoint messages
-            for msg in queue.get_all():
-                kind, trial_id, payload, iteration = msg
-                trial = by_id[trial_id]
-                if kind == "report":
-                    trial.results.append(payload)
-                    trial.last_iteration = iteration
-                    decision, extra = scheduler.on_result(trial_id, payload, iteration)
-                    if decision != CONTINUE and trial.status == "RUNNING":
-                        handle_decision(trial, decision, extra)
-                elif kind == "checkpoint":
-                    trial.checkpoints.append({"path": payload, "iteration": iteration})
+            drain_messages()
 
             # reap finished trials
             for trial in trials:
@@ -300,6 +395,9 @@ def run(
                     scheduler.on_complete(trial.trial_id)
 
             if all(t.status in ("TERMINATED", "STOPPED", "ERROR") for t in trials):
+                # a trial's last reports may have landed in the queue after
+                # this iteration's drain but before its future resolved
+                drain_messages()
                 break
             time.sleep(poll_interval)
     finally:

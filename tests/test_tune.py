@@ -109,3 +109,74 @@ def test_tune_with_trainer_and_report_callback(tmp_root):
     assert trial.status == "TERMINATED"
     assert len(trial.results) == 2  # one report per epoch == max_epochs
     assert "loss" in trial.last_result and "acc" in trial.last_result
+
+
+def test_get_tune_resources_bundles():
+    """Reference shape (tune.py:49-56): [{CPU:1}] + N x [{CPU:c, TPU:share}],
+    strategy PACK."""
+    from ray_lightning_tpu.tune import PlacementGroupFactory, get_tune_resources
+
+    pgf = get_tune_resources(num_workers=2, num_cpus_per_worker=3, use_tpu=True)
+    assert isinstance(pgf, PlacementGroupFactory)
+    assert pgf.strategy == "PACK"
+    assert pgf.bundles[0] == {"CPU": 1.0}
+    assert len(pgf.bundles) == 3
+    assert pgf.bundles[1] == {"CPU": 3.0, "TPU": 0.5}
+    assert pgf.total() == {"CPU": 7.0, "TPU": 1.0}
+    # CPU-only variant has no TPU key anywhere
+    cpu_pgf = get_tune_resources(num_workers=2)
+    assert all("TPU" not in b for b in cpu_pgf.bundles)
+
+
+def test_max_concurrent_for():
+    from ray_lightning_tpu.tune import max_concurrent_for
+
+    assert max_concurrent_for({"CPU": 7.0}, {"CPU": 64.0}) == 9
+    assert max_concurrent_for({"CPU": 7.0, "TPU": 1.0}, {"CPU": 64.0, "TPU": 2.0}) == 2
+    # over-sized demand never deadlocks the controller
+    assert max_concurrent_for({"CPU": 128.0}, {"CPU": 64.0}) == 1
+    assert max_concurrent_for({}, {"CPU": 64.0}) == 1
+
+
+@pytest.mark.slow
+def test_tune_trials_reserve_cluster_capacity(tmp_root):
+    """Trials carry their full bundle demand: with a demand sized to half
+    the cluster (+1), trials must serialize — observed via a timeline file
+    each trial appends to (start/end markers never interleave)."""
+    import json
+
+    from ray_lightning_tpu import runtime as rt
+    from ray_lightning_tpu import tune
+
+    rt.init()
+    total = rt.cluster_resources()["CPU"]
+    marker = os.path.join(tmp_root, "timeline.jsonl")
+
+    def trainable(config):
+        import json as _json
+        import time as _time
+
+        from ray_lightning_tpu.tune.session import get_trial_session
+
+        session = get_trial_session()
+        with open(config["marker"], "a") as f:
+            f.write(_json.dumps({"event": "start", "t": _time.time()}) + "\n")
+        _time.sleep(1.0)
+        session.report(loss=0.0)
+        with open(config["marker"], "a") as f:
+            f.write(_json.dumps({"event": "end", "t": _time.time()}) + "\n")
+
+    analysis = tune.run(
+        trainable,
+        config={"marker": marker},
+        num_samples=2,
+        metric="loss",
+        mode="min",
+        local_dir=tmp_root,
+        resources_per_trial={"CPU": total // 2 + 1},
+        trial_env={"JAX_PLATFORMS": "cpu"},
+    )
+    assert all(t.status == "TERMINATED" for t in analysis.trials)
+    events = [json.loads(line) for line in open(marker)]
+    kinds = [e["event"] for e in sorted(events, key=lambda e: e["t"])]
+    assert kinds == ["start", "end", "start", "end"]  # no overlap
