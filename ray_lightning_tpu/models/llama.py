@@ -49,6 +49,8 @@ class LlamaConfig:
     expert_top_k: int = 2
     capacity_factor: float = 1.5
     moe_aux_weight: float = 0.01
+    # GPipe microbatches when the mesh has a 'pp' axis (0 = one per stage)
+    pp_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -165,13 +167,16 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
     column-parallel in-projections, row-parallel out-projections; fsdp
     shards the other big axis. Specs reference axis names that may or may
     not exist in a given mesh; filter with :func:`shardings_for_mesh`."""
+    # the leading entry is the stacked layer axis: sharded over 'pp' when
+    # the mesh has pipeline stages (contiguous layer blocks per stage,
+    # matching _forward_pp's reshape), replicated otherwise
     layer_specs = {
-        "attn_norm": P(None, None),
-        "wq": P(None, "fsdp", "tp"),
-        "wk": P(None, "fsdp", "tp"),
-        "wv": P(None, "fsdp", "tp"),
-        "wo": P(None, "tp", "fsdp"),
-        "mlp_norm": P(None, None),
+        "attn_norm": P("pp", None),
+        "wq": P("pp", "fsdp", "tp"),
+        "wk": P("pp", "fsdp", "tp"),
+        "wv": P("pp", "fsdp", "tp"),
+        "wo": P("pp", "tp", "fsdp"),
+        "mlp_norm": P("pp", None),
     }
     if cfg.n_experts:
         from ray_lightning_tpu.parallel.moe import moe_param_specs
@@ -179,9 +184,9 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
         layer_specs["moe"] = moe_param_specs(n_layers=cfg.n_layers)
     else:
         layer_specs.update(
-            w_gate=P(None, "fsdp", "tp"),
-            w_up=P(None, "fsdp", "tp"),
-            w_down=P(None, "tp", "fsdp"),
+            w_gate=P("pp", "fsdp", "tp"),
+            w_up=P("pp", "fsdp", "tp"),
+            w_down=P("pp", "tp", "fsdp"),
         )
     return {
         # vocab axis replicated: token gather must stay local (a
@@ -229,6 +234,102 @@ def _act_constraint(x, mesh: Optional[Mesh], *entries):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn):
+    """One transformer block (pre-norm attention + gated MLP / MoE) shared
+    by the scanned dense path and the pipeline stage path — the math must
+    stay identical between them."""
+    B, S = x.shape[0], x.shape[1]
+    hd = cfg.head_dim
+    h = rmsnorm(x, lp["attn_norm"])
+    q = (h @ lp["wq"]).reshape(B, S, cfg.n_heads, hd)
+    k = (h @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+    v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q = apply_rope(q, cos, sin).swapaxes(1, 2)  # [B, H, S, hd]
+    k = apply_rope(k, cos, sin).swapaxes(1, 2)
+    v = v.swapaxes(1, 2)
+    att = attn_fn(q, k, v)
+    att = att.swapaxes(1, 2).reshape(B, S, cfg.n_heads * hd)
+    x = x + att @ lp["wo"]
+    h2 = rmsnorm(x, lp["mlp_norm"])
+    if cfg.n_experts and "moe" in lp:
+        from ray_lightning_tpu.parallel.moe import moe_ffn
+
+        moe_out, aux = moe_ffn(
+            lp["moe"], h2, top_k=cfg.expert_top_k,
+            capacity_factor=cfg.capacity_factor,
+        )
+        x = x + moe_out
+    else:
+        gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
+        x = x + gated @ lp["w_down"]
+        aux = jnp.float32(0.0)
+    return x, aux
+
+
+def _forward_pp(
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pipeline-parallel forward: the layer stack is split into pp stages
+    (GPipe microbatch schedule, parallel/pipeline.py); embed and lm_head run
+    replicated outside the pipeline. Composes with 'dp' (each dp group runs
+    its own pipeline on its batch shard); tp/fsdp/sp inside a stage would
+    need manual in-stage collectives and are rejected loudly."""
+    from ray_lightning_tpu.parallel.pipeline import pipeline_apply
+
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "pipeline parallelism with MoE layers is not supported yet; "
+            "use ep without pp (or dense layers with pp)"
+        )
+    for ax in ("tp", "fsdp", "sp"):
+        if ax in mesh.axis_names and mesh.shape[ax] > 1:
+            raise NotImplementedError(
+                f"pipeline parallelism composes with dp only for now; mesh "
+                f"has {ax}={mesh.shape[ax]}. Drop the pp axis to use {ax}."
+            )
+    pp = mesh.shape["pp"]
+    L = cfg.n_layers
+    if L % pp != 0:
+        raise ValueError(f"n_layers={L} must divide into pp={pp} stages")
+    B, S = tokens.shape
+    hd = cfg.head_dim
+    x = params["embed"][tokens]
+
+    def stage_fn(stage_layers, xb):
+        # rope angles recomputed per stage from static shapes (cheap; avoids
+        # closing over traced values under shard_map)
+        cos, sin = rope_angles(S, hd, cfg.rope_theta)
+
+        def attn_fn(q, k, v):
+            return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+
+        def layer_fn(x, lp):
+            x, _ = _decoder_layer(x, lp, cfg, cos, sin, attn_fn)
+            return x, None
+
+        fn = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
+        out, _ = jax.lax.scan(fn, xb, stage_layers)
+        return out
+
+    # [L, ...] -> [pp, L/pp, ...]: one contiguous block of layers per stage
+    stage_params = jax.tree_util.tree_map(
+        lambda p: p.reshape(pp, L // pp, *p.shape[1:]), params["layers"]
+    )
+    m = cfg.pp_microbatches or pp
+    data_spec = (
+        P("dp") if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else P()
+    )
+    x = pipeline_apply(
+        stage_fn, stage_params, x, mesh,
+        axis="pp", num_microbatches=m, data_spec=data_spec,
+    )
+    x = rmsnorm(x, params["final_norm"])
+    return x @ params["lm_head"], jnp.float32(0.0)
+
+
 def forward(
     params: Dict[str, Any],
     tokens: jnp.ndarray,
@@ -238,8 +339,11 @@ def forward(
     """tokens [B, S] -> logits [B, S, V].
 
     Data axes: batch over ('dp','fsdp'); sequence over 'sp' (ring attention
-    handles cross-shard attention when the mesh has sp>1).
+    handles cross-shard attention when the mesh has sp>1); layers over 'pp'
+    (GPipe schedule) when the mesh has pipeline stages.
     """
+    if mesh is not None and "pp" in mesh.axis_names and mesh.shape["pp"] > 1:
+        return _forward_pp(params, tokens, cfg, mesh)
     B, S = tokens.shape
     hd = cfg.head_dim
     x = params["embed"][tokens]  # gather -> [B, S, D]
@@ -252,36 +356,13 @@ def forward(
     if use_ring:
         from ray_lightning_tpu.parallel.ring_attention import ring_attention
 
-    def layer_fn(x, lp):
-        h = rmsnorm(x, lp["attn_norm"])
-        q = (h @ lp["wq"]).reshape(B, S, cfg.n_heads, hd)
-        k = (h @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
-        v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
-        q = apply_rope(q, cos, sin)  # [B, S, H, hd]
-        k = apply_rope(k, cos, sin)
-        # [B, H, S, hd] for the kernel
-        q = q.swapaxes(1, 2)
-        k = k.swapaxes(1, 2)
-        v = v.swapaxes(1, 2)
+    def attn_fn(q, k, v):
         if use_ring:
-            att = ring_attention(q, k, v, mesh=mesh, axis="sp", causal=True)
-        else:
-            att = attention(q, k, v, causal=True, impl=cfg.attn_impl)
-        att = att.swapaxes(1, 2).reshape(B, S, cfg.n_heads * hd)
-        x = x + att @ lp["wo"]
-        h2 = rmsnorm(x, lp["mlp_norm"])
-        if cfg.n_experts:
-            from ray_lightning_tpu.parallel.moe import moe_ffn
+            return ring_attention(q, k, v, mesh=mesh, axis="sp", causal=True)
+        return attention(q, k, v, causal=True, impl=cfg.attn_impl)
 
-            moe_out, aux = moe_ffn(
-                lp["moe"], h2, top_k=cfg.expert_top_k,
-                capacity_factor=cfg.capacity_factor,
-            )
-            x = x + moe_out
-        else:
-            gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
-            x = x + gated @ lp["w_down"]
-            aux = jnp.float32(0.0)
+    def layer_fn(x, lp):
+        x, aux = _decoder_layer(x, lp, cfg, cos, sin, attn_fn)
         x = _act_constraint(x, mesh, ("dp", "fsdp"), "sp", None)
         return x, aux
 

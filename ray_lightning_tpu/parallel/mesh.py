@@ -67,6 +67,11 @@ class MeshSpec:
     def fsdp() -> "MeshSpec":
         return MeshSpec(axes={"fsdp": -1})
 
+    @staticmethod
+    def pipeline(pp: int) -> "MeshSpec":
+        """GPipe stages over 'pp'; remaining devices become data parallel."""
+        return MeshSpec(axes={"pp": pp, "dp": -1})
+
 
 def build_mesh(
     spec: Optional[MeshSpec] = None,

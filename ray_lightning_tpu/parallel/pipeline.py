@@ -30,27 +30,45 @@ def pipeline_apply(
     mesh: Mesh,
     axis: str = "pp",
     num_microbatches: int = 2,
+    data_spec: P = P(),
 ) -> jnp.ndarray:
     """Run ``stage_fn`` sequentially across the 'pp' stages.
 
     stage_params: pytree with leading axis == mesh.shape[axis] (one slice
-    per stage). x: [B, ...] global batch, B divisible by num_microbatches.
-    Returns the final stage's output for the full batch, replicated.
+    per stage). x: [B, ...] global batch whose per-shard size is divisible
+    by num_microbatches. ``data_spec`` shards x's batch dim over data axes
+    (e.g. ``P('dp')``) so pipeline stages compose with data parallelism:
+    each dp group runs its own pipeline over its batch shard. Returns the
+    final stage's output, sharded like ``data_spec``.
     """
     pp = mesh.shape[axis]
     m = num_microbatches
-    b = x.shape[0]
-    assert b % m == 0, "batch must divide into microbatches"
+    # per-data-shard batch (shard_map hands each device its local slice)
+    first = data_spec[0] if len(data_spec) else None
+    data_axes = (first,) if isinstance(first, str) else tuple(first or ())
+    denom = 1
+    for a in data_axes:
+        denom *= mesh.shape[a]
+    if x.shape[0] % denom:
+        raise ValueError(
+            f"global batch {x.shape[0]} is not divisible by the data axes "
+            f"{data_axes} (size {denom})"
+        )
+    b = x.shape[0] // denom
+    if b % m:
+        raise ValueError(
+            f"local batch {b} (global {x.shape[0]} / {denom}) must divide "
+            f"into {m} microbatches"
+        )
     mb = b // m
 
-    # data/batch specs: everything replicated except stage params
     param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(param_spec, P()),
-        out_specs=P(),
+        in_specs=(param_spec, data_spec),
+        out_specs=data_spec,
         check_rep=False,
     )
     def _pipe(params_local, x_full):

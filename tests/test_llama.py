@@ -139,3 +139,63 @@ def test_moe_llama_ep_mesh(tmp_root):
     trainer.fit(module, datamodule=dm)
     spec = trainer.params["layers"]["moe"]["w_gate"].sharding.spec
     assert "ep" in str(spec)
+
+
+def test_pp_forward_matches_dense():
+    """Pipeline-parallel forward is numerically identical to the plain
+    scanned forward (GPipe re-schedules compute, it must not change math)."""
+    from ray_lightning_tpu.models.llama import forward, init_params
+
+    cfg = LlamaConfig.tiny()
+    mesh = build_mesh(MeshSpec(axes={"pp": 2, "dp": 4}))
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (8, cfg.max_seq)),
+        jnp.int32,
+    )
+    ref, _ = jax.jit(lambda p, t: forward(p, t, cfg))(params, tokens)
+    piped, _ = jax.jit(lambda p, t: forward(p, t, cfg, mesh))(params, tokens)
+    err = float(jnp.max(jnp.abs(ref.astype(jnp.float32) - piped.astype(jnp.float32))))
+    assert err < 2e-2, err
+
+
+def test_train_pp_mesh(tmp_root):
+    """Full train step through the Trainer on a pp=2 x dp=4 mesh: the
+    flagship uses pipeline parallelism first-class (VERDICT r1 #4)."""
+    cfg = LlamaConfig.tiny()
+    strategy = rlt.XLAStrategy(
+        mesh_spec=MeshSpec(axes={"pp": 2, "dp": 4}),
+        sharding_policy=ShardingPolicy(data_axes=("dp",)),
+    )
+    module = LlamaModule(cfg, lr=3e-3, warmup_steps=2, total_steps=50)
+    dm = SyntheticLMDataModule(cfg, batch_size=8, n_train=32)
+    trainer = get_trainer(tmp_root, max_epochs=1, strategy=strategy,
+                          limit_train_batches=None, checkpoint_callback=False)
+    trainer.fit(module, datamodule=dm)
+    assert trainer.params is not None
+    # layer stacks are sharded over the pp axis (stage-local weights)
+    spec = trainer.params["layers"]["wq"].sharding.spec
+    assert "pp" in str(spec)
+
+
+def test_pp_rejects_unsupported_combos():
+    from ray_lightning_tpu.models.llama import forward, init_params
+
+    mesh = build_mesh(MeshSpec(axes={"pp": 2, "tp": 2, "dp": 2}))
+    cfg = LlamaConfig.tiny()
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((4, cfg.max_seq), jnp.int32)
+    with pytest.raises(NotImplementedError, match="composes with dp"):
+        forward(params, tokens, cfg, mesh)
+
+    moe_cfg = LlamaConfig.tiny_moe()
+    moe_mesh = build_mesh(MeshSpec(axes={"pp": 2, "dp": 4}))
+    moe_params = init_params(jax.random.key(0), moe_cfg)
+    with pytest.raises(NotImplementedError, match="MoE"):
+        forward(moe_params, tokens, moe_cfg, moe_mesh)
+
+    odd = LlamaConfig(vocab_size=64, dim=32, n_layers=3, n_heads=2,
+                      n_kv_heads=2, ffn_dim=64, max_seq=32, remat=False)
+    odd_params = init_params(jax.random.key(0), odd)
+    with pytest.raises(ValueError, match="divide"):
+        forward(odd_params, jnp.zeros((4, 32), jnp.int32), odd, moe_mesh)
