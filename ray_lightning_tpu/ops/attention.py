@@ -341,6 +341,29 @@ def _flash_attention_bwd(causal, scale, interpret, residuals, g):
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
+def _lane_pad(d: int) -> int:
+    """Head dim rounded up to the TPU lane width (128)."""
+    return ((d + 127) // 128) * 128
+
+
+def flash_supported(q_shape, k_shape) -> bool:
+    """Whether the pallas flash kernels can serve these shapes: last-aligned
+    self-attention (sq == skv), block-divisible lengths, TPU-tileable block
+    rows. Head dims that are not lane-multiples are zero-padded to 128
+    around the kernel (exact: padded q/k columns contribute zero scores and
+    padded v columns carry zero values and gradients) — so head_dim 64
+    (BERT-base and most small models) takes the flash path too."""
+    sq, skv = q_shape[2], k_shape[2]
+    bq, bk = _pick_blocks(sq)
+    return (
+        sq == skv
+        and sq % bq == 0
+        and skv % bk == 0
+        and bq % 8 == 0
+        and bk % 8 == 0
+    )
+
+
 def attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -355,31 +378,29 @@ def attention(
     impl: "flash" | "reference" | None (auto: flash when shapes are
     TPU-tileable, reference otherwise).
     """
-    b, hq, sq, d = q.shape
-    skv = k.shape[2]
+    sq, d = q.shape[2], q.shape[3]
     scale = sm_scale if sm_scale is not None else float(1.0 / np.sqrt(d))
-    bq, bk = _pick_blocks(sq)
-    # the flash kernels assume last-aligned self-attention (sq == skv),
-    # block-divisible lengths and TPU-tileable blocks (rows % 8, lanes %
-    # 128); anything else must take the reference path
-    flash_ok = (
-        sq == skv
-        and sq % bq == 0
-        and skv % bk == 0
-        and bq % 8 == 0
-        and bk % 8 == 0
-        and d % 128 == 0
-    )
+    flash_ok = flash_supported(q.shape, k.shape)
     if impl is None:
         impl = "flash" if flash_ok else "reference"
     elif impl == "flash" and not flash_ok:
         raise ValueError(
-            f"flash attention requires sq == skv, sq % {bq} == 0 and "
-            f"d % 128 == 0; got q {q.shape}, k {k.shape}. "
+            "flash attention requires last-aligned self-attention (sq == "
+            "skv) with sequence lengths divisible into 8-row-aligned "
+            f"blocks; got q {q.shape}, k {k.shape}. "
             "Use impl='reference' for these shapes."
         )
     if impl == "reference":
         return reference_attention(q, k, v, causal=causal, sm_scale=scale)
     if interpret is None:
         interpret = _interpret_default()
+    d_pad = _lane_pad(d)
+    if d_pad != d:
+        # scale already fixed from the true d; zero columns change nothing
+        pad = ((0, 0), (0, 0), (0, 0), (0, d_pad - d))
+        out = _flash_attention(
+            jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+            causal, scale, interpret,
+        )
+        return out[..., :d]
     return _flash_attention(q, k, v, causal, scale, interpret)
