@@ -1,0 +1,83 @@
+"""Remote-driver ("Ray Client") mode: the driver runs on one machine, the
+training cluster on others (role parity: the reference's Ray Client tests,
+ray_lightning/tests/test_client.py:10-30 — "driver on laptop, cluster
+remote").
+
+Cluster side (once per host, the ``ray start`` role):
+
+  python -c "import secrets; print(secrets.token_bytes(16).hex())" > key.hex
+  python -m ray_lightning_tpu.runtime.node --port 7717 --authkey-file key.hex
+
+Driver side (this script, anywhere that can reach the host):
+
+  python examples/ray_client_example.py --address HOST:7717 \
+      --authkey-file key.hex --num-workers 2 --smoke-test
+
+The driver contributes no compute: ``init(address=...)`` registers the
+local node with zero resources, so every worker actor is placed on the
+remote node(s) and results stream back over the actor sockets.
+"""
+from __future__ import annotations
+
+import argparse
+
+
+def train_mnist_remote(
+    address: str,
+    authkey: bytes,
+    config: dict,
+    num_workers: int = 2,
+    max_epochs: int = 2,
+    platform: str | None = "cpu",
+):
+    import ray_lightning_tpu as rlt
+    from ray_lightning_tpu import runtime as rt
+    from ray_lightning_tpu.models.mnist import MNISTClassifier, MNISTDataModule
+
+    rt.init(address=address, authkey=authkey)
+    assert rt.is_connected()
+
+    model = MNISTClassifier(config)
+    dm = MNISTDataModule(batch_size=config.get("batch_size", 32))
+    trainer = rlt.Trainer(
+        max_epochs=max_epochs,
+        # the remote-driver machine must never touch an accelerator — the
+        # delayed accelerator pins the driver to CPU while workers own the
+        # chips (reference _GPUAccelerator role)
+        accelerator="_tpu",
+        strategy=rlt.RayStrategy(
+            num_workers=num_workers,
+            num_cpus_per_worker=1,
+            platform=platform,
+            devices_per_worker=2,
+        ),
+        enable_progress_bar=True,
+        logger=False,
+    )
+    trainer.fit(model, datamodule=dm)
+    return trainer
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--address", required=True, help="node agent host:port")
+    parser.add_argument("--authkey-file", required=True)
+    parser.add_argument("--num-workers", type=int, default=2)
+    parser.add_argument("--smoke-test", action="store_true")
+    args = parser.parse_args()
+
+    with open(args.authkey_file) as f:
+        authkey = bytes.fromhex(f.read().strip())
+
+    trainer = train_mnist_remote(
+        args.address,
+        authkey,
+        {"lr": 1e-2, "batch_size": 32},
+        num_workers=args.num_workers,
+        max_epochs=1 if args.smoke_test else 4,
+    )
+    print("callback_metrics:", dict(trainer.callback_metrics))
+
+
+if __name__ == "__main__":
+    main()

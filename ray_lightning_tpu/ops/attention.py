@@ -382,7 +382,13 @@ def attention(
     scale = sm_scale if sm_scale is not None else float(1.0 / np.sqrt(d))
     flash_ok = flash_supported(q.shape, k.shape)
     if impl is None:
-        impl = "flash" if flash_ok else "reference"
+        # auto mode never picks interpret-mode pallas: off-TPU the kernels
+        # run in the (slow) interpreter, so the einsum reference is the
+        # faster correct choice there; tests opt in with impl="flash"
+        flash_fast = flash_ok and not (
+            interpret if interpret is not None else _interpret_default()
+        )
+        impl = "flash" if flash_fast else "reference"
     elif impl == "flash" and not flash_ok:
         raise ValueError(
             "flash attention requires last-aligned self-attention (sq == "

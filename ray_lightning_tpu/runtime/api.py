@@ -64,9 +64,11 @@ class _Node:
         num_cpus: float,
         resources: Optional[Dict[str, float]] = None,
         agent: Optional[ActorHandle] = None,
+        dial: Optional[Tuple[str, int]] = None,
     ):
         self.node_id = node_id
         self.ip = ip
+        self.dial = dial  # the address the driver connected to (agents)
         self.total: Dict[str, float] = {"CPU": float(num_cpus)}
         for key, value in (resources or {}).items():
             self.total[key] = float(value)
@@ -109,6 +111,12 @@ def is_initialized() -> bool:
     return _state.initialized
 
 
+def is_connected() -> bool:
+    """Ray-Client parity (``ray.util.client.ray.is_connected``): True when
+    at least one remote node agent is attached."""
+    return any(n.agent is not None for n in _state.nodes)
+
+
 def _local_default_resources() -> Dict[str, float]:
     res: Dict[str, float] = {}
     # TPU presence is advertised per-host; the launcher schedules one worker
@@ -121,17 +129,62 @@ def _local_default_resources() -> Dict[str, float]:
 def init(
     num_cpus: Optional[int] = None,
     resources: Optional[Dict[str, float]] = None,
+    address: Optional[Any] = None,
+    authkey: Optional[bytes] = None,
     **_ignored,
 ) -> None:
     """Idempotent runtime bring-up (the reference calls ``ray.init`` lazily
     from the launcher, ray_launcher.py:41-42). Registers the local machine
-    as node 0."""
+    as node 0.
+
+    **Client mode** (the reference's Ray Client role, "driver on a laptop,
+    cluster remote": reference tests/test_client.py): pass ``address`` — a
+    ``"host:port"`` string or ``(host, port)`` of a running NodeAgent —
+    plus its ``authkey``. The local node then contributes ZERO resources,
+    so every actor (workers, trial runners) is placed on the remote
+    node(s); attach more with :func:`connect_node`.
+    """
+    if address is not None and authkey is None:
+        raise ValueError(
+            "client-mode init(address=...) requires the node agent's "
+            "authkey (hex file written by `python -m "
+            "ray_lightning_tpu.runtime.node`)"
+        )
+    if isinstance(address, str):
+        host, _, port = address.rpartition(":")
+        address = (host, int(port))
     if _state.initialized:
+        if address is not None and not any(
+            n.dial == tuple(address) for n in _state.nodes
+        ):
+            # already-initialized runtime: still honor the attach request
+            # (the local node keeps whatever resources it was created with)
+            connect_node(tuple(address), authkey)
         return
     _state.store = ObjectStore()
     merged = _local_default_resources()
     merged.update(resources or {})
-    if num_cpus is None:
+    if address is not None:
+        # driver-only local node: nothing schedulable here. A client-mode
+        # driver must also never acquire an accelerator — on TPU the PJRT
+        # plugin claims the chip exclusively per process (and a wedged
+        # backend would hang the driver at first device use), so pin this
+        # process to CPU before anything touches jax devices.
+        from ray_lightning_tpu.accelerators.delayed_tpu import (
+            ensure_driver_off_accelerator,
+        )
+
+        if not ensure_driver_off_accelerator():
+            from ray_lightning_tpu.utils.common import rank_zero_warn
+
+            rank_zero_warn(
+                "client-mode init: a non-CPU jax backend is already live in "
+                "this driver process — it may hold the accelerator its "
+                "remote workers need. Connect before any jax device use."
+            )
+        num_cpus = 0
+        merged = {}
+    elif num_cpus is None:
         # CPU is a LOGICAL resource (Ray semantics): bookkeeping for
         # placement, not a cgroup. RLT_NUM_CPUS overrides detection — small
         # containers under-report cores while actors are mostly I/O-bound.
@@ -140,6 +193,8 @@ def init(
     _state.nodes = [_Node(0, "127.0.0.1", float(num_cpus), merged)]
     _state.initialized = True
     atexit.register(shutdown)
+    if address is not None:
+        connect_node(tuple(address), authkey)
 
 
 def connect_node(
@@ -164,6 +219,7 @@ def connect_node(
         num_cpus=info["num_cpus"],
         resources=info.get("resources"),
         agent=agent,
+        dial=tuple(address),
     )
     _state.next_node_id += 1
     _state.nodes.append(node)

@@ -223,3 +223,37 @@ def test_two_host_fit(node_agent, tmp_root):
         assert "ptl/val_loss" in trainer.callback_metrics
     finally:
         rt.disconnect_node(node_id)
+
+
+def test_client_mode_init_requires_authkey():
+    with pytest.raises(ValueError, match="authkey"):
+        rt.init(address="127.0.0.1:1")
+
+
+@pytest.mark.slow
+def test_client_mode_fit(node_agent, tmp_root):
+    """Ray-Client parity (reference tests/test_client.py:17-23): the driver
+    contributes zero resources; the example's train function runs with every
+    worker placed on the remote node."""
+    from examples.ray_client_example import train_mnist_remote
+
+    address, authkey = node_agent
+    rt.shutdown()  # a pure client-mode runtime: local node must be empty
+    try:
+        rt.init(address=f"{address[0]}:{address[1]}", authkey=authkey)
+        assert rt.is_connected()
+        # driver node is unschedulable in client mode
+        local = next(n for n in rt.nodes() if not n["remote"])
+        assert local["total"].get("CPU", 0.0) == 0.0
+
+        trainer = train_mnist_remote(
+            f"{address[0]}:{address[1]}", authkey,
+            {"lr": 1e-2, "batch_size": 32},
+            num_workers=2, max_epochs=1,
+        )
+        assert trainer.state.status == "finished"
+        assert "ptl/val_loss" in trainer.callback_metrics
+    finally:
+        # don't leave a client-mode runtime (0-CPU local node + soon-dead
+        # agent) behind for later tests
+        rt.shutdown()
