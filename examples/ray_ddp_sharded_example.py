@@ -42,5 +42,5 @@ if __name__ == "__main__":
     dm = SyntheticLMDataModule(cfg, batch_size=8, n_train=64)
     trainer.fit(model, datamodule=dm)
     perf = {k: float(v) for k, v in trainer.callback_metrics.items()
-            if k in ("step_time_s", "samples_per_sec", "tokens_per_sec_per_chip", "mfu")}
+            if k in ("step_time_s", "samples_per_sec", "tokens_per_sec_per_chip", "train_mfu")}
     print("perf:", perf)

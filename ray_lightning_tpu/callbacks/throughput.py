@@ -64,6 +64,24 @@ class ThroughputMonitor(Callback):
         self._steps_since_sync = 0
         self._batch_size: Optional[int] = None
 
+    def setup(self, trainer, module, stage: str) -> None:
+        # adopt the module's advertised throughput numbers when the user
+        # didn't hand-feed them (llama advertises flops/tokens per sample)
+        def advertised(name):
+            value = getattr(module, name, None)
+            # a module may expose these as methods (the LightningModule
+            # hooks) or plain numeric attributes
+            return value() if callable(value) else value
+
+        if self.flops_per_sample is None:
+            flops = advertised("flops_per_sample")
+            if flops:
+                self.flops_per_sample = float(flops)
+        if self.tokens_per_sample is None:
+            tokens = advertised("tokens_per_sample")
+            if tokens:
+                self.tokens_per_sample = int(tokens)
+
     @staticmethod
     def _infer_batch_size(batch) -> int:
         leaves = jax.tree_util.tree_leaves(batch)
@@ -116,7 +134,7 @@ class ThroughputMonitor(Callback):
             )
         if self.flops_per_sample:
             achieved = global_batch * self.flops_per_sample / step_time / n_chips
-            out["mfu"] = achieved / (detect_peak_tflops() * 1e12)
+            out["train_mfu"] = achieved / (detect_peak_tflops() * 1e12)
         return out
 
     def on_train_end(self, trainer, module) -> None:

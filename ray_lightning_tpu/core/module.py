@@ -198,6 +198,18 @@ class LightningModule:
         raise NotImplementedError
 
     # ------------------------------------------------------------------ #
+    # throughput advertisement (consumed by ThroughputMonitor so MFU /
+    # tokens-per-sec appear without hand-fed arithmetic)
+    # ------------------------------------------------------------------ #
+    def flops_per_sample(self) -> Optional[float]:
+        """Training FLOPs for ONE sample (fwd+bwd), or None if unknown."""
+        return None
+
+    def tokens_per_sample(self) -> Optional[int]:
+        """Tokens per sample for LM-style throughput, or None."""
+        return None
+
+    # ------------------------------------------------------------------ #
     # logging
     # ------------------------------------------------------------------ #
     def log(

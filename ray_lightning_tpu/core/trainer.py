@@ -38,6 +38,7 @@ from ray_lightning_tpu.core.module import LightningModule
 from ray_lightning_tpu.loggers.base import Logger
 from ray_lightning_tpu.loggers.csv_logger import CSVLogger
 from ray_lightning_tpu.strategies.base import Strategy, XLAStrategy
+from ray_lightning_tpu.utils.precision import cast_floats, parse_precision
 from ray_lightning_tpu.utils.seed import seed_everything
 from ray_lightning_tpu.utils.serialization import to_state_stream, load_state_stream
 
@@ -109,7 +110,7 @@ class Trainer:
         limit_predict_batches: Optional[Union[int, float]] = None,
         gradient_clip_val: Optional[float] = None,
         accumulate_grad_batches: int = 1,
-        precision: str = "32-true",
+        precision: Optional[Union[str, int]] = None,
         seed: Optional[int] = None,
         enable_progress_bar: bool = False,
         fast_dev_run: bool = False,
@@ -147,6 +148,9 @@ class Trainer:
         self.gradient_clip_val = gradient_clip_val
         self.accumulate_grad_batches = accumulate_grad_batches
         self.precision = precision
+        # PTL parity: precision is a real dtype policy, not a stored string
+        # (None = module-owned dtypes; see utils/precision.py)
+        self.precision_policy = parse_precision(precision)
         self.seed = seed
         self.enable_progress_bar = enable_progress_bar
         self.fast_dev_run = fast_dev_run
@@ -376,12 +380,36 @@ class Trainer:
     # optimizer normalization
     # ------------------------------------------------------------------ #
     def _normalize_tx(self, configured) -> optax.GradientTransformation:
-        if isinstance(configured, dict):
+        if isinstance(configured, dict) and "optimizers" in configured:
+            # several optimizers over DISJOINT parameter groups (the common
+            # "different lr/opt for head vs body"): optax.multi_transform
+            # routes each labeled leaf to its transformation inside ONE
+            # compiled step. configure_optimizers returns
+            #   {"optimizers": {label: tx, ...},
+            #    "param_labels": label_pytree | callable(params)->labels}
+            labels = configured.get("param_labels")
+            if labels is None:
+                raise ValueError(
+                    "configure_optimizers returned {'optimizers': ...} "
+                    "without 'param_labels' (a pytree of labels matching "
+                    "the params, or a callable params -> labels)"
+                )
+            configured = optax.multi_transform(configured["optimizers"], labels)
+        elif isinstance(configured, dict):
             configured = configured.get("optimizer", configured)
         # optax transforms are NamedTuples; only unwrap plain containers
         if isinstance(configured, (list, tuple)) and not hasattr(configured, "update"):
             if len(configured) != 1:
-                raise ValueError("multiple optimizers are not supported")
+                raise ValueError(
+                    "PTL-style ALTERNATING optimizers (optimizer_idx) are "
+                    "not supported: every trainable step is one compiled "
+                    "XLA program, and alternating programs would recompile "
+                    "or double the step count. For per-parameter-group "
+                    "optimizers return {'optimizers': {label: tx}, "
+                    "'param_labels': ...} (optax.multi_transform); for "
+                    "GAN-style alternation, alternate inside training_step "
+                    "on `step % 2` with lax.cond."
+                )
             configured = configured[0]
         if not hasattr(configured, "update"):
             raise TypeError(
@@ -400,11 +428,18 @@ class Trainer:
     def _build_train_step(self):
         module = self._module
         tx = self._tx
+        policy = self.precision_policy
+        compute_dtype = policy.compute_dtype
 
         def train_step(params, opt_state, batch, rng_root, step):
             rng = jax.random.fold_in(rng_root, step)
+            batch = cast_floats(batch, compute_dtype)
 
             def loss_fn(p):
+                if policy.cast_params_in_compute:
+                    # mixed precision: forward/backward on a bf16 view of
+                    # the fp32 masters (grads flow back to the masters)
+                    p = cast_floats(p, compute_dtype)
                 module._capture_begin("train", rng)
                 out = module.training_step(p, batch, step)
                 logs = module._capture_end()
@@ -441,7 +476,13 @@ class Trainer:
             "test": module.test_step,
         }[phase]
 
+        policy = self.precision_policy
+        compute_dtype = policy.compute_dtype
+
         def eval_step(params, batch, step):
+            batch = cast_floats(batch, compute_dtype)
+            if policy.cast_params_in_compute:
+                params = cast_floats(params, compute_dtype)
             module._capture_begin(phase)
             out = step_fn(params, batch, step)
             logs = module._capture_end()
@@ -461,6 +502,7 @@ class Trainer:
         self.strategy.setup_environment()
         if hasattr(model, "mesh"):
             model.mesh = self.strategy.mesh
+        model.precision_policy = self.precision_policy
 
         if datamodule is not None:
             datamodule.prepare_data()
@@ -481,6 +523,7 @@ class Trainer:
         host_params = model._params if model._params is not None else model.init_params(
             self._rng_root
         )
+        host_params = cast_floats(host_params, self.precision_policy.param_dtype)
         self._params = self.strategy.place_params(host_params)
         self._tx = self._normalize_tx(model.configure_optimizers())
         opt_shapes = jax.eval_shape(self._tx.init, self._params)
@@ -772,7 +815,10 @@ class Trainer:
             model._params = ckpt["state_dict"]
         if model._params is None:
             raise ValueError(f"{phase} requires trained params (fit first or pass ckpt_path)")
-        self._params = self.strategy.place_params(model._params)
+        model.precision_policy = self.precision_policy
+        self._params = self.strategy.place_params(
+            cast_floats(model._params, self.precision_policy.param_dtype)
+        )
 
         eval_step = self._build_eval_step(phase)
         limit = self.limit_val_batches if phase == "val" else self.limit_test_batches
@@ -807,11 +853,19 @@ class Trainer:
             model._params = ckpt["state_dict"]
         if model._params is None:
             raise ValueError("predict requires trained params")
-        self._params = self.strategy.place_params(model._params)
+        model.precision_policy = self.precision_policy
+        self._params = self.strategy.place_params(
+            cast_floats(model._params, self.precision_policy.param_dtype)
+        )
         module = model
+
+        policy = self.precision_policy
 
         @jax.jit
         def predict_step(params, batch, step):
+            batch = cast_floats(batch, policy.compute_dtype)
+            if policy.cast_params_in_compute:
+                params = cast_floats(params, policy.compute_dtype)
             module._capture_begin("predict")
             out = module.predict_step(params, batch, step)
             module._capture_end()
@@ -867,10 +921,13 @@ class Trainer:
     def _restore_checkpoint(self, ckpt_path: str) -> None:
         with open(ckpt_path, "rb") as f:
             ckpt = load_state_stream(f.read())
-        # params: restore into the existing (possibly sharded) structure
+        # params: restore into the existing (possibly sharded) structure;
+        # re-apply the precision policy — the checkpoint may carry different
+        # dtypes than this run requests (e.g. fp32 ckpt, bf16-true resume)
         host_params = flax_serialization.from_state_dict(
             jax.device_get(self._params), ckpt["state_dict"]
         )
+        host_params = cast_floats(host_params, self.precision_policy.param_dtype)
         self._params = self.strategy.place_params(host_params)
         if "optimizer_state" in ckpt and self._opt_state is not None:
             host_opt = flax_serialization.from_state_dict(

@@ -458,6 +458,14 @@ class LlamaModule(LightningModule):
         )
         return optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=self.weight_decay)
 
+    def flops_per_sample(self) -> float:
+        """Advertised to ThroughputMonitor: every llama fit logs train_mfu
+        without hand-fed arithmetic (VERDICT r1 #9)."""
+        return self.config.flops_per_token() * self.config.max_seq
+
+    def tokens_per_sample(self) -> int:
+        return self.config.max_seq
+
 
 from ray_lightning_tpu.core.datamodule import LightningDataModule
 

@@ -1,0 +1,99 @@
+"""Precision policy: PTL's ``precision`` Trainer arg made real on TPU.
+
+The reference inherits precision handling from PTL 1.6 (AMP/GradScaler on
+GPU). On TPU the native story is simpler and different: bf16 is the MXU's
+fast dtype, fp16 buys nothing and loses exponent range, and there is no
+GradScaler because bf16 keeps fp32's exponent. The policy therefore maps:
+
+- ``None`` (default)   -> module-owned dtypes (the zoo hand-tunes bf16
+  compute with fp32 accumulators already; nothing is touched)
+- ``"32-true"`` / 32   -> params and compute fp32
+- ``"bf16"``/``"bf16-mixed"`` -> fp32 master weights; the compiled step
+  runs forward/backward on a bf16 cast of params and float inputs
+  (gradients land back on the fp32 masters)
+- ``"bf16-true"``      -> params and compute bf16
+- ``"64-true"`` / 64   -> fp64 (requires jax_enable_x64)
+- ``"16-mixed"/"16-true"`` -> mapped to the bf16 twin with a warning
+  (fp16 on TPU is a portability trap, not a speedup)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ray_lightning_tpu.utils.common import rank_zero_warn
+
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    name: str
+    param_dtype: Optional[Any]  # None = leave module-owned dtypes alone
+    compute_dtype: Optional[Any]
+
+    @property
+    def active(self) -> bool:
+        return self.param_dtype is not None or self.compute_dtype is not None
+
+    @property
+    def cast_params_in_compute(self) -> bool:
+        """Mixed precision = fp32 master weights, bf16 compute: the step
+        casts a bf16 VIEW of the params for the forward/backward (autodiff
+        through the cast yields fp32 master gradients). Without this, JAX
+        type promotion (fp32 param x bf16 input -> fp32) would silently
+        undo the whole policy."""
+        return self.name.endswith("-mixed")
+
+
+_POLICIES = {
+    "32-true": ("32-true", jnp.float32, jnp.float32),
+    "bf16-mixed": ("bf16-mixed", None, jnp.bfloat16),
+    "bf16-true": ("bf16-true", jnp.bfloat16, jnp.bfloat16),
+    "64-true": ("64-true", jnp.float64, jnp.float64),
+}
+_ALIASES = {"bf16": "bf16-mixed"}  # PTL's common spelling
+_FP16_ALIASES = {"16-mixed": "bf16-mixed", "16-true": "bf16-true", "16": "bf16-mixed"}
+
+
+def parse_precision(precision: Union[str, int, None]) -> PrecisionPolicy:
+    if precision is None:
+        return PrecisionPolicy("default", None, None)
+    key = str(precision)
+    if key in ("32", "64"):
+        key += "-true"
+    key = _ALIASES.get(key, key)
+    if key in _FP16_ALIASES:
+        rank_zero_warn(
+            "precision=%r: fp16 has no advantage on TPU (bf16 is the MXU "
+            "dtype and keeps fp32 exponent range); using %s instead",
+            precision,
+            _FP16_ALIASES[key],
+        )
+        key = _FP16_ALIASES[key]
+    if key not in _POLICIES:
+        raise ValueError(
+            f"unknown precision {precision!r}; supported: "
+            f"{sorted(_POLICIES)} (or 16-mixed/16-true, mapped to bf16)"
+        )
+    name, param_dtype, compute_dtype = _POLICIES[key]
+    if name == "64-true" and not jax.config.jax_enable_x64:
+        raise ValueError(
+            "precision='64-true' requires jax_enable_x64 "
+            "(set JAX_ENABLE_X64=1 or jax.config.update('jax_enable_x64', True))"
+        )
+    return PrecisionPolicy(name, param_dtype, compute_dtype)
+
+
+def cast_floats(tree: Any, dtype) -> Any:
+    """Cast floating leaves to ``dtype``; integer/bool leaves untouched."""
+    if dtype is None:
+        return tree
+
+    def cast(leaf):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.astype(dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(cast, tree)

