@@ -102,6 +102,7 @@ class _RuntimeState:
         self.nodes: List[_Node] = []
         # monotonic so ids never recycle across disconnect/connect cycles
         self.next_node_id = 1
+        self.zygote = None  # lazy ZygoteClient when RLT_ZYGOTE=1
 
 
 _state = _RuntimeState()
@@ -263,6 +264,9 @@ def shutdown() -> None:
         return
     for name in list(_state.actors):
         kill(_state.actors[name][0])
+    if _state.zygote is not None:
+        _state.zygote.shutdown()
+        _state.zygote = None
     if _state.store is not None:
         _state.store.shutdown()
         _state.store = None
@@ -391,6 +395,25 @@ def create_actor(
     return handles[0]
 
 
+def _use_zygote() -> bool:
+    return os.environ.get("RLT_ZYGOTE") == "1"
+
+
+def _get_zygote():
+    from ray_lightning_tpu.runtime.zygote import ZygoteClient
+
+    # a dead/desynced zygote is discarded and replaced, not reused
+    if _state.zygote is not None and not _state.zygote.alive():
+        try:
+            _state.zygote.shutdown()
+        except Exception:
+            pass
+        _state.zygote = None
+    if _state.zygote is None:
+        _state.zygote = ZygoteClient()
+    return _state.zygote
+
+
 def _spawn_local_proc(
     cls: type,
     args: Sequence[Any],
@@ -490,6 +513,24 @@ def create_actors(
                 child_env = _merge_child_env(
                     env, per_actor_env[i] if per_actor_env else None
                 )
+                if _use_zygote():
+                    # preload-fork path: millisecond boots instead of a
+                    # fresh jax-importing interpreter per actor
+                    try:
+                        port, pid = _get_zygote().spawn(
+                            cls, args, kwargs, authkey, child_env, timeout
+                        )
+                    except Exception as e:
+                        _get_node(node_id).release(name)
+                        errors.append(f"{name}: {e}")
+                        continue
+                    handle = ActorHandle(
+                        name=name, address=("127.0.0.1", port),
+                        authkey=authkey, pid=pid,
+                    )
+                    _state.actors[name] = (handle, None, node_id)
+                    handles.append(handle)
+                    continue
                 proc = _spawn_local_proc(cls, args, kwargs, authkey, child_env)
                 local_pending.append((name, authkey, proc, node_id))
             else:
@@ -676,6 +717,37 @@ def kill(handle: ActorHandle, no_restart: bool = True, timeout: float = 5.0) -> 
                     proc.wait(timeout=timeout)
                 except subprocess.TimeoutExpired:
                     proc.kill()
+        elif getattr(handle, "_pid", 0):
+            # zygote-forked child: not our subprocess, reaped by the
+            # zygote's SIGCHLD handler — poll for exit, then escalate
+            _wait_pid_exit(handle._pid, timeout)
+
+
+def _wait_pid_exit(pid: int, timeout: float) -> None:
+    import signal as _signal
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except (ProcessLookupError, PermissionError):
+            # gone — or the pid was recycled to another user's process
+            # (possible since the zygote reaps children instantly); either
+            # way it is not ours to signal anymore
+            return
+        time.sleep(0.05)
+    for sig in (_signal.SIGTERM, _signal.SIGKILL):
+        try:
+            os.kill(pid, sig)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                os.kill(pid, 0)
+            except (ProcessLookupError, PermissionError):
+                return
+            time.sleep(0.05)
 
 
 def put(obj: Any) -> ObjectRef:

@@ -33,6 +33,12 @@ if not os.environ.get("RLT_TEST_ON_TPU"):
 # does the same thing by passing num_cpus=2/4 to ray.init in its fixtures.
 os.environ.setdefault("RLT_NUM_CPUS", "64")
 
+# Preload-fork actor spawning (runtime/zygote.py): pays the ~15-20s
+# jax-import interpreter boot once instead of per worker actor — measured
+# 9:44 -> 3:59 on the slow (multi-worker) test suite. Set RLT_ZYGOTE=0 to
+# exercise the classic one-interpreter-per-actor path.
+os.environ.setdefault("RLT_ZYGOTE", "1")
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,81 @@
+"""Preload-fork (zygote) actor spawning: boot cost amortization, env
+application after fork, independent child backends, kill semantics."""
+import os
+import time
+
+import pytest
+
+from ray_lightning_tpu import runtime as rt
+
+
+def _make_counter_cls():
+    # by-value pickling (see test_multihost._make_echo_cls)
+    class _Counter:
+        def __init__(self, start=0):
+            self.x = start
+
+        def incr(self, by=1):
+            self.x += by
+            return self.x
+
+        def pid(self):
+            import os as _os
+
+            return _os.getpid()
+
+        def device_count(self):
+            import jax as _j
+
+            return _j.local_device_count()
+
+    return _Counter
+
+
+@pytest.mark.slow
+def test_zygote_spawn_fast_and_isolated(monkeypatch):
+    monkeypatch.setenv("RLT_ZYGOTE", "1")
+    rt.init()
+    Counter = _make_counter_cls()
+    a = rt.create_actor(Counter, args=(5,), env={"JAX_PLATFORMS": "cpu"})
+    t0 = time.perf_counter()
+    b = rt.create_actor(
+        Counter,
+        args=(100,),
+        env={
+            "JAX_PLATFORMS": "cpu",
+            # post-fork env must still steer the child's backend init
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        },
+    )
+    fork_spawn = time.perf_counter() - t0
+    assert fork_spawn < 5.0, f"fork spawn took {fork_spawn:.1f}s"
+
+    assert a.incr.remote(3).result(timeout=30) == 8
+    assert b.incr.remote().result(timeout=30) == 101
+    pa = a.pid.remote().result(timeout=30)
+    pb = b.pid.remote().result(timeout=30)
+    assert pa != pb != os.getpid()
+    # the child initialized its OWN backend with its own flags
+    assert b.device_count.remote().result(timeout=60) == 4
+
+    rt.kill(a)
+    rt.kill(b)
+    for pid in (pa, pb):
+        with pytest.raises(ProcessLookupError):
+            os.kill(pid, 0)
+
+
+@pytest.mark.slow
+def test_zygote_construction_error_surfaces(monkeypatch):
+    monkeypatch.setenv("RLT_ZYGOTE", "1")
+    rt.init()
+
+    def _bad_cls():
+        class _Boom:
+            def __init__(self):
+                raise RuntimeError("ctor kaboom")
+
+        return _Boom
+
+    with pytest.raises(rt.ActorError, match="kaboom"):
+        rt.create_actor(_bad_cls(), env={"JAX_PLATFORMS": "cpu"})
