@@ -3,12 +3,23 @@ reference einsum path.
 
 Design (TPU-first):
 - layout [B, H, S, D] so the inner dots are MXU-shaped [BQ, D] x [D, BK];
-- forward: online-softmax over KV blocks (fp32 accumulators carried through
-  a fori_loop, bf16 inputs), causal block skipping via the loop bound;
-- backward: recompute-based (no S x S materialization): a dQ kernel looping
-  KV blocks and a dK/dV kernel looping Q blocks, both seeded with the saved
-  per-row logsumexp and delta = rowsum(dO * O);
+- FULLY BLOCKED grids: no ref ever pins a whole [S, D] tensor in VMEM —
+  both sequence axes are grid dimensions, so VMEM use is O(block^2)
+  regardless of S (8k+ sequences fit; the round-1 kernels pinned full
+  K/V per q block and full Q/dO per kv block, which could not scale);
+- forward: online softmax with fp32 scratch accumulators (acc/m/l)
+  persisted across the innermost (KV) grid dimension — TPU grids iterate
+  sequentially on a core, so scratch carries state between steps;
+- causal skipping: fully-masked blocks are skipped with pl.when on STATIC
+  grid indices (replaces round 1's dynamic fori_loop bound, a flagged
+  perf suspect);
+- backward: recompute-based (no S x S materialization): a dQ kernel
+  accumulating over KV blocks and a dK/dV kernel accumulating over Q
+  blocks, seeded with the saved per-row logsumexp and
+  delta = rowsum(dO * O);
 - GQA: KV-head index derived in the BlockSpec index map (no repeat/copy);
+- block sizes default 512x512, env-tunable (RLT_FLASH_BLOCK_Q/K) for
+  on-chip sweeps;
 - `interpret=True` runs the same kernels on CPU for numerical tests.
 
 The reference project has no attention of its own (it wraps user torch
@@ -63,25 +74,32 @@ def reference_attention(
 
 
 # --------------------------------------------------------------------- #
-# pallas forward
+# pallas forward: grid (b, h, n_q, n_kv), KV innermost; acc/m/l live in
+# fp32 VMEM scratch carried across the KV steps of one q block
 # --------------------------------------------------------------------- #
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale, causal, block_q, block_k):
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, l_ref, acc_scr, m_scr, l_scr,
+    *, scale, causal, block_q, block_k, n_kv,
+):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
-    q = q_ref[:]  # [BQ, D] input dtype; dots accumulate in fp32
-    skv = k_ref.shape[0]
-    n_kv = skv // block_k
-    if causal:
-        # only blocks whose first kv index <= last q index
-        hi = jax.lax.min(((qi + 1) * block_q + block_k - 1) // block_k, n_kv)
-    else:
-        hi = n_kv
+    kj = pl.program_id(3)
 
-    def body(j, carry):
-        acc, m, l = carry
-        ks = k_ref[pl.ds(j * block_k, block_k), :]
-        vs = v_ref[pl.ds(j * block_k, block_k), :]
+    @pl.when(kj == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    # causal: skip blocks whose first kv index exceeds the last q index
+    active = _block_active(qi, kj, block_q, block_k, causal)
+
+    @pl.when(active)
+    def _update():
+        q = q_ref[:]  # [BQ, D] input dtype; dots accumulate in fp32
+        ks = k_ref[:]
+        vs = v_ref[:]
         s = (
             jax.lax.dot_general(
                 q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -90,54 +108,58 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale, causal, block_q, bl
         )  # [BQ, BK] fp32
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            cols = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(rows >= cols, s, -jnp.inf)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape,
+        )
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(vs.dtype), vs, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return acc, m_new, l
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
-    d = q_ref.shape[-1]
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
-    # logsumexp per row, columnar [BQ, 1] (TPU tiling wants the blocked
-    # seq dim second-to-last)
-    l_ref[:] = m + jnp.log(l_safe)
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[:] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        # logsumexp per row, columnar [BQ, 1] (TPU tiling wants the
+        # blocked seq dim second-to-last)
+        l_ref[:] = m_scr[:, :1] + jnp.log(l_safe)
 
 
 # --------------------------------------------------------------------- #
-# pallas backward: dQ
+# pallas backward: dQ — grid (b, h, n_q, n_kv), accumulating over KV
 # --------------------------------------------------------------------- #
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, scale, causal, block_q, block_k,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale, causal, block_q, block_k, n_kv,
 ):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
-    q = q_ref[:]  # [BQ, D] input dtype
-    do = do_ref[:]
-    lse = lse_ref[:]  # [BQ, 1] fp32
-    delta = delta_ref[:]
-    skv = k_ref.shape[0]
-    n_kv = skv // block_k
-    if causal:
-        hi = jax.lax.min(((qi + 1) * block_q + block_k - 1) // block_k, n_kv)
-    else:
-        hi = n_kv
+    kj = pl.program_id(3)
 
-    def body(j, dq):
-        ks = k_ref[pl.ds(j * block_k, block_k), :]
-        vs = v_ref[pl.ds(j * block_k, block_k), :]
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    active = _block_active(qi, kj, block_q, block_k, causal)
+
+    @pl.when(active)
+    def _update():
+        q = q_ref[:]
+        do = do_ref[:]
+        lse = lse_ref[:]  # [BQ, 1] fp32
+        delta = delta_ref[:]
+        ks = k_ref[:]
+        vs = v_ref[:]
         s = (
             jax.lax.dot_general(
                 q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -146,44 +168,51 @@ def _bwd_dq_kernel(
         )
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            cols = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(rows >= cols, s, -jnp.inf)
         p = jnp.exp(s - lse)  # [BQ, BK]
         dp = jax.lax.dot_general(
             do, vs, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = (p * (dp - delta) * scale).astype(ks.dtype)
-        return dq + jax.lax.dot_general(
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds, ks, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    d = q_ref.shape[-1]
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[:] = dq.astype(dq_ref.dtype)
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
 
 
 # --------------------------------------------------------------------- #
-# pallas backward: dK, dV (one grid step per KV block, loop over Q blocks)
+# pallas backward: dK, dV — grid (b, h, n_kv, n_q), accumulating over Q
 # --------------------------------------------------------------------- #
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, scale, causal, block_q, block_k,
+    dk_scr, dv_scr,
+    *, scale, causal, block_q, block_k, n_q,
 ):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
-    ks = k_ref[:]  # [BK, D] input dtype
-    vs = v_ref[:]
-    sq = q_ref.shape[0]
-    n_q = sq // block_q
-    lo = (ki * block_k) // block_q if causal else 0
+    qi = pl.program_id(3)
 
-    def body(i, carry):
-        dk, dv = carry
-        qs = q_ref[pl.ds(i * block_q, block_q), :]
-        do = do_ref[pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[pl.ds(i * block_q, block_q), :]
-        delta = delta_ref[pl.ds(i * block_q, block_q), :]
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # causal: a q block entirely above the diagonal contributes nothing
+    active = _block_active(qi, ki, block_q, block_k, causal)
+
+    @pl.when(active)
+    def _update():
+        ks = k_ref[:]  # [BK, D] input dtype
+        vs = v_ref[:]
+        qs = q_ref[:]
+        do = do_ref[:]
+        lse = lse_ref[:]
+        delta = delta_ref[:]
         s = (
             jax.lax.dot_general(
                 qs, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -191,11 +220,11 @@ def _bwd_dkv_kernel(
             * scale
         )
         if causal:
-            rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(rows >= cols, s, -jnp.inf)
         p = jnp.exp(s - lse)  # [BQ, BK]
-        dv = dv + jax.lax.dot_general(
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -203,26 +232,69 @@ def _bwd_dkv_kernel(
             do, vs, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = (p * (dp - delta) * scale).astype(qs.dtype)
-        dk = dk + jax.lax.dot_general(
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds, qs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return dk, dv
 
-    d = q_ref.shape[-1]
-    dk0 = jnp.zeros((block_k, d), jnp.float32)
-    dv0 = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lo, n_q, body, (dk0, dv0))
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
 # --------------------------------------------------------------------- #
 # pallas_call wrappers
 # --------------------------------------------------------------------- #
+def _env_block(name: str, default: int, s: int) -> int:
+    raw = os.environ.get(name, str(default))
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an integer block size")
+    if value <= 0 or value % 8:
+        raise ValueError(f"{name}={value}: block sizes must be positive multiples of 8")
+    return min(value, s)
+
+
 def _pick_blocks(s: int):
-    bq = min(512, s)
-    bk = min(512, s)
+    """Default 512x512; env-tunable for on-chip block sweeps.
+
+    NOTE: the env vars are read at trace time and are NOT part of jit
+    cache keys — sweep one setting per process (bench.py's child-process
+    structure does this naturally)."""
+    bq = _env_block("RLT_FLASH_BLOCK_Q", 512, s)
+    bk = _env_block("RLT_FLASH_BLOCK_K", 512, s)
     return bq, bk
+
+
+def _block_active(row_blk, col_blk, block_q: int, block_k: int, causal: bool):
+    """Does q block `row_blk` intersect kv block `col_blk` under the causal
+    mask? (Trivially-true traced predicate when not causal, so pl.when
+    always receives a tracer.) Shared by all three kernels."""
+    if causal:
+        return col_blk * block_k <= (row_blk + 1) * block_q - 1
+    return col_blk >= 0
+
+
+def _kv_index_map(group: int, bq: int, bk: int, causal: bool):
+    """KV BlockSpec index map for grids (b, h, i, j). Under the causal mask,
+    masked steps CLAMP their kv index to the last active block: revisiting
+    the already-resident block elides the DMA, so skipped steps cost
+    neither compute (pl.when in the kernel) nor HBM bandwidth."""
+    if causal:
+        return lambda b_, h, i, j, g=group: (
+            b_, h // g, jnp.minimum(j, ((i + 1) * bq - 1) // bk), 0
+        )
+    return lambda b_, h, i, j, g=group: (b_, h // g, j, 0)
+
+
+def _q_index_map_for_dkv(bq: int, bk: int, causal: bool):
+    """Q-side BlockSpec index map for the dK/dV grid (b, h, j, i). The
+    inactive leading steps (q blocks fully above the diagonal) clamp UP to
+    the first active q block — same DMA-eliding trick as _kv_index_map."""
+    if causal:
+        return lambda b_, h, j, i: (b_, h, jnp.maximum(i, (j * bk) // bq), 0)
+    return lambda b_, h, j, i: (b_, h, i, 0)
 
 
 def _flash_fwd(q, k, v, causal, scale, interpret):
@@ -234,25 +306,33 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
     group = hq // hkv
     skv = k.shape[2]
     bq, bk = _pick_blocks(sq)
+    n_kv = skv // bk
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        n_kv=n_kv,
     )
+    kv_idx = _kv_index_map(group, bq, bk, causal)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b, hq, sq // bq),
+        grid=(b, hq, sq // bq, n_kv),
         in_specs=[
-            pl.BlockSpec((None, None, bq, d), lambda b_, h, i: (b_, h, i, 0)),
-            pl.BlockSpec((None, None, skv, d), lambda b_, h, i, g=group: (b_, h // g, 0, 0)),
-            pl.BlockSpec((None, None, skv, d), lambda b_, h, i, g=group: (b_, h // g, 0, 0)),
+            pl.BlockSpec((None, None, bq, d), lambda b_, h, i, j: (b_, h, i, 0)),
+            pl.BlockSpec((None, None, bk, d), kv_idx),
+            pl.BlockSpec((None, None, bk, d), kv_idx),
         ],
         out_specs=[
-            pl.BlockSpec((None, None, bq, d), lambda b_, h, i: (b_, h, i, 0)),
-            pl.BlockSpec((None, None, bq, 1), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((None, None, bq, d), lambda b_, h, i, j: (b_, h, i, 0)),
+            pl.BlockSpec((None, None, bq, 1), lambda b_, h, i, j: (b_, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b, hq, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),    # acc
+            pltpu.VMEM((bq, 128), jnp.float32),  # running max (lane-replicated)
+            pltpu.VMEM((bq, 128), jnp.float32),  # running sum (lane-replicated)
         ],
         interpret=interpret,
     )(q, k, v)
@@ -261,54 +341,66 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
 
 def _flash_bwd(q, k, v, out, lse, do, causal, scale, interpret):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, hq, sq, d = q.shape
     hkv = k.shape[1]
     group = hq // hkv
     skv = k.shape[2]
     bq, bk = _pick_blocks(sq)
+    n_q = sq // bq
+    n_kv = skv // bk
 
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1, keepdims=True)
 
+    kv_idx = _kv_index_map(group, bq, bk, causal)
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+            _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+            n_kv=n_kv,
         ),
-        grid=(b, hq, sq // bq),
+        grid=(b, hq, n_q, n_kv),
         in_specs=[
-            pl.BlockSpec((None, None, bq, d), lambda b_, h, i: (b_, h, i, 0)),
-            pl.BlockSpec((None, None, skv, d), lambda b_, h, i, g=group: (b_, h // g, 0, 0)),
-            pl.BlockSpec((None, None, skv, d), lambda b_, h, i, g=group: (b_, h // g, 0, 0)),
-            pl.BlockSpec((None, None, bq, d), lambda b_, h, i: (b_, h, i, 0)),
-            pl.BlockSpec((None, None, bq, 1), lambda b_, h, i: (b_, h, i, 0)),
-            pl.BlockSpec((None, None, bq, 1), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((None, None, bq, d), lambda b_, h, i, j: (b_, h, i, 0)),
+            pl.BlockSpec((None, None, bk, d), kv_idx),
+            pl.BlockSpec((None, None, bk, d), kv_idx),
+            pl.BlockSpec((None, None, bq, d), lambda b_, h, i, j: (b_, h, i, 0)),
+            pl.BlockSpec((None, None, bq, 1), lambda b_, h, i, j: (b_, h, i, 0)),
+            pl.BlockSpec((None, None, bq, 1), lambda b_, h, i, j: (b_, h, i, 0)),
         ],
-        out_specs=pl.BlockSpec((None, None, bq, d), lambda b_, h, i: (b_, h, i, 0)),
+        out_specs=pl.BlockSpec((None, None, bq, d), lambda b_, h, i, j: (b_, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
     # dK/dV are computed per Q-head then reduced over the GQA group
+    q_idx = _q_index_map_for_dkv(bq, bk, causal)
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+            n_q=n_q,
         ),
-        grid=(b, hq, skv // bk),
+        grid=(b, hq, n_kv, n_q),
         in_specs=[
-            pl.BlockSpec((None, None, sq, d), lambda b_, h, j: (b_, h, 0, 0)),
-            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, g=group: (b_, h // g, j, 0)),
-            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, g=group: (b_, h // g, j, 0)),
-            pl.BlockSpec((None, None, sq, d), lambda b_, h, j: (b_, h, 0, 0)),
-            pl.BlockSpec((None, None, sq, 1), lambda b_, h, j: (b_, h, 0, 0)),
-            pl.BlockSpec((None, None, sq, 1), lambda b_, h, j: (b_, h, 0, 0)),
+            pl.BlockSpec((None, None, bq, d), q_idx),
+            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, i, g=group: (b_, h // g, j, 0)),
+            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, i, g=group: (b_, h // g, j, 0)),
+            pl.BlockSpec((None, None, bq, d), q_idx),
+            pl.BlockSpec((None, None, bq, 1), q_idx),
+            pl.BlockSpec((None, None, bq, 1), q_idx),
         ],
         out_specs=[
-            pl.BlockSpec((None, None, bk, d), lambda b_, h, j: (b_, h, j, 0)),
-            pl.BlockSpec((None, None, bk, d), lambda b_, h, j: (b_, h, j, 0)),
+            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, i: (b_, h, j, 0)),
+            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, i: (b_, h, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, skv, d), q.dtype),
             jax.ShapeDtypeStruct((b, hq, skv, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)

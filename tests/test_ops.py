@@ -120,3 +120,32 @@ def test_rope_rotation_preserves_norm():
     norm_in = jnp.linalg.norm(x, axis=-1)
     norm_out = jnp.linalg.norm(out, axis=-1)
     assert float(jnp.max(jnp.abs(norm_in - norm_out))) < 1e-4
+
+
+def test_flash_multiblock_grid(monkeypatch):
+    """Force small blocks so the grid really iterates (4 q-blocks x 4
+    kv-blocks): exercises the scratch-accumulator handoff across grid steps
+    that makes VMEM O(block^2) instead of O(S)."""
+    monkeypatch.setenv("RLT_FLASH_BLOCK_Q", "64")
+    monkeypatch.setenv("RLT_FLASH_BLOCK_K", "64")
+    q, k, v = _qkv(1, 2, 1, 256, 128)  # GQA group 2 as well
+    for causal in (True, False):
+        ref = reference_attention(q, k, v, causal=causal)
+        out = attention(q, k, v, causal=causal, impl="flash", interpret=True)
+        assert float(jnp.max(jnp.abs(ref - out))) < 1e-4, causal
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+    for causal in (True, False):
+        g_ref = jax.grad(
+            loss(lambda q, k, v: reference_attention(q, k, v, causal=causal)),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g_fl = jax.grad(
+            loss(lambda q, k, v: attention(q, k, v, causal=causal, impl="flash", interpret=True)),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(g_ref, g_fl):
+            rel = float(jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(a)) + 1e-9))
+            assert rel < 1e-4, causal
