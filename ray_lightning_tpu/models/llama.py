@@ -234,22 +234,31 @@ def _act_constraint(x, mesh: Optional[Mesh], *entries):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
-def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn):
+def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None):
     """One transformer block (pre-norm attention + gated MLP / MoE) shared
     by the scanned dense path and the pipeline stage path — the math must
-    stay identical between them."""
+    stay identical between them.
+
+    Head counts come from the weight shapes (not cfg) so the same code runs
+    on tp-local shards inside shard_map: with wq/wk/wv column-sharded over
+    'tp' each device computes its head slice, and ``reduce_fn`` (a psum over
+    'tp') completes the row-parallel wo / w_down matmuls — the megatron
+    pattern, expressed once."""
+    red = reduce_fn or (lambda y: y)
     B, S = x.shape[0], x.shape[1]
     hd = cfg.head_dim
+    nh = lp["wq"].shape[-1] // hd  # local heads (== cfg.n_heads unless tp-sharded)
+    nkv = lp["wk"].shape[-1] // hd
     h = rmsnorm(x, lp["attn_norm"])
-    q = (h @ lp["wq"]).reshape(B, S, cfg.n_heads, hd)
-    k = (h @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
-    v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q = (h @ lp["wq"]).reshape(B, S, nh, hd)
+    k = (h @ lp["wk"]).reshape(B, S, nkv, hd)
+    v = (h @ lp["wv"]).reshape(B, S, nkv, hd)
     q = apply_rope(q, cos, sin).swapaxes(1, 2)  # [B, H, S, hd]
     k = apply_rope(k, cos, sin).swapaxes(1, 2)
     v = v.swapaxes(1, 2)
     att = attn_fn(q, k, v)
-    att = att.swapaxes(1, 2).reshape(B, S, cfg.n_heads * hd)
-    x = x + att @ lp["wo"]
+    att = att.swapaxes(1, 2).reshape(B, S, nh * hd)
+    x = x + red(att @ lp["wo"])
     h2 = rmsnorm(x, lp["mlp_norm"])
     if cfg.n_experts and "moe" in lp:
         from ray_lightning_tpu.parallel.moe import moe_ffn
@@ -261,7 +270,7 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn):
         x = x + moe_out
     else:
         gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
-        x = x + gated @ lp["w_down"]
+        x = x + red(gated @ lp["w_down"])
         aux = jnp.float32(0.0)
     return x, aux
 
@@ -275,8 +284,10 @@ def _forward_pp(
     """Pipeline-parallel forward: the layer stack is split into pp stages
     (GPipe microbatch schedule, parallel/pipeline.py); embed and lm_head run
     replicated outside the pipeline. Composes with 'dp' (each dp group runs
-    its own pipeline on its batch shard); tp/fsdp/sp inside a stage would
-    need manual in-stage collectives and are rejected loudly."""
+    its own pipeline on its batch shard) and 'tp' (megatron layout inside
+    each stage: heads/ffn column-sharded, explicit psum after the
+    row-parallel wo/w_down matmuls); fsdp/sp inside a stage are rejected
+    loudly."""
     from ray_lightning_tpu.parallel.pipeline import pipeline_apply
 
     if cfg.n_experts:
@@ -284,16 +295,22 @@ def _forward_pp(
             "pipeline parallelism with MoE layers is not supported yet; "
             "use ep without pp (or dense layers with pp)"
         )
-    for ax in ("tp", "fsdp", "sp"):
+    for ax in ("fsdp", "sp"):
         if ax in mesh.axis_names and mesh.shape[ax] > 1:
             raise NotImplementedError(
-                f"pipeline parallelism composes with dp only for now; mesh "
-                f"has {ax}={mesh.shape[ax]}. Drop the pp axis to use {ax}."
+                f"pipeline parallelism composes with dp/tp only for now; "
+                f"mesh has {ax}={mesh.shape[ax]}. Drop the pp axis to use {ax}."
             )
     pp = mesh.shape["pp"]
+    tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
     L = cfg.n_layers
     if L % pp != 0:
         raise ValueError(f"n_layers={L} must divide into pp={pp} stages")
+    if tp > 1 and (cfg.n_heads % tp or cfg.n_kv_heads % tp or cfg.ffn_dim % tp):
+        raise ValueError(
+            f"tp={tp} must divide n_heads={cfg.n_heads}, "
+            f"n_kv_heads={cfg.n_kv_heads}, and ffn_dim={cfg.ffn_dim}"
+        )
     B, S = tokens.shape
     hd = cfg.head_dim
     x = params["embed"][tokens]
@@ -302,12 +319,13 @@ def _forward_pp(
         # rope angles recomputed per stage from static shapes (cheap; avoids
         # closing over traced values under shard_map)
         cos, sin = rope_angles(S, hd, cfg.rope_theta)
+        reduce_fn = (lambda y: jax.lax.psum(y, "tp")) if tp > 1 else None
 
         def attn_fn(q, k, v):
             return attention(q, k, v, causal=True, impl=cfg.attn_impl)
 
         def layer_fn(x, lp):
-            x, _ = _decoder_layer(x, lp, cfg, cos, sin, attn_fn)
+            x, _ = _decoder_layer(x, lp, cfg, cos, sin, attn_fn, reduce_fn)
             return x, None
 
         fn = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
@@ -318,6 +336,26 @@ def _forward_pp(
     stage_params = jax.tree_util.tree_map(
         lambda p: p.reshape(pp, L // pp, *p.shape[1:]), params["layers"]
     )
+    stage_spec = None
+    if tp > 1:
+        # derive the in-stage megatron layout from param_specs (the single
+        # source of truth for which dims are column vs row parallel): keep
+        # only the pp/tp entries and insert a None for the intra-stage
+        # layer dim the [pp, L/pp, ...] reshape introduced
+        def _to_stage_spec(spec: P) -> P:
+            def keep(e):
+                if isinstance(e, (tuple, list)):
+                    kept = tuple(a for a in e if a in ("pp", "tp"))
+                    return kept if kept else None
+                return e if e in ("pp", "tp") else None
+
+            entries = [keep(e) for e in spec]
+            return P(entries[0], None, *entries[1:])
+
+        stage_spec = jax.tree_util.tree_map(
+            _to_stage_spec, param_specs(cfg)["layers"],
+            is_leaf=lambda x: isinstance(x, P),
+        )
     m = cfg.pp_microbatches or pp
     data_spec = (
         P("dp") if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else P()
@@ -325,6 +363,7 @@ def _forward_pp(
     x = pipeline_apply(
         stage_fn, stage_params, x, mesh,
         axis="pp", num_microbatches=m, data_spec=data_spec,
+        param_spec=stage_spec,
     )
     x = rmsnorm(x, params["final_norm"])
     return x @ params["lm_head"], jnp.float32(0.0)

@@ -31,6 +31,7 @@ def pipeline_apply(
     axis: str = "pp",
     num_microbatches: int = 2,
     data_spec: P = P(),
+    param_spec: Any = None,
 ) -> jnp.ndarray:
     """Run ``stage_fn`` sequentially across the 'pp' stages.
 
@@ -40,6 +41,14 @@ def pipeline_apply(
     (e.g. ``P('dp')``) so pipeline stages compose with data parallelism:
     each dp group runs its own pipeline over its batch shard. Returns the
     final stage's output, sharded like ``data_spec``.
+
+    ``param_spec``: optional pytree of PartitionSpecs (same structure as
+    ``stage_params``) whose first entry must be ``axis``; lets stage weights
+    shard over further mesh axes (e.g. ``P('pp', None, None, 'tp')`` for
+    megatron tensor parallelism inside a stage). ``stage_fn`` then sees
+    tp-local weight shards and is responsible for the in-stage collectives
+    (``psum`` over 'tp' after row-parallel matmuls). Default: each leaf is
+    ``P(axis)`` (stage weights replicated within a stage).
     """
     pp = mesh.shape[axis]
     m = num_microbatches
@@ -62,7 +71,19 @@ def pipeline_apply(
         )
     mb = b // m
 
-    param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    if param_spec is None:
+        param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    else:
+        for leaf in jax.tree_util.tree_leaves(
+            param_spec, is_leaf=lambda x: isinstance(x, P)
+        ):
+            if not len(leaf) or leaf[0] != axis:
+                # a spec not leading with the stage axis would leave every
+                # device holding ALL stages and p[0] silently running stage
+                # 0's weights everywhere
+                raise ValueError(
+                    f"param_spec leaves must lead with {axis!r}; got {leaf}"
+                )
 
     @partial(
         shard_map,
