@@ -211,6 +211,8 @@ class Trainer:
         self._params = None
         self._opt_state = None
         self._tx = None
+        self._alt_txs = None  # alternating optimizers (GAN-style), or None
+        self._alt_labels = None
         self._rng_root = None
         self._datamodule = None
         self._restored_ckpt: Optional[Dict[str, Any]] = None
@@ -379,53 +381,98 @@ class Trainer:
     # ------------------------------------------------------------------ #
     # optimizer normalization
     # ------------------------------------------------------------------ #
-    def _normalize_tx(self, configured) -> optax.GradientTransformation:
-        if isinstance(configured, dict) and "optimizers" in configured:
-            # several optimizers over DISJOINT parameter groups (the common
-            # "different lr/opt for head vs body"): optax.multi_transform
-            # routes each labeled leaf to its transformation inside ONE
-            # compiled step. configure_optimizers returns
-            #   {"optimizers": {label: tx, ...},
-            #    "param_labels": label_pytree | callable(params)->labels}
-            labels = configured.get("param_labels")
-            if labels is None:
-                raise ValueError(
-                    "configure_optimizers returned {'optimizers': ...} "
-                    "without 'param_labels' (a pytree of labels matching "
-                    "the params, or a callable params -> labels)"
-                )
-            configured = optax.multi_transform(configured["optimizers"], labels)
-        elif isinstance(configured, dict):
-            configured = configured.get("optimizer", configured)
-        # optax transforms are NamedTuples; only unwrap plain containers
-        if isinstance(configured, (list, tuple)) and not hasattr(configured, "update"):
-            if len(configured) != 1:
-                raise ValueError(
-                    "PTL-style ALTERNATING optimizers (optimizer_idx) are "
-                    "not supported: every trainable step is one compiled "
-                    "XLA program, and alternating programs would recompile "
-                    "or double the step count. For per-parameter-group "
-                    "optimizers return {'optimizers': {label: tx}, "
-                    "'param_labels': ...} (optax.multi_transform); for "
-                    "GAN-style alternation, alternate inside training_step "
-                    "on `step % 2` with lax.cond."
-                )
-            configured = configured[0]
-        if not hasattr(configured, "update"):
-            raise TypeError(
-                "configure_optimizers must return an optax.GradientTransformation"
-            )
-        tx = configured
+    @staticmethod
+    def _broadcast_labels(labels, params):
+        """Expand a label *prefix* tree (e.g. {"gen": 0, "disc": 1} over a
+        nested param pytree) to the params' full structure; callables are
+        applied to params first. Exact-structure labels pass through."""
+        if callable(labels):
+            labels = labels(params)
+        prefix_def = jax.tree_util.tree_structure(labels)
+        subtrees = prefix_def.flatten_up_to(params)
+        flat = jax.tree_util.tree_leaves(labels)
+        full = [
+            jax.tree_util.tree_map(lambda _, l=l: l, st)
+            for l, st in zip(flat, subtrees)
+        ]
+        return jax.tree_util.tree_unflatten(prefix_def, full)
+
+    def _wrap_tx(self, tx) -> optax.GradientTransformation:
+        """Trainer-level knobs applied around any optimizer."""
         if self.gradient_clip_val:
             tx = optax.chain(optax.clip_by_global_norm(self.gradient_clip_val), tx)
         if self.accumulate_grad_batches > 1:
             tx = optax.MultiSteps(tx, every_k_schedule=self.accumulate_grad_batches)
         return tx
 
+    def _normalize_tx(self, configured) -> Optional[optax.GradientTransformation]:
+        self._alt_txs = None
+        self._alt_labels = None
+        if isinstance(configured, dict) and "optimizers" in configured:
+            opts = configured["optimizers"]
+            labels = configured.get("param_labels")
+            if labels is None:
+                raise ValueError(
+                    "configure_optimizers returned {'optimizers': ...} "
+                    "without 'param_labels' (a pytree of labels — a prefix "
+                    "over the params is fine — or a callable params -> labels)"
+                )
+            if isinstance(opts, (list, tuple)):
+                # ALTERNATING optimizers (PTL optimizer_idx / GAN-style):
+                # one compiled program runs len(opts) sequential sub-steps;
+                # sub-step i takes value_and_grad of
+                # training_step(..., optimizer_idx=i) and updates only the
+                # leaves labeled i (set_to_zero for the rest, so XLA DCEs
+                # the unused gradient branches). param_labels maps each
+                # leaf to an optimizer index.
+                def wrapped(i, tx):
+                    def lab(params, i=i):
+                        full = self._broadcast_labels(labels, params)
+                        return jax.tree_util.tree_map(
+                            lambda l: "active" if int(l) == i else "frozen", full
+                        )
+
+                    return optax.multi_transform(
+                        {"active": self._wrap_tx(tx), "frozen": optax.set_to_zero()},
+                        lab,
+                    )
+
+                self._alt_txs = [wrapped(i, tx) for i, tx in enumerate(opts)]
+                self._alt_labels = labels
+                return None
+            # several optimizers over DISJOINT parameter groups (the common
+            # "different lr/opt for head vs body"): optax.multi_transform
+            # routes each labeled leaf to its transformation inside ONE
+            # compiled step.
+            configured = optax.multi_transform(
+                opts, lambda p: self._broadcast_labels(labels, p)
+            )
+        elif isinstance(configured, dict):
+            configured = configured.get("optimizer", configured)
+        # optax transforms are NamedTuples; only unwrap plain containers
+        if isinstance(configured, (list, tuple)) and not hasattr(configured, "update"):
+            if len(configured) != 1:
+                raise ValueError(
+                    "a bare list of optimizers is ambiguous: for PTL-style "
+                    "ALTERNATING optimizers (optimizer_idx) return "
+                    "{'optimizers': [tx0, tx1], 'param_labels': <leaf -> "
+                    "optimizer index>}; for per-parameter-group optimizers "
+                    "over one loss return {'optimizers': {label: tx}, "
+                    "'param_labels': ...} (optax.multi_transform)"
+                )
+            configured = configured[0]
+        if not hasattr(configured, "update"):
+            raise TypeError(
+                "configure_optimizers must return an optax.GradientTransformation"
+            )
+        return self._wrap_tx(configured)
+
     # ------------------------------------------------------------------ #
     # compiled steps
     # ------------------------------------------------------------------ #
     def _build_train_step(self):
+        if self._alt_txs is not None:
+            return self._build_alternating_train_step()
         module = self._module
         tx = self._tx
         policy = self.precision_policy
@@ -466,6 +513,66 @@ class Trainer:
             logs = dict(logs)
             logs.setdefault("loss", loss)
             return new_params, new_opt_state, logs
+
+        return jax.jit(train_step, donate_argnums=(0, 1))
+
+    def _build_alternating_train_step(self):
+        """PTL multiple-optimizer semantics, compiled: training_step is
+        traced once per optimizer_idx and the sub-steps run sequentially
+        inside ONE XLA program (the PTL 1.6 loop called training_step per
+        optimizer per batch eagerly; here the alternation is unrolled at
+        trace time, so there is no per-step recompilation or dispatch)."""
+        import inspect
+
+        module = self._module
+        txs = self._alt_txs
+        policy = self.precision_policy
+        compute_dtype = policy.compute_dtype
+        sig = inspect.signature(module.training_step)
+        if "optimizer_idx" not in sig.parameters and not any(
+            p.kind is inspect.Parameter.VAR_KEYWORD for p in sig.parameters.values()
+        ):
+            raise TypeError(
+                f"configure_optimizers returned {len(txs)} alternating "
+                "optimizers, so training_step must accept an "
+                "`optimizer_idx` argument (PTL multiple-optimizer contract)"
+            )
+
+        def train_step(params, opt_states, batch, rng_root, step):
+            rng = jax.random.fold_in(rng_root, step)
+            batch = cast_floats(batch, compute_dtype)
+            logs_all: Dict[str, Any] = {}
+            new_states = []
+            for i, tx in enumerate(txs):
+
+                def loss_fn(p, i=i):
+                    if policy.cast_params_in_compute:
+                        p = cast_floats(p, compute_dtype)
+                    module._capture_begin("train", jax.random.fold_in(rng, i))
+                    out = module.training_step(p, batch, step, optimizer_idx=i)
+                    logs = module._capture_end()
+                    if isinstance(out, dict):
+                        loss, mutated = out["loss"], out.get("mutated_params")
+                    else:
+                        loss, mutated = out, None
+                    return loss, (logs, mutated)
+
+                (loss_i, (logs_i, mutated)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                updates, st = tx.update(grads, opt_states[i], params)
+                params = optax.apply_updates(params, updates)
+                if mutated is not None and isinstance(params, dict):
+                    # same contract as the single-optimizer step: forward-
+                    # mutated non-differentiable collections win
+                    params = {
+                        k: (mutated[k] if (k != "params" and k in mutated) else v)
+                        for k, v in params.items()
+                    }
+                new_states.append(st)
+                logs_all.update(logs_i)
+                logs_all.setdefault("loss", loss_i)
+            return params, tuple(new_states), logs_all
 
         return jax.jit(train_step, donate_argnums=(0, 1))
 
@@ -526,13 +633,29 @@ class Trainer:
         host_params = cast_floats(host_params, self.precision_policy.param_dtype)
         self._params = self.strategy.place_params(host_params)
         self._tx = self._normalize_tx(model.configure_optimizers())
-        opt_shapes = jax.eval_shape(self._tx.init, self._params)
+        if self._alt_txs is not None:
+            # every label must name a real optimizer and every optimizer
+            # must own at least one leaf — an out-of-range label would
+            # silently freeze its group (set_to_zero in every sub-step)
+            full_labels = self._broadcast_labels(self._alt_labels, host_params)
+            seen = {int(l) for l in jax.tree_util.tree_leaves(full_labels)}
+            k = len(self._alt_txs)
+            if not seen <= set(range(k)) or len(seen) < k:
+                raise ValueError(
+                    f"param_labels must cover exactly the optimizer indices "
+                    f"0..{k - 1}; got labels {sorted(seen)}"
+                )
+            # alternating: one state per optimizer, advanced sequentially
+            init_fn = lambda p: tuple(tx.init(p) for tx in self._alt_txs)
+        else:
+            init_fn = self._tx.init
+        opt_shapes = jax.eval_shape(init_fn, self._params)
         opt_shardings = self.strategy.optstate_shardings(opt_shapes)
         if opt_shardings is None:
             # moments inherit the param shardings through XLA propagation
-            self._opt_state = jax.jit(self._tx.init)(self._params)
+            self._opt_state = jax.jit(init_fn)(self._params)
         else:
-            self._opt_state = jax.jit(self._tx.init, out_shardings=opt_shardings)(
+            self._opt_state = jax.jit(init_fn, out_shardings=opt_shardings)(
                 self._params
             )
 
