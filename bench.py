@@ -224,10 +224,12 @@ def main() -> int:
     bench_timeout = _env_timeout("RLT_BENCH_TIMEOUT", 1500.0)
     here = os.path.abspath(__file__)
     env = dict(os.environ)
-    passthrough = [
-        "--preset", args.preset, "--steps", str(args.steps),
-        "--warmup", str(args.warmup),
-    ] + (["--batch", str(args.batch)] if args.batch else [])
+    base_args = ["--preset", args.preset] + (
+        ["--batch", str(args.batch)] if args.batch else []
+    )
+    passthrough = base_args + [
+        "--steps", str(args.steps), "--warmup", str(args.warmup),
+    ]
 
     error = None
     # explicit --platform beats the ambient env var
@@ -235,15 +237,53 @@ def main() -> int:
         args.platform != "native" and env.get("JAX_PLATFORMS") == "cpu"
     )
     if not force_cpu:
-        ok, _, perr = _run(
+        ok, probe_res, perr = _run(
             [sys.executable, here, "--_probe"], probe_timeout, env
         )
         if ok:
+            # flash block-size autotune: short child runs (fresh process per
+            # config — the env vars are read at trace time) pick the fastest
+            # (block_q, block_k) before the real measurement. TPU only: off
+            # the chip the blocks get clamped to tiny sequences and the
+            # sweep would rank noise. Opt out with RLT_BENCH_AUTOTUNE=0;
+            # explicit RLT_FLASH_BLOCK_* wins outright.
+            autotune_note = None
+            if (
+                (probe_res or {}).get("platform") in ("tpu", "axon")
+                and env.get("RLT_BENCH_AUTOTUNE", "1") != "0"
+                and "RLT_FLASH_BLOCK_Q" not in env
+                and "RLT_FLASH_BLOCK_K" not in env
+            ):
+                sweep_timeout = _env_timeout("RLT_BENCH_SWEEP_TIMEOUT", 300.0)
+                sweep_args = base_args + ["--steps", "3", "--warmup", "1"]
+                best = None
+                tried = {}
+                for bq, bk in ((512, 512), (512, 256), (256, 512), (256, 256)):
+                    senv = dict(env)
+                    senv["RLT_FLASH_BLOCK_Q"] = str(bq)
+                    senv["RLT_FLASH_BLOCK_K"] = str(bk)
+                    sok, sres, _ = _run(
+                        [sys.executable, here, "--_child"] + sweep_args,
+                        sweep_timeout, senv,
+                    )
+                    if sok and sres and sres.get("value"):
+                        tried[f"{bq}x{bk}"] = sres["value"]
+                        if best is None or sres["value"] > best[2]:
+                            best = (bq, bk, sres["value"])
+                if best is not None:
+                    env["RLT_FLASH_BLOCK_Q"] = str(best[0])
+                    env["RLT_FLASH_BLOCK_K"] = str(best[1])
+                    autotune_note = {
+                        "picked": f"{best[0]}x{best[1]}",
+                        "tokens_per_sec_by_block": tried,
+                    }
             ok, result, berr = _run(
                 [sys.executable, here, "--_child"] + passthrough,
                 bench_timeout, env,
             )
             if ok:
+                if autotune_note:
+                    result.setdefault("detail", {})["flash_autotune"] = autotune_note
                 print(json.dumps(result))
                 return 0
             error = f"native bench failed ({berr})"

