@@ -49,8 +49,13 @@ class LlamaConfig:
     expert_top_k: int = 2
     capacity_factor: float = 1.5
     moe_aux_weight: float = 0.01
-    # GPipe microbatches when the mesh has a 'pp' axis (0 = one per stage)
+    # microbatches when the mesh has a 'pp' axis (0 = one per stage)
     pp_microbatches: int = 0
+    # "gpipe": differentiable fill-drain (composes with dp and tp);
+    # "1f1b": one-forward-one-backward — backward starts as soon as a
+    # microbatch reaches the last stage, bounding resident activations by
+    # min(2*pp-1, M) instead of M (use with many microbatches; dp only)
+    pp_schedule: str = "gpipe"
 
     @property
     def head_dim(self) -> int:
@@ -275,6 +280,46 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None):
     return x, aux
 
 
+def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
+                    seq_len: int, tp: int = 1):
+    """Shared pipeline-stage plumbing for both pp schedules: the per-stage
+    scan over a contiguous layer block (tp-aware via the psum reduce_fn),
+    the [pp, L/pp, ...] stage stacking, microbatch count, and dp data
+    spec. The two schedules must never drift apart on this."""
+    pp = mesh.shape["pp"]
+    L = cfg.n_layers
+    if L % pp != 0:
+        raise ValueError(f"n_layers={L} must divide into pp={pp} stages")
+    hd = cfg.head_dim
+
+    def stage_fn(stage_layers, xb):
+        # rope angles recomputed per stage from static shapes (cheap; avoids
+        # closing over traced values under shard_map)
+        cos, sin = rope_angles(seq_len, hd, cfg.rope_theta)
+        reduce_fn = (lambda y: jax.lax.psum(y, "tp")) if tp > 1 else None
+
+        def attn_fn(q, k, v):
+            return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+
+        def layer_fn(x, lp):
+            x, _ = _decoder_layer(x, lp, cfg, cos, sin, attn_fn, reduce_fn)
+            return x, None
+
+        fn = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
+        out, _ = jax.lax.scan(fn, xb, stage_layers)
+        return out
+
+    # [L, ...] -> [pp, L/pp, ...]: one contiguous block of layers per stage
+    stage_params = jax.tree_util.tree_map(
+        lambda p: p.reshape(pp, L // pp, *p.shape[1:]), params["layers"]
+    )
+    m = cfg.pp_microbatches or pp
+    data_spec = (
+        P("dp") if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else P()
+    )
+    return stage_fn, stage_params, m, data_spec
+
+
 def _forward_pp(
     params: Dict[str, Any],
     tokens: jnp.ndarray,
@@ -301,40 +346,16 @@ def _forward_pp(
                 f"pipeline parallelism composes with dp/tp only for now; "
                 f"mesh has {ax}={mesh.shape[ax]}. Drop the pp axis to use {ax}."
             )
-    pp = mesh.shape["pp"]
     tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
-    L = cfg.n_layers
-    if L % pp != 0:
-        raise ValueError(f"n_layers={L} must divide into pp={pp} stages")
     if tp > 1 and (cfg.n_heads % tp or cfg.n_kv_heads % tp or cfg.ffn_dim % tp):
         raise ValueError(
             f"tp={tp} must divide n_heads={cfg.n_heads}, "
             f"n_kv_heads={cfg.n_kv_heads}, and ffn_dim={cfg.ffn_dim}"
         )
-    B, S = tokens.shape
-    hd = cfg.head_dim
+    _, S = tokens.shape
     x = params["embed"][tokens]
-
-    def stage_fn(stage_layers, xb):
-        # rope angles recomputed per stage from static shapes (cheap; avoids
-        # closing over traced values under shard_map)
-        cos, sin = rope_angles(S, hd, cfg.rope_theta)
-        reduce_fn = (lambda y: jax.lax.psum(y, "tp")) if tp > 1 else None
-
-        def attn_fn(q, k, v):
-            return attention(q, k, v, causal=True, impl=cfg.attn_impl)
-
-        def layer_fn(x, lp):
-            x, _ = _decoder_layer(x, lp, cfg, cos, sin, attn_fn, reduce_fn)
-            return x, None
-
-        fn = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
-        out, _ = jax.lax.scan(fn, xb, stage_layers)
-        return out
-
-    # [L, ...] -> [pp, L/pp, ...]: one contiguous block of layers per stage
-    stage_params = jax.tree_util.tree_map(
-        lambda p: p.reshape(pp, L // pp, *p.shape[1:]), params["layers"]
+    stage_fn, stage_params, m, data_spec = _pp_stage_setup(
+        params, cfg, mesh, S, tp=tp
     )
     stage_spec = None
     if tp > 1:
@@ -356,10 +377,6 @@ def _forward_pp(
             _to_stage_spec, param_specs(cfg)["layers"],
             is_leaf=lambda x: isinstance(x, P),
         )
-    m = cfg.pp_microbatches or pp
-    data_spec = (
-        P("dp") if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else P()
-    )
     x = pipeline_apply(
         stage_fn, stage_params, x, mesh,
         axis="pp", num_microbatches=m, data_spec=data_spec,
@@ -412,12 +429,74 @@ def forward(
     return logits, jnp.mean(aux_losses)
 
 
+def _lm_loss_pp_1f1b(
+    params, tokens, cfg: LlamaConfig, mesh: Mesh
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """1F1B-scheduled pipeline loss: the head + cross entropy run inside
+    the last stage per microbatch so backward starts immediately
+    (parallel/pipeline_1f1b.py). Logits are never materialized globally —
+    that is the memory point. Composes with dp only."""
+    from ray_lightning_tpu.parallel.pipeline_1f1b import pipeline_1f1b_loss
+
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "pipeline parallelism with MoE layers is not supported yet"
+        )
+    for ax in ("tp", "fsdp", "sp"):
+        if ax in mesh.axis_names and mesh.shape[ax] > 1:
+            raise NotImplementedError(
+                f"pp_schedule='1f1b' composes with dp only for now; mesh "
+                f"has {ax}={mesh.shape[ax]}. Use pp_schedule='gpipe' (which "
+                f"also composes with tp) or drop the {ax} axis."
+            )
+    _, S = tokens.shape
+    x = params["embed"][tokens]
+    targets = jnp.roll(tokens, -1, axis=1)
+    stage_fn, stage_params, m, data_spec = _pp_stage_setup(
+        params, cfg, mesh, S
+    )
+
+    # NOTE: SPMD lockstep runs last_fn (head matmul + CE and its VJP) on
+    # EVERY stage every tick with the result masked on non-last stages —
+    # P-fold redundant head FLOPs, though wall-clock is gated by the
+    # lockstep collectives either way. The per-tick logits are one
+    # [mb, S, V] microbatch (never the global [B, S, V]).
+    def last_fn(last_p, y, tgt):
+        h = rmsnorm(y, last_p["final_norm"])
+        logits = h @ last_p["lm_head"]
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), tgt
+        )
+        mask = jnp.ones_like(losses).at[:, -1].set(0.0)
+        return jnp.sum(losses * mask) / jnp.sum(mask)
+
+    last_params = {
+        "final_norm": params["final_norm"], "lm_head": params["lm_head"]
+    }
+    ce = pipeline_1f1b_loss(
+        stage_fn, last_fn, stage_params, last_params, x, targets, mesh,
+        axis="pp", num_microbatches=m, data_spec=data_spec,
+    )
+    return ce, {"loss": ce, "ppl": jnp.exp(ce)}
+
+
 def lm_loss(
     params, tokens, cfg: LlamaConfig, mesh: Optional[Mesh] = None
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Next-token cross entropy. The full sequence is fed (so sequence
     sharding stays divisible) and the last position is masked out. MoE
     configs add the weighted load-balancing auxiliary loss."""
+    if cfg.pp_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"pp_schedule={cfg.pp_schedule!r}: expected 'gpipe' or '1f1b'"
+        )
+    if (
+        mesh is not None
+        and "pp" in mesh.axis_names
+        and mesh.shape["pp"] > 1
+        and cfg.pp_schedule == "1f1b"
+    ):
+        return _lm_loss_pp_1f1b(params, tokens, cfg, mesh)
     logits, moe_aux = forward(params, tokens, cfg, mesh)
     targets = jnp.roll(tokens, -1, axis=1)
     losses = optax.softmax_cross_entropy_with_integer_labels(

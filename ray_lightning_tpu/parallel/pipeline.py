@@ -23,6 +23,32 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def data_axes_of(data_spec: P) -> tuple:
+    """Mesh-axis names a data PartitionSpec's batch dim shards over
+    (handles None / a name / a tuple of names in entry 0)."""
+    first = data_spec[0] if len(data_spec) else None
+    return (first,) if isinstance(first, str) else tuple(first or ())
+
+
+def local_batch(x, data_spec: P, mesh: Mesh, num_microbatches: int) -> int:
+    """Per-data-shard batch size, validated to divide into microbatches."""
+    denom = 1
+    for a in data_axes_of(data_spec):
+        denom *= mesh.shape[a]
+    if x.shape[0] % denom:
+        raise ValueError(
+            f"global batch {x.shape[0]} is not divisible by the data axes "
+            f"{data_axes_of(data_spec)} (size {denom})"
+        )
+    b = x.shape[0] // denom
+    if b % num_microbatches:
+        raise ValueError(
+            f"local batch {b} (global {x.shape[0]} / {denom}) must divide "
+            f"into {num_microbatches} microbatches"
+        )
+    return b
+
+
 def pipeline_apply(
     stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     stage_params: Any,
@@ -53,23 +79,7 @@ def pipeline_apply(
     pp = mesh.shape[axis]
     m = num_microbatches
     # per-data-shard batch (shard_map hands each device its local slice)
-    first = data_spec[0] if len(data_spec) else None
-    data_axes = (first,) if isinstance(first, str) else tuple(first or ())
-    denom = 1
-    for a in data_axes:
-        denom *= mesh.shape[a]
-    if x.shape[0] % denom:
-        raise ValueError(
-            f"global batch {x.shape[0]} is not divisible by the data axes "
-            f"{data_axes} (size {denom})"
-        )
-    b = x.shape[0] // denom
-    if b % m:
-        raise ValueError(
-            f"local batch {b} (global {x.shape[0]} / {denom}) must divide "
-            f"into {m} microbatches"
-        )
-    mb = b // m
+    mb = local_batch(x, data_spec, mesh, m) // m
 
     if param_spec is None:
         param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)

@@ -1,0 +1,338 @@
+"""1F1B (one-forward-one-backward) pipeline schedule.
+
+GPipe (parallel/pipeline.py) differentiates the whole fill-drain loop with
+autodiff: every microbatch's stage input stays saved until the reverse pass
+— O(M) in-flight activations per stage for M microbatches. 1F1B starts each
+microbatch's backward as soon as its forward reaches the last stage, so at
+most min(2P-1, M) inputs are resident per stage (P stages) no matter how
+many microbatches amortize the bubble. That requires the LOSS to be
+computed per-microbatch at the last stage (a loss outside the pipeline
+would force a full drain first), and manual VJP bookkeeping instead of
+autodiff.
+
+Schedule (eager 1F1B, SPMD lockstep over the 'pp' axis): tick t runs a
+masked forward phase and a masked backward phase on every stage.
+- stage s forwards microbatch j at tick s + j (same as GPipe);
+- the last stage also applies ``last_fn`` (head + loss) to its forward
+  output and seeds that microbatch's cotangent IN THE SAME TICK;
+- stage s backwards microbatch j at tick 2P - 2 - s + j, reading the
+  cotangent its successor produced one tick earlier (reverse ppermute);
+- stage inputs wait in a ring buffer between their forward and backward
+  (residency 2(P-1-s)+1 ticks, so min(2P-1, M) slots suffice);
+- total ticks: 2P + M - 2.
+
+The whole schedule runs inside ``jax.custom_vjp``: the fwd rule computes
+loss AND all gradients in one pass (the 1F1B pass *is* forward+backward);
+the bwd rule just scales by the upstream cotangent. Primal-only calls
+(no differentiation, e.g. validation) run a forward-only loop instead.
+
+The reference has no pipeline parallelism at all (SURVEY §2c); this is the
+memory-optimal schedule of our own pp layer. Composes with 'dp' (each data
+group runs its own pipeline); tp-in-stage is GPipe-only for now.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_lightning_tpu.parallel.pipeline import data_axes_of, local_batch
+
+
+def _split_micro(x, m):
+    return x.reshape(m, x.shape[0] // m, *x.shape[1:])
+
+
+def _data_axes_size(data_spec: P, mesh: Mesh) -> int:
+    size = 1
+    for a in data_axes_of(data_spec):
+        size *= mesh.shape[a]
+    return size
+
+
+def pipeline_1f1b_loss(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    last_fn: Callable[[Any, jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    last_params: Any,
+    x: jnp.ndarray,
+    targets: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "pp",
+    num_microbatches: int = 2,
+    data_spec: P = P(),
+) -> jnp.ndarray:
+    """Mean-over-microbatches scalar loss of a 1F1B-scheduled pipeline.
+
+    stage_params: pytree with leading axis == P (one slice per stage).
+    last_fn(last_params, y, tgt) -> scalar loss for one microbatch (head +
+    criterion, applied after the final stage). Differentiable wrt
+    (stage_params, last_params, x) via the manual schedule; targets are
+    non-differentiable.
+    """
+    m = num_microbatches
+    local_batch(x, data_spec, mesh, m)  # divisibility validation
+    closure = _Closure(stage_fn, last_fn, mesh, axis, m, data_spec)
+    return closure(stage_params, last_params, x, targets)
+
+
+class _Closure:
+    """custom_vjp must be defined over the array arguments only; the static
+    pieces (functions, mesh, schedule constants) live here."""
+
+    def __init__(self, stage_fn, last_fn, mesh, axis, m, data_spec):
+        self.stage_fn = stage_fn
+        self.last_fn = last_fn
+        self.mesh = mesh
+        self.axis = axis
+        self.m = m
+        self.data_spec = data_spec
+
+        @jax.custom_vjp
+        def run(stage_params, last_params, x, targets):
+            return self._forward_only(stage_params, last_params, x, targets)
+
+        def fwd(stage_params, last_params, x, targets):
+            loss, grads = self._forward_backward(
+                stage_params, last_params, x, targets
+            )
+            return loss, (grads, targets)
+
+        def bwd(res, g):
+            import numpy as np
+
+            (d_stage, d_last, d_x), targets = res
+            scale = lambda t: jax.tree_util.tree_map(lambda a: a * g, t)
+            # integer targets carry a symbolic-zero (float0) cotangent
+            if jnp.issubdtype(targets.dtype, jnp.floating):
+                d_tgt = jnp.zeros_like(targets)
+            else:
+                d_tgt = np.zeros(targets.shape, jax.dtypes.float0)
+            return scale(d_stage), scale(d_last), scale(d_x), d_tgt
+
+        run.defvjp(fwd, bwd)
+        self._run = run
+
+    def __call__(self, stage_params, last_params, x, targets):
+        return self._run(stage_params, last_params, x, targets)
+
+    # -------------------------------------------------------------- #
+    def _specs(self, stage_params):
+        param_spec = jax.tree_util.tree_map(
+            lambda _: P(self.axis), stage_params
+        )
+        return param_spec, P(), self.data_spec
+
+    def _forward_only(self, stage_params, last_params, x, targets):
+        """Primal (undifferentiated) value: plain fill-drain forward with
+        the per-microbatch loss at the last stage."""
+        pp = self.mesh.shape[self.axis]
+        m = self.m
+        axis = self.axis
+        stage_fn, last_fn = self.stage_fn, self.last_fn
+        param_spec, last_spec, data_spec = self._specs(stage_params)
+
+        @partial(
+            shard_map, mesh=self.mesh,
+            in_specs=(param_spec, last_spec, data_spec, data_spec),
+            out_specs=P(), check_rep=False,
+        )
+        def _pipe(params_local, last_p, x_full, tgt_full):
+            stage = jax.lax.axis_index(axis)
+            params_here = jax.tree_util.tree_map(lambda p: p[0], params_local)
+            micro = _split_micro(x_full, m)
+            tgt = _split_micro(tgt_full, m)
+            mb_shape = micro.shape[1:]
+            perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+            def tick(t, carry):
+                recv, loss_sum = carry
+                mb_idx = t - stage
+                active = (mb_idx >= 0) & (mb_idx < m)
+                safe = jnp.clip(mb_idx, 0, m - 1)
+                inp = jnp.where(stage == 0, micro[safe], recv)
+                y = stage_fn(params_here, inp)
+                y = jnp.where(active, y, jnp.zeros_like(y))
+                loss_j = last_fn(last_p, y, tgt[safe])
+                loss_sum = loss_sum + jnp.where(
+                    active & (stage == pp - 1), loss_j, 0.0
+                )
+                recv = jax.lax.ppermute(y, axis, perm_fwd)
+                return recv, loss_sum
+
+            recv0 = jnp.zeros(mb_shape, x_full.dtype)
+            _, loss_sum = jax.lax.fori_loop(
+                0, pp + m - 1, tick, (recv0, jnp.float32(0.0))
+            )
+            loss = jax.lax.psum(loss_sum, axis) / m
+            return _mean_over_data(loss, self.mesh, data_spec)
+
+        return _pipe(stage_params, last_params, x, targets)
+
+    def _forward_backward(self, stage_params, last_params, x, targets):
+        """The 1F1B pass: loss and all gradients in 2P + M - 2 ticks."""
+        pp = self.mesh.shape[self.axis]
+        m = self.m
+        axis = self.axis
+        stage_fn, last_fn = self.stage_fn, self.last_fn
+        param_spec, last_spec, data_spec = self._specs(stage_params)
+        w = min(2 * pp - 1, m)  # ring slots: max residency is 2(P-1)+1
+
+        @partial(
+            shard_map, mesh=self.mesh,
+            in_specs=(param_spec, last_spec, data_spec, data_spec),
+            out_specs=(P(), param_spec, last_spec, data_spec),
+            check_rep=False,
+        )
+        def _pipe(params_local, last_p, x_full, tgt_full):
+            stage = jax.lax.axis_index(axis)
+            params_here = jax.tree_util.tree_map(lambda p: p[0], params_local)
+            micro = _split_micro(x_full, m)
+            tgt = _split_micro(tgt_full, m)
+            mb_shape = micro.shape[1:]
+            perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+            perm_bwd = [(i, (i - 1) % pp) for i in range(pp)]
+            zeros_p = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params_here
+            )
+            zeros_last = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), last_p
+            )
+
+            def tick(t, carry):
+                (recv_f, recv_b, ring, d_params, d_last, d_x_micro,
+                 loss_sum) = carry
+
+                # ---- forward phase: stage s, microbatch t - s ----
+                mb_f = t - stage
+                act_f = (mb_f >= 0) & (mb_f < m)
+                safe_f = jnp.clip(mb_f, 0, m - 1)
+                x_in = jnp.where(stage == 0, micro[safe_f], recv_f)
+                y = stage_fn(params_here, x_in)
+                y = jnp.where(act_f, y, jnp.zeros_like(y))
+                # last stage: apply head+loss now and seed the cotangent
+                loss_j, vjp_last = jax.vjp(
+                    lambda lp, yy: last_fn(lp, yy, tgt[safe_f]), last_p, y
+                )
+                d_last_j, cot_self = vjp_last(jnp.float32(1.0))
+                is_last = stage == pp - 1
+                rec_l = act_f & is_last
+                loss_sum = loss_sum + jnp.where(rec_l, loss_j, 0.0)
+                d_last = jax.tree_util.tree_map(
+                    lambda a, u: a + jnp.where(rec_l, u, 0.0), d_last, d_last_j
+                )
+                # park the stage input until this microbatch's backward
+                ring = jax.lax.dynamic_update_slice(
+                    ring,
+                    jnp.where(act_f, x_in, jax.lax.dynamic_slice(
+                        ring, (safe_f % w, *([0] * x_in.ndim)),
+                        (1, *x_in.shape))[0])[None],
+                    (safe_f % w, *([0] * x_in.ndim)),
+                )
+
+                # ---- backward phase: stage s, microbatch t-(2P-2-s) ----
+                mb_b = t - (2 * pp - 2 - stage)
+                act_b = (mb_b >= 0) & (mb_b < m)
+                safe_b = jnp.clip(mb_b, 0, m - 1)
+                x_saved = jax.lax.dynamic_slice(
+                    ring, (safe_b % w, *([0] * x_in.ndim)), (1, *x_in.shape)
+                )[0]
+                cot = jnp.where(is_last, cot_self, recv_b)
+                cot = jnp.where(act_b, cot, jnp.zeros_like(cot))
+                _, vjp_stage = jax.vjp(stage_fn, params_here, x_saved)
+                d_p_j, d_x_j = vjp_stage(cot.astype(y.dtype))
+                d_params = jax.tree_util.tree_map(
+                    lambda a, u: a + jnp.where(act_b, u.astype(jnp.float32), 0.0),
+                    d_params, d_p_j,
+                )
+                # stage 0's input grad is the pipeline's dx (for the embed)
+                rec_x = act_b & (stage == 0)
+                d_x_micro = jax.lax.dynamic_update_slice(
+                    d_x_micro,
+                    jnp.where(rec_x, d_x_j.astype(jnp.float32),
+                              jax.lax.dynamic_slice(
+                                  d_x_micro, (safe_b, *([0] * d_x_j.ndim)),
+                                  (1, *d_x_j.shape))[0])[None],
+                    (safe_b, *([0] * d_x_j.ndim)),
+                )
+
+                # ---- communicate: activations forward, cotangents back ----
+                recv_f = jax.lax.ppermute(y, axis, perm_fwd)
+                recv_b = jax.lax.ppermute(d_x_j, axis, perm_bwd)
+                return (recv_f, recv_b, ring, d_params, d_last, d_x_micro,
+                        loss_sum)
+
+            recv_f0 = jnp.zeros(mb_shape, x_full.dtype)
+            recv_b0 = jnp.zeros(mb_shape, x_full.dtype)
+            ring0 = jnp.zeros((w, *mb_shape), x_full.dtype)
+            d_x0 = jnp.zeros((m, *mb_shape), jnp.float32)
+            carry = (recv_f0, recv_b0, ring0, zeros_p, zeros_last, d_x0,
+                     jnp.float32(0.0))
+            (_, _, _, d_params, d_last, d_x_micro, loss_sum) = (
+                jax.lax.fori_loop(0, 2 * pp + m - 2, tick, carry)
+            )
+
+            inv_m = 1.0 / m
+            ndata = _data_axes_size(data_spec, self.mesh)
+            # loss / d_last live on the last stage, d_x on stage 0: select
+            # and broadcast around the pp ring; grads average over data
+            # groups (each saw 1/ndata of the global batch)
+            loss = jax.lax.psum(loss_sum, axis) * inv_m
+            loss = _mean_over_data(loss, self.mesh, data_spec)
+            d_params = jax.tree_util.tree_map(
+                lambda a: _mean_over_data(a * inv_m, self.mesh, data_spec)[
+                    None
+                ],
+                d_params,
+            )
+            d_last = jax.tree_util.tree_map(
+                lambda a: _mean_over_data(
+                    jax.lax.psum(
+                        jnp.where(stage == pp - 1, a, jnp.zeros_like(a)), axis
+                    ) * inv_m,
+                    self.mesh, data_spec,
+                ),
+                d_last,
+            )
+            # dx is per-data-shard (out_spec data_spec) but the loss is the
+            # mean over data groups, so the local shard's cotangent carries
+            # the same 1/ndata factor the param grads got via pmean
+            d_x = jax.lax.psum(
+                jnp.where(stage == 0, d_x_micro, jnp.zeros_like(d_x_micro)),
+                axis,
+            ) * (inv_m / ndata)
+            d_x = d_x.reshape(m * mb_shape[0], *mb_shape[1:])
+            return loss, d_params, d_last, d_x
+
+        loss, d_params, d_last, d_x = _pipe(stage_params, last_params, x, targets)
+        cast = jax.tree_util.tree_map
+        d_params = cast(lambda g, p: g.astype(p.dtype), d_params, stage_params)
+        d_last = cast(lambda g, p: g.astype(p.dtype), d_last, last_params)
+        return loss, (d_params, d_last, d_x.astype(x.dtype))
+
+
+def _mean_over_data(value, mesh: Mesh, data_spec: P):
+    for a in data_axes_of(data_spec):
+        value = jax.lax.pmean(value, a)
+    return value
+
+
+def sequential_1f1b_reference(stage_fn, last_fn, stage_params, last_params,
+                              x, targets, num_microbatches):
+    """Same math without the mesh (for tests): mean per-microbatch loss."""
+    pp = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    m = num_microbatches
+    micro = _split_micro(x, m)
+    tgt = _split_micro(targets, m)
+    total = 0.0
+    for j in range(m):
+        h = micro[j]
+        for s in range(pp):
+            params_s = jax.tree_util.tree_map(lambda p: p[s], stage_params)
+            h = stage_fn(params_s, h)
+        total = total + last_fn(last_params, h, tgt[j])
+    return total / m
