@@ -17,8 +17,18 @@ can hang *forever*, not just fail. Structure:
     └─ CPU fallback (--_child --platform cpu): config-level platform pin,
        tiny preset, result labeled platform=cpu + "error" explaining why
 
+The bench child does ALL on-chip work in ONE process: flash block-size
+autotune (an attention fwd+bwd microbench retraced per config — block
+sizes are static args), a matmul-ceiling measurement the kernel is
+compared against, then the training measurement. One process = one
+device acquisition: killed helper processes can leave orphaned
+server-side work that serializes everything behind them when the chip
+sits behind a tunnel (observed: a post-sweep bench child blocked >20min
+in tcp_recv behind 4 killed sweep children).
+
 Timeouts via env: RLT_BENCH_PROBE_TIMEOUT (default 150s),
-RLT_BENCH_TIMEOUT (default 1500s).
+RLT_BENCH_TIMEOUT (default 1500s). RLT_BENCH_AUTOTUNE=0 disables the
+in-child sweep; explicit RLT_FLASH_BLOCK_Q/K pins win outright.
 """
 from __future__ import annotations
 
@@ -42,6 +52,90 @@ def _probe() -> int:
     return 0
 
 
+def _measure_matmul_ceiling(jnp, jax) -> float:
+    """Achieved bf16 matmul TFLOPs on a big square — the practical MXU
+    ceiling the flash kernel is judged against."""
+    n = 4096
+    a = jnp.ones((n, n), jnp.bfloat16)
+    b = jnp.ones((n, n), jnp.bfloat16)
+    f = jax.jit(lambda a, b: a @ b)
+    f(a, b).block_until_ready()
+    t0 = time.perf_counter()
+    reps = 10
+    out = a
+    for _ in range(reps):
+        out = f(out, b)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return 2.0 * n * n * n * reps / dt / 1e12
+
+
+def _autotune_flash(jax, jnp, cfg, batch, seq):
+    """Time attention fwd+bwd per (block_q, block_k) in THIS process (each
+    config is a retrace — block sizes are static args). Returns a note dict
+    {picked: "BQxBK", fwd_bwd_ms_by_block, fwd_tflops} or None when no
+    candidate fits/survives. Far cheaper than recompiling the full train
+    step per config, and no helper processes to orphan on the tunnel.
+    Failing candidates (compile error, VMEM OOM — exploring block configs
+    is where those live) are skipped, not fatal."""
+    from ray_lightning_tpu.ops.attention import attention
+
+    B, H, D = batch, cfg.n_heads, cfg.head_dim
+    kq, kk, kv = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(kq, (B, H, seq, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, H, seq, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, H, seq, D), jnp.bfloat16)
+
+    def attn_loss(q, k, v, bq, bk):
+        out = attention(q, k, v, causal=True, impl="flash",
+                        block_q=bq, block_k=bk)
+        return jnp.sum(out.astype(jnp.float32))
+
+    grad_fn = jax.jit(
+        jax.grad(attn_loss, argnums=(0, 1, 2)), static_argnums=(3, 4)
+    )
+    tried = {}
+    best = None
+    candidates = ((512, 512), (512, 256), (256, 512), (256, 256))
+    for bq, bk in candidates:
+        if seq % bq or seq % bk:
+            continue
+        try:
+            out = grad_fn(q, k, v, bq, bk)
+            jax.block_until_ready(out)  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(3):
+                out = grad_fn(q, k, v, bq, bk)
+            jax.block_until_ready(out)
+        except Exception as exc:  # noqa: BLE001 — skip, don't kill the bench
+            tried[f"{bq}x{bk}"] = f"failed: {type(exc).__name__}"
+            continue
+        dt = (time.perf_counter() - t0) / 3
+        tried[f"{bq}x{bk}"] = round(dt * 1e3, 3)
+        if best is None or dt < best[2]:
+            best = (bq, bk, dt)
+    if best is None:
+        return None
+    # kernel-vs-ceiling: fwd-only achieved TFLOPs with the winning blocks.
+    # causal flash fwd ~ 2*B*H*S^2*D flops (two matmuls, half masked off)
+    fwd = jax.jit(
+        lambda q, k, v: attention(q, k, v, causal=True, impl="flash",
+                                  block_q=best[0], block_k=best[1]),
+    )
+    fwd(q, k, v).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(5):
+        o = fwd(q, k, v)
+    o.block_until_ready()
+    fwd_dt = (time.perf_counter() - t0) / 5
+    fwd_tflops = 2.0 * B * H * seq * seq * D / fwd_dt / 1e12
+    return {
+        "picked": f"{best[0]}x{best[1]}",
+        "fwd_bwd_ms_by_block": tried,
+        "fwd_tflops": round(fwd_tflops, 2),
+    }
+
+
 def _child(args: argparse.Namespace) -> int:
     """Child: run the measurement and print one JSON line."""
     import jax
@@ -53,6 +147,8 @@ def _child(args: argparse.Namespace) -> int:
     import jax.numpy as jnp
     import numpy as np
     import optax
+
+    from dataclasses import replace
 
     from ray_lightning_tpu.callbacks.throughput import detect_peak_tflops
     from ray_lightning_tpu.models.llama import (
@@ -69,6 +165,34 @@ def _child(args: argparse.Namespace) -> int:
     cfg = getattr(LlamaConfig, preset)()
     batch = args.batch or (16 if on_tpu else 4)
     seq = cfg.max_seq
+
+    autotune_note = None
+    matmul_ceiling = None
+    if (
+        on_tpu
+        and os.environ.get("RLT_BENCH_AUTOTUNE", "1") != "0"
+        and "RLT_FLASH_BLOCK_Q" not in os.environ
+        and "RLT_FLASH_BLOCK_K" not in os.environ
+    ):
+        # never let tuning kill the measurement: on any failure fall back
+        # to default blocks and still run the real bench
+        try:
+            matmul_ceiling = round(_measure_matmul_ceiling(jnp, jax), 2)
+        except Exception as exc:  # noqa: BLE001
+            matmul_ceiling = None
+            print(f"matmul ceiling measurement failed: {exc!r}", file=sys.stderr)
+        try:
+            autotune_note = _autotune_flash(jax, jnp, cfg, batch, seq)
+        except Exception as exc:  # noqa: BLE001
+            autotune_note = None
+            print(f"flash autotune failed: {exc!r}", file=sys.stderr)
+        if autotune_note:
+            bq, bk = (int(x) for x in autotune_note["picked"].split("x"))
+            cfg = replace(cfg, flash_block_q=bq, flash_block_k=bk)
+            if matmul_ceiling is not None:
+                autotune_note["fwd_vs_matmul_ceiling"] = round(
+                    autotune_note["fwd_tflops"] / max(matmul_ceiling, 1e-9), 3
+                )
 
     params = init_params(jax.random.key(0), cfg)
     tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
@@ -124,6 +248,10 @@ def _child(args: argparse.Namespace) -> int:
             "device_kind": getattr(dev, "device_kind", "?"),
         },
     }
+    if matmul_ceiling is not None:
+        result["detail"]["matmul_ceiling_tflops_measured"] = matmul_ceiling
+    if autotune_note:
+        result["detail"]["flash_autotune"] = autotune_note
     print(json.dumps(result))
     return 0
 
@@ -241,49 +369,13 @@ def main() -> int:
             [sys.executable, here, "--_probe"], probe_timeout, env
         )
         if ok:
-            # flash block-size autotune: short child runs (fresh process per
-            # config — the env vars are read at trace time) pick the fastest
-            # (block_q, block_k) before the real measurement. TPU only: off
-            # the chip the blocks get clamped to tiny sequences and the
-            # sweep would rank noise. Opt out with RLT_BENCH_AUTOTUNE=0;
-            # explicit RLT_FLASH_BLOCK_* wins outright.
-            autotune_note = None
-            if (
-                (probe_res or {}).get("platform") in ("tpu", "axon")
-                and env.get("RLT_BENCH_AUTOTUNE", "1") != "0"
-                and "RLT_FLASH_BLOCK_Q" not in env
-                and "RLT_FLASH_BLOCK_K" not in env
-            ):
-                sweep_timeout = _env_timeout("RLT_BENCH_SWEEP_TIMEOUT", 300.0)
-                sweep_args = base_args + ["--steps", "3", "--warmup", "1"]
-                best = None
-                tried = {}
-                for bq, bk in ((512, 512), (512, 256), (256, 512), (256, 256)):
-                    senv = dict(env)
-                    senv["RLT_FLASH_BLOCK_Q"] = str(bq)
-                    senv["RLT_FLASH_BLOCK_K"] = str(bk)
-                    sok, sres, _ = _run(
-                        [sys.executable, here, "--_child"] + sweep_args,
-                        sweep_timeout, senv,
-                    )
-                    if sok and sres and sres.get("value"):
-                        tried[f"{bq}x{bk}"] = sres["value"]
-                        if best is None or sres["value"] > best[2]:
-                            best = (bq, bk, sres["value"])
-                if best is not None:
-                    env["RLT_FLASH_BLOCK_Q"] = str(best[0])
-                    env["RLT_FLASH_BLOCK_K"] = str(best[1])
-                    autotune_note = {
-                        "picked": f"{best[0]}x{best[1]}",
-                        "tokens_per_sec_by_block": tried,
-                    }
+            # all on-chip work (flash autotune, ceiling, measurement)
+            # happens inside ONE child — see module docstring
             ok, result, berr = _run(
                 [sys.executable, here, "--_child"] + passthrough,
                 bench_timeout, env,
             )
             if ok:
-                if autotune_note:
-                    result.setdefault("detail", {})["flash_autotune"] = autotune_note
                 print(json.dumps(result))
                 return 0
             error = f"native bench failed ({berr})"

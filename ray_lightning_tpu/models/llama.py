@@ -44,6 +44,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     attn_impl: Optional[str] = None  # None=auto, "flash", "reference"
+    # flash block sizes (0 = env/default). Static ints in the traced step,
+    # so a sweep is one process retracing per config — tunnel-friendly.
+    flash_block_q: int = 0
+    flash_block_k: int = 0
     # mixture-of-experts MLP (0 = dense); experts shard over the 'ep' axis
     n_experts: int = 0
     expert_top_k: int = 2
@@ -54,7 +58,7 @@ class LlamaConfig:
     # "gpipe": differentiable fill-drain (composes with dp and tp);
     # "1f1b": one-forward-one-backward — backward starts as soon as a
     # microbatch reaches the last stage, bounding resident activations by
-    # min(2*pp-1, M) instead of M (use with many microbatches; dp only)
+    # min(2*pp-1, M) instead of M (use with many microbatches; dp and tp)
     pp_schedule: str = "gpipe"
 
     @property
@@ -239,7 +243,8 @@ def _act_constraint(x, mesh: Optional[Mesh], *entries):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
-def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None):
+def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
+                   input_fn=None):
     """One transformer block (pre-norm attention + gated MLP / MoE) shared
     by the scanned dense path and the pipeline stage path — the math must
     stay identical between them.
@@ -248,13 +253,16 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None):
     on tp-local shards inside shard_map: with wq/wk/wv column-sharded over
     'tp' each device computes its head slice, and ``reduce_fn`` (a psum over
     'tp') completes the row-parallel wo / w_down matmuls — the megatron
-    pattern, expressed once."""
+    pattern, expressed once. ``input_fn`` (megatron's f operator) marks the
+    normed activations entering the column-parallel matmuls; the manual-VJP
+    1F1B schedule needs it to re-sum input cotangents over 'tp'."""
     red = reduce_fn or (lambda y: y)
+    fin = input_fn or (lambda y: y)
     B, S = x.shape[0], x.shape[1]
     hd = cfg.head_dim
     nh = lp["wq"].shape[-1] // hd  # local heads (== cfg.n_heads unless tp-sharded)
     nkv = lp["wk"].shape[-1] // hd
-    h = rmsnorm(x, lp["attn_norm"])
+    h = fin(rmsnorm(x, lp["attn_norm"]))
     q = (h @ lp["wq"]).reshape(B, S, nh, hd)
     k = (h @ lp["wk"]).reshape(B, S, nkv, hd)
     v = (h @ lp["wv"]).reshape(B, S, nkv, hd)
@@ -264,7 +272,7 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None):
     att = attn_fn(q, k, v)
     att = att.swapaxes(1, 2).reshape(B, S, nh * hd)
     x = x + red(att @ lp["wo"])
-    h2 = rmsnorm(x, lp["mlp_norm"])
+    h2 = fin(rmsnorm(x, lp["mlp_norm"]))
     if cfg.n_experts and "moe" in lp:
         from ray_lightning_tpu.parallel.moe import moe_ffn
 
@@ -281,28 +289,56 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None):
 
 
 def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
-                    seq_len: int, tp: int = 1):
+                    seq_len: int, tp: int = 1, schedule: str = "gpipe"):
     """Shared pipeline-stage plumbing for both pp schedules: the per-stage
     scan over a contiguous layer block (tp-aware via the psum reduce_fn),
     the [pp, L/pp, ...] stage stacking, microbatch count, and dp data
-    spec. The two schedules must never drift apart on this."""
+    spec. The two schedules must never drift apart on this.
+
+    tp collectives differ by schedule: GPipe differentiates the whole
+    shard_map with autodiff, which handles a plain ``lax.psum``; 1F1B takes
+    ``jax.vjp`` INSIDE the body, where JAX's psum-transposes-to-psum rule
+    would double cotangents per stage — it needs megatron's f/g
+    custom-VJP pair instead (parallel/pipeline_1f1b.py)."""
     pp = mesh.shape["pp"]
     L = cfg.n_layers
     if L % pp != 0:
         raise ValueError(f"n_layers={L} must divide into pp={pp} stages")
+    if tp > 1 and (cfg.n_heads % tp or cfg.n_kv_heads % tp or cfg.ffn_dim % tp):
+        raise ValueError(
+            f"tp={tp} must divide n_heads={cfg.n_heads}, "
+            f"n_kv_heads={cfg.n_kv_heads}, and ffn_dim={cfg.ffn_dim}"
+        )
     hd = cfg.head_dim
 
     def stage_fn(stage_layers, xb):
         # rope angles recomputed per stage from static shapes (cheap; avoids
         # closing over traced values under shard_map)
         cos, sin = rope_angles(seq_len, hd, cfg.rope_theta)
-        reduce_fn = (lambda y: jax.lax.psum(y, "tp")) if tp > 1 else None
+        reduce_fn = None
+        input_fn = None
+        if tp > 1:
+            if schedule == "1f1b":
+                from ray_lightning_tpu.parallel.pipeline_1f1b import (
+                    identity_fwd_psum_bwd,
+                    psum_fwd_identity_bwd,
+                )
+
+                reduce_fn = lambda y: psum_fwd_identity_bwd(y, "tp")
+                input_fn = lambda y: identity_fwd_psum_bwd(y, "tp")
+            else:
+                reduce_fn = lambda y: jax.lax.psum(y, "tp")
 
         def attn_fn(q, k, v):
-            return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+            return attention(
+                q, k, v, causal=True, impl=cfg.attn_impl,
+                block_q=cfg.flash_block_q or None,
+                block_k=cfg.flash_block_k or None,
+            )
 
         def layer_fn(x, lp):
-            x, _ = _decoder_layer(x, lp, cfg, cos, sin, attn_fn, reduce_fn)
+            x, _ = _decoder_layer(x, lp, cfg, cos, sin, attn_fn, reduce_fn,
+                                  input_fn)
             return x, None
 
         fn = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
@@ -318,6 +354,29 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
         P("dp") if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else P()
     )
     return stage_fn, stage_params, m, data_spec
+
+
+def _stage_param_specs(cfg: LlamaConfig):
+    """In-stage megatron layout for pipeline stages, derived from
+    param_specs (the single source of truth for which dims are column vs
+    row parallel): keep only the pp/tp entries and insert a None for the
+    intra-stage layer dim the [pp, L/pp, ...] reshape introduces. Shared
+    by the GPipe and 1F1B schedules."""
+
+    def _to_stage_spec(spec: P) -> P:
+        def keep(e):
+            if isinstance(e, (tuple, list)):
+                kept = tuple(a for a in e if a in ("pp", "tp"))
+                return kept if kept else None
+            return e if e in ("pp", "tp") else None
+
+        entries = [keep(e) for e in spec]
+        return P(entries[0], None, *entries[1:])
+
+    return jax.tree_util.tree_map(
+        _to_stage_spec, param_specs(cfg)["layers"],
+        is_leaf=lambda x: isinstance(x, P),
+    )
 
 
 def _forward_pp(
@@ -347,36 +406,12 @@ def _forward_pp(
                 f"mesh has {ax}={mesh.shape[ax]}. Drop the pp axis to use {ax}."
             )
     tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
-    if tp > 1 and (cfg.n_heads % tp or cfg.n_kv_heads % tp or cfg.ffn_dim % tp):
-        raise ValueError(
-            f"tp={tp} must divide n_heads={cfg.n_heads}, "
-            f"n_kv_heads={cfg.n_kv_heads}, and ffn_dim={cfg.ffn_dim}"
-        )
     _, S = tokens.shape
     x = params["embed"][tokens]
     stage_fn, stage_params, m, data_spec = _pp_stage_setup(
         params, cfg, mesh, S, tp=tp
     )
-    stage_spec = None
-    if tp > 1:
-        # derive the in-stage megatron layout from param_specs (the single
-        # source of truth for which dims are column vs row parallel): keep
-        # only the pp/tp entries and insert a None for the intra-stage
-        # layer dim the [pp, L/pp, ...] reshape introduced
-        def _to_stage_spec(spec: P) -> P:
-            def keep(e):
-                if isinstance(e, (tuple, list)):
-                    kept = tuple(a for a in e if a in ("pp", "tp"))
-                    return kept if kept else None
-                return e if e in ("pp", "tp") else None
-
-            entries = [keep(e) for e in spec]
-            return P(entries[0], None, *entries[1:])
-
-        stage_spec = jax.tree_util.tree_map(
-            _to_stage_spec, param_specs(cfg)["layers"],
-            is_leaf=lambda x: isinstance(x, P),
-        )
+    stage_spec = _stage_param_specs(cfg) if tp > 1 else None
     x = pipeline_apply(
         stage_fn, stage_params, x, mesh,
         axis="pp", num_microbatches=m, data_spec=data_spec,
@@ -415,7 +450,11 @@ def forward(
     def attn_fn(q, k, v):
         if use_ring:
             return ring_attention(q, k, v, mesh=mesh, axis="sp", causal=True)
-        return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        return attention(
+            q, k, v, causal=True, impl=cfg.attn_impl,
+            block_q=cfg.flash_block_q or None,
+            block_k=cfg.flash_block_k or None,
+        )
 
     def layer_fn(x, lp):
         x, aux = _decoder_layer(x, lp, cfg, cos, sin, attn_fn)
@@ -435,25 +474,27 @@ def _lm_loss_pp_1f1b(
     """1F1B-scheduled pipeline loss: the head + cross entropy run inside
     the last stage per microbatch so backward starts immediately
     (parallel/pipeline_1f1b.py). Logits are never materialized globally —
-    that is the memory point. Composes with dp only."""
+    that is the memory point. Composes with dp and tp (megatron-in-stage,
+    same layout as the GPipe path; the schedule's manual VJP re-sums
+    in-stage psum cotangents over 'tp' correctly)."""
     from ray_lightning_tpu.parallel.pipeline_1f1b import pipeline_1f1b_loss
 
     if cfg.n_experts:
         raise NotImplementedError(
             "pipeline parallelism with MoE layers is not supported yet"
         )
-    for ax in ("tp", "fsdp", "sp"):
+    for ax in ("fsdp", "sp"):
         if ax in mesh.axis_names and mesh.shape[ax] > 1:
             raise NotImplementedError(
-                f"pp_schedule='1f1b' composes with dp only for now; mesh "
-                f"has {ax}={mesh.shape[ax]}. Use pp_schedule='gpipe' (which "
-                f"also composes with tp) or drop the {ax} axis."
+                f"pp_schedule='1f1b' composes with dp/tp only for now; mesh "
+                f"has {ax}={mesh.shape[ax]}. Drop the {ax} axis to use pp."
             )
+    tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
     _, S = tokens.shape
     x = params["embed"][tokens]
     targets = jnp.roll(tokens, -1, axis=1)
     stage_fn, stage_params, m, data_spec = _pp_stage_setup(
-        params, cfg, mesh, S
+        params, cfg, mesh, S, tp=tp, schedule="1f1b"
     )
 
     # NOTE: SPMD lockstep runs last_fn (head matmul + CE and its VJP) on
@@ -476,6 +517,7 @@ def _lm_loss_pp_1f1b(
     ce = pipeline_1f1b_loss(
         stage_fn, last_fn, stage_params, last_params, x, targets, mesh,
         axis="pp", num_microbatches=m, data_spec=data_spec,
+        param_spec=_stage_param_specs(cfg) if tp > 1 else None,
     )
     return ce, {"loss": ce, "ppl": jnp.exp(ce)}
 

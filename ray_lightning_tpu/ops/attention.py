@@ -256,14 +256,16 @@ def _env_block(name: str, default: int, s: int) -> int:
     return min(value, s)
 
 
-def _pick_blocks(s: int):
-    """Default 512x512; env-tunable for on-chip block sweeps.
+def _pick_blocks(s: int, block_q: Optional[int] = None, block_k: Optional[int] = None):
+    """Explicit block sizes win; else env (RLT_FLASH_BLOCK_Q/K); else 512x512.
 
-    NOTE: the env vars are read at trace time and are NOT part of jit
-    cache keys — sweep one setting per process (bench.py's child-process
-    structure does this naturally)."""
-    bq = _env_block("RLT_FLASH_BLOCK_Q", 512, s)
-    bk = _env_block("RLT_FLASH_BLOCK_K", 512, s)
+    Explicit args are part of the caller's trace (static python ints), so a
+    single process can sweep block configs by retracing — one device
+    acquisition per sweep instead of one process per config, which matters
+    when clients reach the chip through a tunnel. Env vars remain for
+    whole-run pins but are read at trace time and are NOT jit cache keys."""
+    bq = min(block_q, s) if block_q else _env_block("RLT_FLASH_BLOCK_Q", 512, s)
+    bk = min(block_k, s) if block_k else _env_block("RLT_FLASH_BLOCK_K", 512, s)
     return bq, bk
 
 
@@ -297,7 +299,7 @@ def _q_index_map_for_dkv(bq: int, bk: int, causal: bool):
     return lambda b_, h, j, i: (b_, h, i, 0)
 
 
-def _flash_fwd(q, k, v, causal, scale, interpret):
+def _flash_fwd(q, k, v, causal, scale, interpret, blocks=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -305,7 +307,7 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
     hkv = k.shape[1]
     group = hq // hkv
     skv = k.shape[2]
-    bq, bk = _pick_blocks(sq)
+    bq, bk = _pick_blocks(sq, *(blocks or (None, None)))
     n_kv = skv // bk
 
     kernel = functools.partial(
@@ -339,7 +341,7 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
     return out, lse
 
 
-def _flash_bwd(q, k, v, out, lse, do, causal, scale, interpret):
+def _flash_bwd(q, k, v, out, lse, do, causal, scale, interpret, blocks=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -347,7 +349,7 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, interpret):
     hkv = k.shape[1]
     group = hq // hkv
     skv = k.shape[2]
-    bq, bk = _pick_blocks(sq)
+    bq, bk = _pick_blocks(sq, *(blocks or (None, None)))
     n_q = sq // bq
     n_kv = skv // bk
 
@@ -414,20 +416,20 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, interpret):
 # --------------------------------------------------------------------- #
 # public op with custom VJP
 # --------------------------------------------------------------------- #
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_attention(q, k, v, causal, scale, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, scale, interpret, blocks):
+    out, _ = _flash_fwd(q, k, v, causal, scale, interpret, blocks)
     return out
 
 
-def _flash_attention_fwd(q, k, v, causal, scale, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, scale, interpret)
+def _flash_attention_fwd(q, k, v, causal, scale, interpret, blocks):
+    out, lse = _flash_fwd(q, k, v, causal, scale, interpret, blocks)
     return out, (q, k, v, out, lse)
 
 
-def _flash_attention_bwd(causal, scale, interpret, residuals, g):
+def _flash_attention_bwd(causal, scale, interpret, blocks, residuals, g):
     q, k, v, out, lse = residuals
-    return _flash_bwd(q, k, v, out, lse, g, causal, scale, interpret)
+    return _flash_bwd(q, k, v, out, lse, g, causal, scale, interpret, blocks)
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
@@ -438,7 +440,7 @@ def _lane_pad(d: int) -> int:
     return ((d + 127) // 128) * 128
 
 
-def flash_supported(q_shape, k_shape) -> bool:
+def flash_supported(q_shape, k_shape, block_q=None, block_k=None) -> bool:
     """Whether the pallas flash kernels can serve these shapes: last-aligned
     self-attention (sq == skv), block-divisible lengths, TPU-tileable block
     rows. Head dims that are not lane-multiples are zero-padded to 128
@@ -446,7 +448,7 @@ def flash_supported(q_shape, k_shape) -> bool:
     padded v columns carry zero values and gradients) — so head_dim 64
     (BERT-base and most small models) takes the flash path too."""
     sq, skv = q_shape[2], k_shape[2]
-    bq, bk = _pick_blocks(sq)
+    bq, bk = _pick_blocks(sq, block_q, block_k)
     return (
         sq == skv
         and sq % bq == 0
@@ -464,15 +466,19 @@ def attention(
     sm_scale: Optional[float] = None,
     impl: Optional[str] = None,
     interpret: Optional[bool] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jnp.ndarray:
     """Dispatching attention op. q: [B, Hq, S, D]; k/v: [B, Hkv, S, D].
 
     impl: "flash" | "reference" | None (auto: flash when shapes are
-    TPU-tileable, reference otherwise).
+    TPU-tileable, reference otherwise). block_q/block_k: explicit flash
+    block sizes (static ints, so distinct values retrace — sweepable in
+    one process); default env/512.
     """
     sq, d = q.shape[2], q.shape[3]
     scale = sm_scale if sm_scale is not None else float(1.0 / np.sqrt(d))
-    flash_ok = flash_supported(q.shape, k.shape)
+    flash_ok = flash_supported(q.shape, k.shape, block_q, block_k)
     if impl is None:
         # auto mode never picks interpret-mode pallas: off-TPU the kernels
         # run in the (slow) interpreter, so the einsum reference is the
@@ -492,13 +498,14 @@ def attention(
         return reference_attention(q, k, v, causal=causal, sm_scale=scale)
     if interpret is None:
         interpret = _interpret_default()
+    blocks = (block_q, block_k) if (block_q or block_k) else None
     d_pad = _lane_pad(d)
     if d_pad != d:
         # scale already fixed from the true d; zero columns change nothing
         pad = ((0, 0), (0, 0), (0, 0), (0, d_pad - d))
         out = _flash_attention(
             jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
-            causal, scale, interpret,
+            causal, scale, interpret, blocks,
         )
         return out[..., :d]
-    return _flash_attention(q, k, v, causal, scale, interpret)
+    return _flash_attention(q, k, v, causal, scale, interpret, blocks)

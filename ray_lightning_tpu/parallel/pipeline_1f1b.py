@@ -28,7 +28,9 @@ the bwd rule just scales by the upstream cotangent. Primal-only calls
 
 The reference has no pipeline parallelism at all (SURVEY §2c); this is the
 memory-optimal schedule of our own pp layer. Composes with 'dp' (each data
-group runs its own pipeline); tp-in-stage is GPipe-only for now.
+group runs its own pipeline) and 'tp' (megatron-in-stage via the f/g
+custom-VJP operators below — plain lax.psum is WRONG under the manual VJP
+because JAX transposes psum to psum, doubling cotangents per stage).
 """
 from __future__ import annotations
 
@@ -41,6 +43,36 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ray_lightning_tpu.parallel.pipeline import data_axes_of, local_batch
+
+
+def psum_fwd_identity_bwd(x, axis: str):
+    """Megatron's "g" operator: forward = psum over ``axis``, backward =
+    identity. Required (with :func:`identity_fwd_psum_bwd`) for tensor
+    parallelism inside a MANUALLY-vjp'd shard_map body: JAX transposes
+    ``lax.psum`` to ``lax.psum``, so a plain psum doubles the cotangent per
+    stage traversal (axis-size factor, compounding across stages). Outside
+    autodiff (e.g. the GPipe path, grad-of-shard_map) compensates via the
+    unmapped-input rules and must keep the plain psum."""
+
+    @jax.custom_vjp
+    def fn(x):
+        return jax.lax.psum(x, axis)
+
+    fn.defvjp(lambda x: (jax.lax.psum(x, axis), None), lambda _, ct: (ct,))
+    return fn(x)
+
+
+def identity_fwd_psum_bwd(x, axis: str):
+    """Megatron's "f" operator: forward = identity, backward = psum over
+    ``axis``. Placed where a replicated activation enters column-parallel
+    matmuls so each shard's partial input-cotangent is re-summed."""
+
+    @jax.custom_vjp
+    def fn(x):
+        return x
+
+    fn.defvjp(lambda x: (x, None), lambda _, ct: (jax.lax.psum(ct, axis),))
+    return fn(x)
 
 
 def _split_micro(x, m):
@@ -65,6 +97,7 @@ def pipeline_1f1b_loss(
     axis: str = "pp",
     num_microbatches: int = 2,
     data_spec: P = P(),
+    param_spec: Any = None,
 ) -> jnp.ndarray:
     """Mean-over-microbatches scalar loss of a 1F1B-scheduled pipeline.
 
@@ -73,10 +106,27 @@ def pipeline_1f1b_loss(
     criterion, applied after the final stage). Differentiable wrt
     (stage_params, last_params, x) via the manual schedule; targets are
     non-differentiable.
+
+    ``param_spec``: optional PartitionSpec pytree for stage_params (leaves
+    must lead with ``axis``), enabling megatron tensor parallelism inside a
+    stage. ``stage_fn`` sees tp-local weight shards and MUST use the f/g
+    operators above for its in-stage collectives — `psum_fwd_identity_bwd`
+    after row-parallel matmuls, `identity_fwd_psum_bwd` where replicated
+    activations enter column-parallel matmuls. A plain ``lax.psum`` yields
+    tp-size-scaled weight gradients under this schedule's manual VJP
+    (tested). Default: stage weights replicated within a stage.
     """
     m = num_microbatches
     local_batch(x, data_spec, mesh, m)  # divisibility validation
-    closure = _Closure(stage_fn, last_fn, mesh, axis, m, data_spec)
+    if param_spec is not None:
+        for leaf in jax.tree_util.tree_leaves(
+            param_spec, is_leaf=lambda s: isinstance(s, P)
+        ):
+            if not len(leaf) or leaf[0] != axis:
+                raise ValueError(
+                    f"param_spec leaves must lead with {axis!r}; got {leaf}"
+                )
+    closure = _Closure(stage_fn, last_fn, mesh, axis, m, data_spec, param_spec)
     return closure(stage_params, last_params, x, targets)
 
 
@@ -84,13 +134,15 @@ class _Closure:
     """custom_vjp must be defined over the array arguments only; the static
     pieces (functions, mesh, schedule constants) live here."""
 
-    def __init__(self, stage_fn, last_fn, mesh, axis, m, data_spec):
+    def __init__(self, stage_fn, last_fn, mesh, axis, m, data_spec,
+                 param_spec=None):
         self.stage_fn = stage_fn
         self.last_fn = last_fn
         self.mesh = mesh
         self.axis = axis
         self.m = m
         self.data_spec = data_spec
+        self.param_spec = param_spec
 
         @jax.custom_vjp
         def run(stage_params, last_params, x, targets):
@@ -122,9 +174,11 @@ class _Closure:
 
     # -------------------------------------------------------------- #
     def _specs(self, stage_params):
-        param_spec = jax.tree_util.tree_map(
-            lambda _: P(self.axis), stage_params
-        )
+        param_spec = self.param_spec
+        if param_spec is None:
+            param_spec = jax.tree_util.tree_map(
+                lambda _: P(self.axis), stage_params
+            )
         return param_spec, P(), self.data_spec
 
     def _forward_only(self, stage_params, last_params, x, targets):
