@@ -70,6 +70,17 @@ def _measure_matmul_ceiling(jnp, jax) -> float:
     return 2.0 * n * n * n * reps / dt / 1e12
 
 
+def _should_autotune(on_tpu: bool, environ) -> bool:
+    """Autotune gate: TPU only, RLT_BENCH_AUTOTUNE=0 disables, and explicit
+    RLT_FLASH_BLOCK_Q/K pins win outright (no sweep)."""
+    return (
+        on_tpu
+        and environ.get("RLT_BENCH_AUTOTUNE", "1") != "0"
+        and "RLT_FLASH_BLOCK_Q" not in environ
+        and "RLT_FLASH_BLOCK_K" not in environ
+    )
+
+
 def _autotune_flash(jax, jnp, cfg, batch, seq):
     """Time attention fwd+bwd per (block_q, block_k) in THIS process (each
     config is a retrace — block sizes are static args). Returns a note dict
@@ -80,11 +91,13 @@ def _autotune_flash(jax, jnp, cfg, batch, seq):
     is where those live) are skipped, not fatal."""
     from ray_lightning_tpu.ops.attention import attention
 
-    B, H, D = batch, cfg.n_heads, cfg.head_dim
+    # shapes must mirror the training step's kernel exactly — including
+    # GQA (n_kv_heads), or the sweep tunes a kernel the model never runs
+    B, H, HKV, D = batch, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     kq, kk, kv = jax.random.split(jax.random.key(1), 3)
     q = jax.random.normal(kq, (B, H, seq, D), jnp.bfloat16)
-    k = jax.random.normal(kk, (B, H, seq, D), jnp.bfloat16)
-    v = jax.random.normal(kv, (B, H, seq, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, HKV, seq, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, HKV, seq, D), jnp.bfloat16)
 
     def attn_loss(q, k, v, bq, bk):
         out = attention(q, k, v, causal=True, impl="flash",
@@ -168,12 +181,7 @@ def _child(args: argparse.Namespace) -> int:
 
     autotune_note = None
     matmul_ceiling = None
-    if (
-        on_tpu
-        and os.environ.get("RLT_BENCH_AUTOTUNE", "1") != "0"
-        and "RLT_FLASH_BLOCK_Q" not in os.environ
-        and "RLT_FLASH_BLOCK_K" not in os.environ
-    ):
+    if _should_autotune(on_tpu, os.environ):
         # never let tuning kill the measurement: on any failure fall back
         # to default blocks and still run the real bench
         try:

@@ -1,0 +1,167 @@
+"""Autoregressive decoding for the flagship llama family: preallocated
+KV cache, fully compiled decode loop.
+
+TPU-first design:
+- the cache is STATIC-shaped ([L, B, Hkv, max_len, D]) and updated with
+  ``lax.dynamic_update_slice`` — no reallocation, no dynamic shapes, one
+  compile for the whole generation;
+- the decode loop is a single ``lax.scan`` over step index (prompt prefill
+  included: tokens are consumed from the prompt while ``pos < prompt_len``
+  and sampled after), so the host never round-trips per token;
+- attention at decode is a masked matvec over the cache (memory-bound;
+  the MXU flash kernel buys nothing at q-length 1, so the plain einsum is
+  the right kernel here), GQA folded the same way as training;
+- rope tables are precomputed for ``max_len`` and indexed at the traced
+  position.
+
+The reference wraps user torch models and has no generation surface
+(SURVEY §2a — examples train/validate only); this is native capability on
+top of the flagship family. Exactness contract: with greedy sampling the
+cached decode reproduces the training ``forward``'s argmax at every
+position (tested against the no-cache path).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_lightning_tpu.models.llama import LlamaConfig
+from ray_lightning_tpu.ops.rmsnorm import rmsnorm
+from ray_lightning_tpu.ops.rope import rope_angles
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict[str, jnp.ndarray]:
+    """Preallocated cache: k/v of shape [L, B, Hkv, max_len, head_dim]."""
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def _rope_at(table: Tuple[jnp.ndarray, jnp.ndarray], pos: jnp.ndarray):
+    cos, sin = table
+    c = jax.lax.dynamic_slice_in_dim(cos, pos, 1)  # [1, hd/2]
+    s = jax.lax.dynamic_slice_in_dim(sin, pos, 1)
+    return c, s
+
+
+def _apply_rope_one(x: jnp.ndarray, c: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """x: [B, H, hd] at one position; c/s: [1, hd/2]."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)
+
+
+def decode_step(
+    params: Dict[str, Any],
+    cache: Dict[str, jnp.ndarray],
+    token: jnp.ndarray,
+    pos: jnp.ndarray,
+    cfg: LlamaConfig,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """One decode step. token: [B] int32; pos: scalar int32 (same position
+    for the whole batch). Returns (logits [B, V], updated cache).
+
+    The layer stack is a ``lax.scan`` over the stacked params with the
+    per-layer cache slices as a second scanned input, mirroring the
+    training forward's structure (models/llama.py::forward).
+    """
+    if cfg.n_experts:
+        raise NotImplementedError("KV-cache decoding for MoE configs is not wired yet")
+    hd = cfg.head_dim
+    max_len = cache["k"].shape[3]
+    table = rope_angles(max_len, hd, cfg.rope_theta)
+    c, s = _rope_at(table, pos)
+    x = params["embed"][token]  # [B, D]
+
+    # causal-by-position mask over the static cache length
+    valid = (jnp.arange(max_len) <= pos)[None, None, :]  # [1, 1, max_len]
+
+    def layer_fn(x, inputs):
+        lp, k_cache, v_cache = inputs  # k/v: [B, Hkv, max_len, hd]
+        B = x.shape[0]
+        nh = lp["wq"].shape[-1] // hd
+        nkv = lp["wk"].shape[-1] // hd
+        group = nh // nkv
+        h = rmsnorm(x, lp["attn_norm"])
+        q = (h @ lp["wq"]).reshape(B, nh, hd)
+        k = (h @ lp["wk"]).reshape(B, nkv, hd)
+        v = (h @ lp["wv"]).reshape(B, nkv, hd)
+        q = _apply_rope_one(q, c, s)
+        k = _apply_rope_one(k, c, s)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k[:, :, None, :].astype(k_cache.dtype), (0, 0, pos, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v[:, :, None, :].astype(v_cache.dtype), (0, 0, pos, 0)
+        )
+        # GQA: fold q heads to [B, Hkv, G, hd]; attend over the cache
+        qf = q.reshape(B, nkv, group, hd).astype(jnp.float32)
+        logits = jnp.einsum(
+            "bhgd,bhtd->bhgt", qf, k_cache.astype(jnp.float32)
+        ) / jnp.sqrt(jnp.float32(hd))
+        logits = jnp.where(valid[:, :, None, :], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        att = jnp.einsum("bhgt,bhtd->bhgd", probs, v_cache.astype(jnp.float32))
+        att = att.reshape(B, nh * hd).astype(x.dtype)
+        x = x + att @ lp["wo"]
+        h2 = rmsnorm(x, lp["mlp_norm"])
+        gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
+        x = x + gated @ lp["w_down"]
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rmsnorm(x, params["final_norm"])
+    logits = x @ params["lm_head"]
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
+def generate(
+    params: Dict[str, Any],
+    prompt: jnp.ndarray,
+    cfg: LlamaConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    pad_id: int = 0,
+) -> jnp.ndarray:
+    """Generate ``max_new_tokens`` after ``prompt`` [B, P] (right-aligned
+    dense prompts; all rows share length P). Returns [B, P + max_new_tokens].
+
+    One compiled ``lax.scan`` covers prefill AND generation: at step t the
+    input token is the prompt's (teacher-forced) while t < P, the model's
+    sample after. temperature 0 = greedy; > 0 = categorical sampling.
+    """
+    if rng is None:
+        rng = jax.random.key(0)
+    B, P = prompt.shape
+    total = P + max_new_tokens
+    cache = init_kv_cache(cfg, B, total)
+
+    def step(carry, t):
+        cache, tok, rng = carry
+        logits, cache = decode_step(params, cache, tok, t, cfg)
+        rng, sub = jax.random.split(rng)
+        if temperature > 0.0:
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = nxt.astype(prompt.dtype)
+        # teacher-force while still inside the prompt
+        in_prompt = t + 1 < P
+        forced = prompt[:, jnp.minimum(t + 1, P - 1)]
+        tok_next = jnp.where(in_prompt, forced, nxt)
+        return (cache, tok_next, rng), tok_next
+
+    (_, _, _), toks = jax.lax.scan(
+        step, (cache, prompt[:, 0], rng), jnp.arange(total - 1)
+    )
+    out = jnp.concatenate([prompt[:, :1], toks.swapaxes(0, 1)], axis=1)
+    return out

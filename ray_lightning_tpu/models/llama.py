@@ -289,17 +289,20 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
 
 
 def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
-                    seq_len: int, tp: int = 1, schedule: str = "gpipe"):
+                    seq_len: int, tp: int = 1, schedule: str = "gpipe",
+                    sp: int = 1):
     """Shared pipeline-stage plumbing for both pp schedules: the per-stage
-    scan over a contiguous layer block (tp-aware via the psum reduce_fn),
-    the [pp, L/pp, ...] stage stacking, microbatch count, and dp data
-    spec. The two schedules must never drift apart on this.
+    scan over a contiguous layer block (tp-aware via the psum reduce_fn,
+    sp-aware via in-stage ring attention), the [pp, L/pp, ...] stage
+    stacking, microbatch count, and the data spec (batch over 'dp',
+    sequence over 'sp'). The two schedules must never drift apart on this.
 
     tp collectives differ by schedule: GPipe differentiates the whole
     shard_map with autodiff, which handles a plain ``lax.psum``; 1F1B takes
     ``jax.vjp`` INSIDE the body, where JAX's psum-transposes-to-psum rule
     would double cotangents per stage — it needs megatron's f/g
-    custom-VJP pair instead (parallel/pipeline_1f1b.py)."""
+    custom-VJP pair instead (parallel/pipeline_1f1b.py). sp's ppermutes
+    are bijections (transpose = reverse rotation), safe under both."""
     pp = mesh.shape["pp"]
     L = cfg.n_layers
     if L % pp != 0:
@@ -309,12 +312,21 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
             f"tp={tp} must divide n_heads={cfg.n_heads}, "
             f"n_kv_heads={cfg.n_kv_heads}, and ffn_dim={cfg.ffn_dim}"
         )
+    if sp > 1 and seq_len % sp:
+        raise ValueError(f"sp={sp} must divide sequence length {seq_len}")
     hd = cfg.head_dim
 
     def stage_fn(stage_layers, xb):
         # rope angles recomputed per stage from static shapes (cheap; avoids
-        # closing over traced values under shard_map)
+        # closing over traced values under shard_map); with sp the stage
+        # sees a local sequence shard, so slice the GLOBAL-position tables
+        # to this shard's offset
         cos, sin = rope_angles(seq_len, hd, cfg.rope_theta)
+        if sp > 1:
+            sl = seq_len // sp
+            start = jax.lax.axis_index("sp") * sl
+            cos = jax.lax.dynamic_slice_in_dim(cos, start, sl)
+            sin = jax.lax.dynamic_slice_in_dim(sin, start, sl)
         reduce_fn = None
         input_fn = None
         if tp > 1:
@@ -329,12 +341,20 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
             else:
                 reduce_fn = lambda y: jax.lax.psum(y, "tp")
 
-        def attn_fn(q, k, v):
-            return attention(
-                q, k, v, causal=True, impl=cfg.attn_impl,
-                block_q=cfg.flash_block_q or None,
-                block_k=cfg.flash_block_k or None,
+        if sp > 1:
+            from ray_lightning_tpu.parallel.ring_attention import (
+                ring_attention_local,
             )
+
+            def attn_fn(q, k, v):
+                return ring_attention_local(q, k, v, axis="sp", sp=sp)
+        else:
+            def attn_fn(q, k, v):
+                return attention(
+                    q, k, v, causal=True, impl=cfg.attn_impl,
+                    block_q=cfg.flash_block_q or None,
+                    block_k=cfg.flash_block_k or None,
+                )
 
         def layer_fn(x, lp):
             x, _ = _decoder_layer(x, lp, cfg, cos, sin, attn_fn, reduce_fn,
@@ -350,8 +370,11 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
         lambda p: p.reshape(pp, L // pp, *p.shape[1:]), params["layers"]
     )
     m = cfg.pp_microbatches or pp
-    data_spec = (
-        P("dp") if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else P()
+    batch_entry = (
+        "dp" if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else None
+    )
+    data_spec = P(batch_entry, "sp") if sp > 1 else (
+        P(batch_entry) if batch_entry else P()
     )
     return stage_fn, stage_params, m, data_spec
 
@@ -388,10 +411,11 @@ def _forward_pp(
     """Pipeline-parallel forward: the layer stack is split into pp stages
     (GPipe microbatch schedule, parallel/pipeline.py); embed and lm_head run
     replicated outside the pipeline. Composes with 'dp' (each dp group runs
-    its own pipeline on its batch shard) and 'tp' (megatron layout inside
-    each stage: heads/ffn column-sharded, explicit psum after the
-    row-parallel wo/w_down matmuls); fsdp/sp inside a stage are rejected
-    loudly."""
+    its own pipeline on its batch shard), 'tp' (megatron layout inside each
+    stage: heads/ffn column-sharded, explicit psum after the row-parallel
+    wo/w_down matmuls), and 'sp' (in-stage ring attention over local
+    sequence shards with global-position rope); fsdp inside a stage is
+    rejected loudly."""
     from ray_lightning_tpu.parallel.pipeline import pipeline_apply
 
     if cfg.n_experts:
@@ -399,17 +423,17 @@ def _forward_pp(
             "pipeline parallelism with MoE layers is not supported yet; "
             "use ep without pp (or dense layers with pp)"
         )
-    for ax in ("fsdp", "sp"):
-        if ax in mesh.axis_names and mesh.shape[ax] > 1:
-            raise NotImplementedError(
-                f"pipeline parallelism composes with dp/tp only for now; "
-                f"mesh has {ax}={mesh.shape[ax]}. Drop the pp axis to use {ax}."
-            )
+    if "fsdp" in mesh.axis_names and mesh.shape["fsdp"] > 1:
+        raise NotImplementedError(
+            f"pipeline parallelism composes with dp/tp/sp for now; mesh "
+            f"has fsdp={mesh.shape['fsdp']}. Drop the pp axis to use fsdp."
+        )
     tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
+    sp = mesh.shape["sp"] if "sp" in mesh.axis_names else 1
     _, S = tokens.shape
     x = params["embed"][tokens]
     stage_fn, stage_params, m, data_spec = _pp_stage_setup(
-        params, cfg, mesh, S, tp=tp
+        params, cfg, mesh, S, tp=tp, sp=sp
     )
     stage_spec = _stage_param_specs(cfg) if tp > 1 else None
     x = pipeline_apply(
@@ -617,6 +641,18 @@ class LlamaModule(LightningModule):
             0.0, self.lr, self.warmup_steps, max(self.total_steps, self.warmup_steps + 1)
         )
         return optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=self.weight_decay)
+
+    def generate(self, prompt, max_new_tokens: int, temperature: float = 0.0,
+                 rng=None):
+        """KV-cache autoregressive decoding with the trained params (see
+        models/generation.py for the compiled decode loop)."""
+        from ray_lightning_tpu.models.generation import generate
+
+        if self.params is None:
+            raise ValueError("generate requires trained params; fit first "
+                             "or set module.params")
+        return generate(self.params, prompt, self.config, max_new_tokens,
+                        temperature=temperature, rng=rng)
 
     def flops_per_sample(self) -> float:
         """Advertised to ThroughputMonitor: every llama fit logs train_mfu

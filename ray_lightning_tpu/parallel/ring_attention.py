@@ -71,6 +71,50 @@ def _merge(o1, l1, o2, l2):
     return out, m + jnp.log(denom)
 
 
+def ring_attention_local(
+    q_loc: jnp.ndarray,
+    k_loc: jnp.ndarray,
+    v_loc: jnp.ndarray,
+    axis: str,
+    sp: int,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """The ring program on LOCAL sequence shards — for callers already
+    inside a ``shard_map`` whose mesh has ``axis`` (e.g. sequence
+    parallelism inside a pipeline stage, models/llama.py::_pp_stage_setup).
+    q_loc: [B, H, S/sp, D]; k_loc/v_loc: [B, Hkv, S/sp, D]. Differentiable
+    under outer autodiff: ppermute transposes to the reverse rotation (a
+    bijection — none of psum's replication pitfalls)."""
+    d = q_loc.shape[-1]
+    scale = sm_scale if sm_scale is not None else float(1.0 / (d**0.5))
+    hq, hkv = q_loc.shape[1], k_loc.shape[1]
+    group = hq // hkv
+    my = jax.lax.axis_index(axis)
+    b_, _, sl, d_ = q_loc.shape
+    qf = q_loc.astype(jnp.float32).reshape(b_, hkv, group, sl, d_)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(t, carry):
+        out, lse, kb, vb = carry
+        origin = (my - t) % sp
+        mode = jnp.where(origin > my, 0, jnp.where(origin == my, 1, 2))
+        o_new, l_new = _block_attention(
+            qf, kb.astype(jnp.float32), vb.astype(jnp.float32), mode, scale
+        )
+        # a skipped block must not perturb the merge: force its weight
+        # to zero via lse = -inf
+        l_new = jnp.where(mode == 0, jnp.float32(-1e30), l_new)
+        out, lse = _merge(out, lse, o_new, l_new)
+        kb = jax.lax.ppermute(kb, axis, perm)
+        vb = jax.lax.ppermute(vb, axis, perm)
+        return out, lse, kb, vb
+
+    out0 = jnp.zeros(qf.shape, jnp.float32)
+    lse0 = jnp.full((*qf.shape[:-1], 1), -1e30, jnp.float32)
+    out, lse, _, _ = jax.lax.fori_loop(0, sp, step, (out0, lse0, k_loc, v_loc))
+    return out.reshape(q_loc.shape).astype(q_loc.dtype)
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -86,8 +130,6 @@ def ring_attention(
     """
     if not causal:
         raise NotImplementedError("ring attention currently implements causal LM")
-    d = q.shape[-1]
-    scale = sm_scale if sm_scale is not None else float(1.0 / (d**0.5))
     sp = mesh.shape[axis]
 
     def batch_entry():
@@ -95,11 +137,6 @@ def ring_attention(
         return tuple(names) if names else None
 
     spec = P(batch_entry(), None, axis, None)
-
-    # GQA without copies: fold q heads into [B, Hkv, G, S, D]; kv blocks
-    # ride the ring at true KV size (group broadcast happens in-einsum)
-    hq, hkv = q.shape[1], k.shape[1]
-    group = hq // hkv
 
     @partial(
         shard_map,
@@ -109,30 +146,9 @@ def ring_attention(
         check_rep=False,
     )
     def _ring(q_loc, k_loc, v_loc):
-        my = jax.lax.axis_index(axis)
-        b_, _, sl, d_ = q_loc.shape
-        qf = q_loc.astype(jnp.float32).reshape(b_, hkv, group, sl, d_)
-        perm = [(i, (i + 1) % sp) for i in range(sp)]
-
-        def step(t, carry):
-            out, lse, kb, vb = carry
-            origin = (my - t) % sp
-            mode = jnp.where(origin > my, 0, jnp.where(origin == my, 1, 2))
-            o_new, l_new = _block_attention(
-                qf, kb.astype(jnp.float32), vb.astype(jnp.float32), mode, scale
-            )
-            # a skipped block must not perturb the merge: force its weight
-            # to zero via lse = -inf
-            l_new = jnp.where(mode == 0, jnp.float32(-1e30), l_new)
-            out, lse = _merge(out, lse, o_new, l_new)
-            kb = jax.lax.ppermute(kb, axis, perm)
-            vb = jax.lax.ppermute(vb, axis, perm)
-            return out, lse, kb, vb
-
-        out0 = jnp.zeros(qf.shape, jnp.float32)
-        lse0 = jnp.full((*qf.shape[:-1], 1), -1e30, jnp.float32)
-        out, lse, _, _ = jax.lax.fori_loop(0, sp, step, (out0, lse0, k_loc, v_loc))
-        return out.reshape(q_loc.shape).astype(q_loc.dtype)
+        return ring_attention_local(
+            q_loc, k_loc, v_loc, axis=axis, sp=sp, sm_scale=sm_scale
+        )
 
     return _ring(q, k, v)
 

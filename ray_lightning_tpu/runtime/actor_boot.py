@@ -65,6 +65,18 @@ def main() -> None:
             jax.config.update(
                 "jax_platforms", os.environ["RLT_FORCE_JAX_PLATFORM"]
             )
+        # persistent XLA compilation cache: actors are fresh processes, so
+        # without this every worker recompiles the train step from scratch.
+        # Opt-in via env (the test conftest sets it) because the cache dir
+        # must be shared/writable; config-level set because sitecustomize
+        # pre-imports jax before env vars can influence its config.
+        if os.environ.get("RLT_XLA_CACHE_DIR"):
+            import jax
+
+            jax.config.update(
+                "jax_compilation_cache_dir", os.environ["RLT_XLA_CACHE_DIR"]
+            )
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         cls = cloudpickle.loads(_read_msg(stdin))
         args, kwargs = cloudpickle.loads(_read_msg(stdin))
         instance = cls(*args, **kwargs)

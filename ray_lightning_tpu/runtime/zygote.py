@@ -150,6 +150,13 @@ def main() -> int:
     _ORIGINAL_JAX_PLATFORMS = jax.config.jax_platforms
     if os.environ.get("RLT_FORCE_JAX_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["RLT_FORCE_JAX_PLATFORM"])
+    # persistent XLA compilation cache for forked actor children (same
+    # opt-in as actor_boot; config survives the fork)
+    if os.environ.get("RLT_XLA_CACHE_DIR"):
+        jax.config.update(
+            "jax_compilation_cache_dir", os.environ["RLT_XLA_CACHE_DIR"]
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)

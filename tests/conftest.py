@@ -22,11 +22,25 @@ if not os.environ.get("RLT_TEST_ON_TPU"):
 
     jax.config.update("jax_platforms", "cpu")
 
-# NOTE on the XLA persistent compilation cache: it cuts recompiles 8x
-# (measured 5.8s -> 0.7s on the llama-tiny step) but is NOT enabled —
-# reloading the cached MoE train-step executable on the CPU backend
-# reproducibly aborts the process (SIGABRT inside pjit on this jaxlib).
-# Revisit when jaxlib's CPU executable deserialization stabilizes.
+# Persistent XLA compilation cache — WORKER PROCESSES ONLY. Within one
+# suite run the slow tests spawn many actor processes compiling the same
+# tiny train steps; sharing a cache across them (actor_boot/zygote honor
+# RLT_XLA_CACHE_DIR) removes that duplicate work. The MAIN pytest process
+# must NOT use it: on this jaxlib, loading any cached CPU-AOT executable
+# taints the process (machine-feature mismatch, "+prefer-no-gather"), and
+# the next FRESH gather-heavy compile aborts the interpreter — reproduced
+# 2026-07-29, warm-cache runs died at test_moe_llama_trains (first MoE
+# top-k dispatch compile after cached loads) with glibc abort. Actors are
+# safe because they only ever load programs sibling actors wrote and
+# compile nothing gather-heavy afterwards. RLT_XLA_CACHE=0 disables even
+# the worker cache.
+if os.environ.get("RLT_XLA_CACHE", "1") != "0" and not os.environ.get(
+    "RLT_TEST_ON_TPU"
+):
+    os.environ.setdefault(
+        "RLT_XLA_CACHE_DIR",
+        os.path.join(os.path.dirname(__file__), "..", ".xla_cache"),
+    )
 
 # CPU is a logical scheduling resource (Ray semantics); CI containers may
 # report 1 core, which would serialize every multi-actor test. The reference
@@ -45,3 +59,11 @@ import pytest  # noqa: E402
 @pytest.fixture
 def tmp_root(tmp_path):
     return str(tmp_path)
+
+
+@pytest.fixture
+def no_xla_cache():
+    """Compatibility no-op: the main test process never uses the
+    persistent compilation cache (see the poison note above). Kept so
+    MoE tests stay visibly annotated as the trigger of that failure."""
+    yield

@@ -77,6 +77,7 @@ def test_autotune_picks_best_blocks(monkeypatch):
 
     class Cfg:
         n_heads = 2
+        n_kv_heads = 2
         head_dim = 8
 
     note = bench._autotune_flash(jax, jnp, Cfg(), batch=1, seq=512)
@@ -95,6 +96,7 @@ def test_autotune_none_when_no_candidate_fits():
 
     class Cfg:
         n_heads = 2
+        n_kv_heads = 2
         head_dim = 8
 
     assert bench._autotune_flash(jax, jnp, Cfg(), batch=1, seq=100) is None
@@ -139,3 +141,13 @@ def test_wedged_probe_falls_back_to_cpu(monkeypatch, capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "error" in out["detail"]
     assert out["value"] == 10.0
+
+
+def test_autotune_gate_respects_pins_and_env():
+    """Explicit RLT_FLASH_BLOCK_Q/K pins and RLT_BENCH_AUTOTUNE=0 must
+    skip the sweep outright; off-TPU never autotunes."""
+    assert bench._should_autotune(True, {})
+    assert not bench._should_autotune(False, {})
+    assert not bench._should_autotune(True, {"RLT_BENCH_AUTOTUNE": "0"})
+    assert not bench._should_autotune(True, {"RLT_FLASH_BLOCK_Q": "256"})
+    assert not bench._should_autotune(True, {"RLT_FLASH_BLOCK_K": "256"})

@@ -110,7 +110,7 @@ def test_graft_entry_contract():
     assert out.ndim == 3
 
 
-def test_moe_llama_trains(tmp_root):
+def test_moe_llama_trains(tmp_root, no_xla_cache):
     """The MoE flagship variant (expert-parallel MLP, aux loss) trains and
     the aux loss is logged."""
     cfg = LlamaConfig.tiny_moe()
@@ -124,7 +124,7 @@ def test_moe_llama_trains(tmp_root):
     assert "train_moe_aux" in trainer.callback_metrics
 
 
-def test_moe_llama_ep_mesh(tmp_root):
+def test_moe_llama_ep_mesh(tmp_root, no_xla_cache):
     """MoE flagship on a mesh with an 'ep' axis: expert weights shard over
     ep, the dispatch einsums become all-to-alls."""
     cfg = LlamaConfig.tiny_moe()
@@ -289,11 +289,11 @@ def test_train_pp_1f1b_mesh(tmp_root):
 def test_pp_rejects_unsupported_combos():
     from ray_lightning_tpu.models.llama import forward, init_params
 
-    mesh = build_mesh(MeshSpec(axes={"pp": 2, "sp": 2, "dp": 2}))
+    mesh = build_mesh(MeshSpec(axes={"pp": 2, "fsdp": 2, "dp": 2}))
     cfg = LlamaConfig.tiny()
     params = init_params(jax.random.key(0), cfg)
     tokens = jnp.zeros((4, cfg.max_seq), jnp.int32)
-    with pytest.raises(NotImplementedError, match="composes with dp/tp"):
+    with pytest.raises(NotImplementedError, match="fsdp"):
         forward(params, tokens, cfg, mesh)
 
     moe_cfg = LlamaConfig.tiny_moe()
@@ -363,3 +363,55 @@ def test_pp_1f1b_tp_matches_dense_loss_and_grads():
         err = float(jnp.max(jnp.abs(g_ref[name] - g_pp[name])))
         scale = float(jnp.max(jnp.abs(g_ref[name]))) + 1e-12
         assert err < 1e-5 + 1e-3 * scale, (name, err)
+
+
+def test_pp_sp_matches_dense_loss_and_grads():
+    """GPipe pipeline composed with sequence parallelism (pp=2 x sp=2 x
+    dp=2): in-stage ring attention over local sequence shards, rope tables
+    sliced to global positions. Loss and grads must match the dense path."""
+    import dataclasses
+
+    from ray_lightning_tpu.models.llama import init_params, lm_loss
+
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(), dtype=jnp.float32, pp_microbatches=2
+    )
+    mesh = build_mesh(MeshSpec(axes={"pp": 2, "sp": 2, "dp": 2}))
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(4).integers(0, cfg.vocab_size, (8, cfg.max_seq)),
+        jnp.int32,
+    )
+    dense = lambda p: lm_loss(p, tokens, cfg, None)[0]
+    piped = lambda p: lm_loss(p, tokens, cfg, mesh)[0]
+    l_ref = float(jax.jit(dense)(params))
+    l_pp = float(jax.jit(piped)(params))
+    assert abs(l_ref - l_pp) < 1e-4, (l_ref, l_pp)
+    g_ref = jax.jit(jax.grad(dense))(params)
+    g_pp = jax.jit(jax.grad(piped))(params)
+    # wq/wk catch rope-offset mistakes (position-dependent); embed catches
+    # the sequence-shard stitching of the input cotangent
+    for name in ("wq", "wk", "wo"):
+        a, b = g_ref["layers"][name], g_pp["layers"][name]
+        err = float(jnp.max(jnp.abs(a - b)))
+        scale = float(jnp.max(jnp.abs(a))) + 1e-12
+        assert err < 1e-5 + 1e-3 * scale, (name, err, scale)
+    err = float(jnp.max(jnp.abs(g_ref["embed"] - g_pp["embed"])))
+    scale = float(jnp.max(jnp.abs(g_ref["embed"]))) + 1e-12
+    assert err < 1e-5 + 1e-3 * scale, ("embed", err)
+
+
+def test_train_pp_sp_mesh(tmp_root):
+    """Full fit through the Trainer on pp=2 x sp=2 x dp=2."""
+    cfg = LlamaConfig.tiny()
+    strategy = rlt.XLAStrategy(
+        mesh_spec=MeshSpec(axes={"pp": 2, "sp": 2, "dp": 2}),
+        sharding_policy=ShardingPolicy(data_axes=("dp",)),
+    )
+    module = LlamaModule(cfg, lr=3e-3, warmup_steps=2, total_steps=50)
+    dm = SyntheticLMDataModule(cfg, batch_size=8, n_train=32)
+    trainer = get_trainer(tmp_root, max_epochs=1, strategy=strategy,
+                          limit_train_batches=None, checkpoint_callback=False)
+    trainer.fit(module, datamodule=dm)
+    assert "val_loss" in trainer.callback_metrics
+    assert np.isfinite(float(trainer.callback_metrics["val_loss"]))

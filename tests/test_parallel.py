@@ -104,7 +104,7 @@ def test_pipeline_1f1b_loss_and_grads_match_sequential():
         assert float(jnp.max(jnp.abs(a - b))) < 1e-6
 
 
-def test_moe_routing_and_grads():
+def test_moe_routing_and_grads(no_xla_cache):
     p = init_moe_params(jax.random.key(0), dim=32, ffn_dim=64, n_experts=4,
                         dtype=jnp.float32)
     x = jax.random.normal(jax.random.key(1), (2, 16, 32), jnp.float32)
@@ -115,7 +115,7 @@ def test_moe_routing_and_grads():
     assert float(jnp.linalg.norm(grads["router"])) > 0.0
 
 
-def test_moe_capacity_drops_tokens():
+def test_moe_capacity_drops_tokens(no_xla_cache):
     """With capacity 1 slot per expert most tokens are dropped (out≈0 for
     them) — the capacity mechanism actually binds."""
     p = init_moe_params(jax.random.key(0), dim=32, ffn_dim=64, n_experts=2,
