@@ -244,7 +244,7 @@ def _act_constraint(x, mesh: Optional[Mesh], *entries):
 
 
 def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
-                   input_fn=None):
+                   input_fn=None, return_kv: bool = False):
     """One transformer block (pre-norm attention + gated MLP / MoE) shared
     by the scanned dense path and the pipeline stage path — the math must
     stay identical between them.
@@ -255,7 +255,12 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
     'tp') completes the row-parallel wo / w_down matmuls — the megatron
     pattern, expressed once. ``input_fn`` (megatron's f operator) marks the
     normed activations entering the column-parallel matmuls; the manual-VJP
-    1F1B schedule needs it to re-sum input cotangents over 'tp'."""
+    1F1B schedule needs it to re-sum input cotangents over 'tp'.
+
+    ``return_kv=True`` additionally returns this layer's post-rope
+    (k, v) in cache layout [B, Hkv, S, hd] — the KV-cache prefill path
+    (models/generation.py) reuses the training math verbatim instead of
+    maintaining a drift-prone copy."""
     red = reduce_fn or (lambda y: y)
     fin = input_fn or (lambda y: y)
     B, S = x.shape[0], x.shape[1]
@@ -285,6 +290,8 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
         gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
         x = x + red(gated @ lp["w_down"])
         aux = jnp.float32(0.0)
+    if return_kv:
+        return x, aux, (k, v)
     return x, aux
 
 
