@@ -1,24 +1,38 @@
-"""Autoregressive decoding for the flagship llama family: preallocated
-KV cache, fully compiled decode loop.
+"""Autoregressive decoding for the flagship llama family: batched prompt
+prefill + preallocated KV cache + fully compiled decode loop.
 
 TPU-first design:
 - the cache is STATIC-shaped ([L, B, Hkv, max_len, D]) and updated with
   ``lax.dynamic_update_slice`` — no reallocation, no dynamic shapes, one
   compile for the whole generation;
-- the decode loop is a single ``lax.scan`` over step index (prompt prefill
-  included: tokens are consumed from the prompt while ``pos < prompt_len``
-  and sampled after), so the host never round-trips per token;
+- the prompt is consumed in ONE batched forward pass (``prefill``) that
+  reuses the training layer math (models/llama.py::_decoder_layer with
+  ``return_kv=True``) — MXU-shaped [B, P, D] matmuls instead of P
+  sequential matvecs — and writes every layer's post-rope (k, v) into
+  the cache;
+- the decode loop is a single ``lax.scan`` over step index, so the host
+  never round-trips per token;
 - attention at decode is a masked matvec over the cache (memory-bound;
   the MXU flash kernel buys nothing at q-length 1, so the plain einsum is
   the right kernel here), GQA folded the same way as training;
-- rope tables are precomputed for ``max_len`` and indexed at the traced
-  position.
+- rope tables are precomputed ONCE for ``max_len`` in ``generate`` and
+  passed into every step (loop-invariant by construction, not by hoping
+  XLA hoists them);
+- MoE configs route LOSSLESSLY throughout generation
+  (``moe_ffn_lossless``: all experts evaluated densely, combined with the
+  top-k gate weights, so no token ever drops and no O(T^2*E) dispatch
+  tensors are built): capacity truncation is a training-time
+  load-balancing artifact computed over B*S competing tokens and has no
+  analogue at inference. Prefill and stepwise decode therefore produce
+  identical caches for MoE configs too.
 
 The reference wraps user torch models and has no generation surface
 (SURVEY §2a — examples train/validate only); this is native capability on
 top of the flagship family. Exactness contract: with greedy sampling the
 cached decode reproduces the training ``forward``'s argmax at every
-position (tested against the no-cache path).
+position (tested against the no-cache path); for MoE configs this holds
+whenever training's expert capacity does not bind (tested with an
+unbinding capacity_factor).
 """
 from __future__ import annotations
 
@@ -27,7 +41,8 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ray_lightning_tpu.models.llama import LlamaConfig
+from ray_lightning_tpu.models.llama import LlamaConfig, _decoder_layer
+from ray_lightning_tpu.ops.attention import attention, flash_supported
 from ray_lightning_tpu.ops.rmsnorm import rmsnorm
 from ray_lightning_tpu.ops.rope import rope_angles
 
@@ -57,12 +72,71 @@ def _apply_rope_one(x: jnp.ndarray, c: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarr
     return out.astype(dtype)
 
 
+def prefill(
+    params: Dict[str, Any],
+    prompt: jnp.ndarray,
+    cfg: LlamaConfig,
+    cache: Dict[str, jnp.ndarray],
+    rope_table: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Consume the whole prompt [B, P] in one batched forward, writing every
+    layer's (k, v) into ``cache`` positions [0, P). Returns (last-position
+    logits [B, V] fp32, updated cache).
+
+    Reuses the training layer (``_decoder_layer`` with ``return_kv=True``)
+    so the cache contents cannot drift from the training math.
+    """
+    B, P = prompt.shape
+    hd = cfg.head_dim
+    max_len = cache["k"].shape[3]
+    if rope_table is None:
+        rope_table = rope_angles(max_len, hd, cfg.rope_theta)
+    cos, sin = rope_table[0][:P], rope_table[1][:P]
+    x = params["embed"][prompt]  # [B, P, D]
+
+    def attn_fn(q, k, v):
+        # prompts have arbitrary lengths; a config-pinned impl="flash"
+        # degrades to auto (which falls back to the einsum path) when the
+        # prompt shape is not block-tileable, instead of raising
+        impl = cfg.attn_impl
+        if impl == "flash" and not flash_supported(
+            q.shape, k.shape, cfg.flash_block_q or None,
+            cfg.flash_block_k or None,
+        ):
+            impl = None
+        return attention(q, k, v, causal=True, impl=impl,
+                         block_q=cfg.flash_block_q or None,
+                         block_k=cfg.flash_block_k or None)
+
+    # MoE prompts route losslessly too: generation's semantic is uniformly
+    # no-drop — prefill and stepwise decode must produce identical caches,
+    # and training's capacity truncation is a load-balancing artifact, not
+    # an inference behavior. moe_lossless runs all experts densely (no
+    # O(T^2*E) dispatch tensors).
+    def layer_fn(x, lp):
+        x, _, kv = _decoder_layer(x, lp, cfg, cos, sin, attn_fn,
+                                  return_kv=True, moe_lossless=True)
+        return x, kv
+
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, params["layers"])
+    # ks/vs: [L, B, Hkv, P, hd] -> cache[:, :, :, :P]
+    zeros_idx = (0, 0, 0, 0, 0)
+    cache = {
+        "k": jax.lax.dynamic_update_slice(cache["k"], ks.astype(cache["k"].dtype), zeros_idx),
+        "v": jax.lax.dynamic_update_slice(cache["v"], vs.astype(cache["v"].dtype), zeros_idx),
+    }
+    h = rmsnorm(x[:, -1], params["final_norm"])
+    logits = h @ params["lm_head"]
+    return logits.astype(jnp.float32), cache
+
+
 def decode_step(
     params: Dict[str, Any],
     cache: Dict[str, jnp.ndarray],
     token: jnp.ndarray,
     pos: jnp.ndarray,
     cfg: LlamaConfig,
+    rope_table: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """One decode step. token: [B] int32; pos: scalar int32 (same position
     for the whole batch). Returns (logits [B, V], updated cache).
@@ -70,13 +144,14 @@ def decode_step(
     The layer stack is a ``lax.scan`` over the stacked params with the
     per-layer cache slices as a second scanned input, mirroring the
     training forward's structure (models/llama.py::forward).
+    ``rope_table``: precomputed (cos, sin) for the cache length — pass it
+    when stepping in a loop so the tables are built once, not per step.
     """
-    if cfg.n_experts:
-        raise NotImplementedError("KV-cache decoding for MoE configs is not wired yet")
     hd = cfg.head_dim
     max_len = cache["k"].shape[3]
-    table = rope_angles(max_len, hd, cfg.rope_theta)
-    c, s = _rope_at(table, pos)
+    if rope_table is None:
+        rope_table = rope_angles(max_len, hd, cfg.rope_theta)
+    c, s = _rope_at(rope_table, pos)
     x = params["embed"][token]  # [B, D]
 
     # causal-by-position mask over the static cache length
@@ -111,8 +186,20 @@ def decode_step(
         att = att.reshape(B, nh * hd).astype(x.dtype)
         x = x + att @ lp["wo"]
         h2 = rmsnorm(x, lp["mlp_norm"])
-        gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
-        x = x + gated @ lp["w_down"]
+        if cfg.n_experts and "moe" in lp:
+            from ray_lightning_tpu.parallel.moe import moe_ffn_lossless
+
+            # lossless routing at decode: capacity dropping is a TRAINING
+            # load-balancing artifact computed over B*S competing tokens
+            # and has no analogue at one-position decode — every routed
+            # token keeps its experts (dense all-experts evaluation)
+            moe_out = moe_ffn_lossless(
+                lp["moe"], h2[:, None, :], top_k=cfg.expert_top_k
+            )
+            x = x + moe_out[:, 0]
+        else:
+            gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
+            x = x + gated @ lp["w_down"]
         return x, (k_cache, v_cache)
 
     x, (k_new, v_new) = jax.lax.scan(
@@ -130,38 +217,40 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
-    pad_id: int = 0,
 ) -> jnp.ndarray:
-    """Generate ``max_new_tokens`` after ``prompt`` [B, P] (right-aligned
-    dense prompts; all rows share length P). Returns [B, P + max_new_tokens].
+    """Generate ``max_new_tokens`` after ``prompt`` [B, P] (dense prompts;
+    all rows share length P). Returns [B, P + max_new_tokens].
 
-    One compiled ``lax.scan`` covers prefill AND generation: at step t the
-    input token is the prompt's (teacher-forced) while t < P, the model's
-    sample after. temperature 0 = greedy; > 0 = categorical sampling.
+    The prompt is consumed by ONE batched ``prefill`` pass (the training
+    layer math filling the cache), then one compiled ``lax.scan`` samples
+    the new tokens. temperature 0 = greedy; > 0 = categorical sampling.
     """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if rng is None:
         rng = jax.random.key(0)
     B, P = prompt.shape
     total = P + max_new_tokens
     cache = init_kv_cache(cfg, B, total)
+    table = rope_angles(total, cfg.head_dim, cfg.rope_theta)
+
+    def sample(logits, key):
+        if temperature > 0.0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    logits0, cache = prefill(params, prompt, cfg, cache, table)
+    rng, sub = jax.random.split(rng)
+    tok0 = sample(logits0, sub).astype(prompt.dtype)  # token at position P
 
     def step(carry, t):
         cache, tok, rng = carry
-        logits, cache = decode_step(params, cache, tok, t, cfg)
+        logits, cache = decode_step(params, cache, tok, t, cfg, table)
         rng, sub = jax.random.split(rng)
-        if temperature > 0.0:
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
-        else:
-            nxt = jnp.argmax(logits, axis=-1)
-        nxt = nxt.astype(prompt.dtype)
-        # teacher-force while still inside the prompt
-        in_prompt = t + 1 < P
-        forced = prompt[:, jnp.minimum(t + 1, P - 1)]
-        tok_next = jnp.where(in_prompt, forced, nxt)
-        return (cache, tok_next, rng), tok_next
+        nxt = sample(logits, sub).astype(prompt.dtype)
+        return (cache, nxt, rng), nxt
 
     (_, _, _), toks = jax.lax.scan(
-        step, (cache, prompt[:, 0], rng), jnp.arange(total - 1)
+        step, (cache, tok0, rng), jnp.arange(P, total - 1)
     )
-    out = jnp.concatenate([prompt[:, :1], toks.swapaxes(0, 1)], axis=1)
-    return out
+    return jnp.concatenate([prompt, tok0[:, None], toks.swapaxes(0, 1)], axis=1)

@@ -244,7 +244,8 @@ def _act_constraint(x, mesh: Optional[Mesh], *entries):
 
 
 def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
-                   input_fn=None, return_kv: bool = False):
+                   input_fn=None, return_kv: bool = False,
+                   moe_lossless: bool = False):
     """One transformer block (pre-norm attention + gated MLP / MoE) shared
     by the scanned dense path and the pipeline stage path — the math must
     stay identical between them.
@@ -279,12 +280,16 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
     x = x + red(att @ lp["wo"])
     h2 = fin(rmsnorm(x, lp["mlp_norm"]))
     if cfg.n_experts and "moe" in lp:
-        from ray_lightning_tpu.parallel.moe import moe_ffn
+        from ray_lightning_tpu.parallel.moe import moe_ffn, moe_ffn_lossless
 
-        moe_out, aux = moe_ffn(
-            lp["moe"], h2, top_k=cfg.expert_top_k,
-            capacity_factor=cfg.capacity_factor,
-        )
+        if moe_lossless:  # inference: no-drop routing, no dispatch tensors
+            moe_out = moe_ffn_lossless(lp["moe"], h2, top_k=cfg.expert_top_k)
+            aux = jnp.float32(0.0)
+        else:
+            moe_out, aux = moe_ffn(
+                lp["moe"], h2, top_k=cfg.expert_top_k,
+                capacity_factor=cfg.capacity_factor,
+            )
         x = x + moe_out
     else:
         gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])

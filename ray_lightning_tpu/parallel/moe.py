@@ -46,22 +46,68 @@ def moe_param_specs(n_layers: Optional[int] = None) -> Dict[str, P]:
     }
 
 
+def moe_ffn_lossless(
+    params: Dict[str, Any],
+    x: jnp.ndarray,
+    top_k: int = 2,
+) -> jnp.ndarray:
+    """No-drop MoE evaluation for INFERENCE: every expert runs on every
+    token (a ``lax.scan`` over experts — E dense FFNs), combined with the
+    normalized top-k gate weights. Semantically identical to ``moe_ffn``
+    whenever its capacity does not bind, but with no [T, E, C] dispatch
+    tensors: memory O(T*F) and compute E/k x the routed path — the right
+    trade at generation shapes, where the dispatch one-hots are O(T^2*E)
+    once capacity must cover a worst-case expert load (lossless).
+    x: [B, S, D] -> out [B, S, D] (no aux loss: inference only).
+    """
+    b, s, d = x.shape
+    e = params["router"].shape[-1]
+    xt = x.reshape(b * s, d)
+
+    logits = (xt.astype(jnp.float32) @ params["router"]).astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)  # [T, E]
+    top_vals, top_idx = jax.lax.top_k(gates, top_k)
+    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    sel = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # [T, K, E]
+    w = (sel * top_vals[..., None]).sum(axis=1)  # [T, E]
+
+    def body(acc, expert):
+        wg, wu, wd, gate_col = expert  # [D,F], [D,F], [F,D], [T]
+        h = jax.nn.silu(xt @ wg) * (xt @ wu)
+        return acc + gate_col[:, None] * (h @ wd).astype(jnp.float32), None
+
+    acc0 = jnp.zeros((b * s, d), jnp.float32)
+    out, _ = jax.lax.scan(
+        body, acc0,
+        (params["w_gate"], params["w_up"], params["w_down"], w.T),
+    )
+    return out.reshape(b, s, d).astype(x.dtype)
+
+
 def moe_ffn(
     params: Dict[str, Any],
     x: jnp.ndarray,
     top_k: int = 2,
     capacity_factor: float = 1.5,
+    capacity: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """x: [B, S, D] -> (out [B, S, D], aux_loss scalar).
 
     aux_loss is the Switch-Transformer load-balancing loss: n_experts x
     sum_i(mean gate probability_i x raw pre-capacity assignment fraction_i).
+
+    ``capacity``: explicit per-expert slot count, overriding the
+    capacity_factor formula (exact integer bound — the float
+    capacity_factor math can round below an intended bound). Note:
+    generation does NOT use this; it routes through
+    :func:`moe_ffn_lossless`, which needs no dispatch tensors at all.
     """
     b, s, d = x.shape
     e = params["router"].shape[-1]
     t = b * s
     xt = x.reshape(t, d)
-    capacity = max(1, int(capacity_factor * top_k * t / e))
+    if capacity is None:
+        capacity = max(1, int(capacity_factor * top_k * t / e))
 
     logits = (xt.astype(jnp.float32) @ params["router"]).astype(jnp.float32)
     gates = jax.nn.softmax(logits, axis=-1)  # [T, E]
