@@ -25,7 +25,8 @@ Design (TPU-first):
 The reference project has no attention of its own (it wraps user torch
 models); this is the hot op of our flagship model family (SURVEY §5
 long-context: ring attention in parallel/ring_attention.py shards sequence
-ACROSS chips and calls this kernel per block pair).
+ACROSS chips and, on TPU, runs these flash kernels per ring step through a
+ring-level custom VJP — einsum block math remains as the off-TPU fallback).
 """
 from __future__ import annotations
 

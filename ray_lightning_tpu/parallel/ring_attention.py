@@ -18,8 +18,20 @@ Algorithm (blockwise, numerically exact):
   ppermute is unconditional, so every device participates in every
   collective (SPMD-safe).
 
-Autodiff: the whole function is differentiable JAX (ppermute transposes to
-the reverse rotation), so the backward pass is itself a ring program.
+In-chip block math has TWO implementations:
+- FLASH (default on TPU): the pallas kernels from ops/attention.py run per
+  ring step (``lax.switch`` between the static causal/full variants), so
+  in-chip memory is O(block^2) — never the [S/sp x S/sp] fp32 logits —
+  and the whole (S/sp)^2 work rides the MXU. Differentiation is a
+  ring-level ``jax.custom_vjp``: the backward pass re-rotates KV (plus
+  dK/dV accumulators, which land back on their origin device after sp
+  hops) and runs the flash backward kernels seeded with the final
+  logsumexp and delta = rowsum(dO * O) — the standard flash residuals,
+  valid globally because the forward merge produces exactly the global
+  softmax statistics.
+- EINSUM (reference/off-TPU default): full per-block-pair logits,
+  differentiable by outer autodiff (ppermute transposes to the reverse
+  rotation).
 """
 from __future__ import annotations
 
@@ -31,7 +43,14 @@ import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ray_lightning_tpu.ops.attention import reference_attention
+from ray_lightning_tpu.ops.attention import (
+    _flash_bwd,
+    _flash_fwd,
+    _interpret_default,
+    _lane_pad,
+    flash_supported,
+    reference_attention,
+)
 
 
 def _block_attention(q, k, v, mode, scale):
@@ -71,6 +90,123 @@ def _merge(o1, l1, o2, l2):
     return out, m + jnp.log(denom)
 
 
+# --------------------------------------------------------------------- #
+# flash block math: ring-level custom VJP over the pallas kernels
+# --------------------------------------------------------------------- #
+def _block_flash_fwd(q, kb, vb, mode, scale, interpret, blocks):
+    """One ring step's partial attention via the flash kernel.
+    q: [B, Hq, Sl, D]; kb/vb: [B, Hkv, Sl, D]; mode: traced 0/1/2.
+    Returns (out fp32 normalized-within-block, lse [B, Hq, Sl, 1] fp32)."""
+
+    def _skip(q, kb, vb):
+        return (
+            jnp.zeros(q.shape, jnp.float32),
+            jnp.full((*q.shape[:-1], 1), -1e30, jnp.float32),
+        )
+
+    def _causal(q, kb, vb):
+        o, lse = _flash_fwd(q, kb, vb, True, scale, interpret, blocks)
+        return o.astype(jnp.float32), lse
+
+    def _full(q, kb, vb):
+        o, lse = _flash_fwd(q, kb, vb, False, scale, interpret, blocks)
+        return o.astype(jnp.float32), lse
+
+    return jax.lax.switch(mode, (_skip, _causal, _full), q, kb, vb)
+
+
+def _block_flash_bwd(q, kb, vb, out, lse, g, mode, scale, interpret, blocks):
+    """One ring step's gradient contributions via the flash backward
+    kernels, seeded with the GLOBAL lse and out (delta is computed inside
+    _flash_bwd as rowsum(g * out), which is the global delta)."""
+
+    def _skip(q, kb, vb, out, lse, g):
+        return (
+            jnp.zeros(q.shape, q.dtype),
+            jnp.zeros(kb.shape, kb.dtype),
+            jnp.zeros(vb.shape, vb.dtype),
+        )
+
+    def _causal(q, kb, vb, out, lse, g):
+        return _flash_bwd(q, kb, vb, out, lse, g, True, scale, interpret, blocks)
+
+    def _full(q, kb, vb, out, lse, g):
+        return _flash_bwd(q, kb, vb, out, lse, g, False, scale, interpret, blocks)
+
+    return jax.lax.switch(mode, (_skip, _causal, _full), q, kb, vb, out, lse, g)
+
+
+def _ring_modes(my, t, sp):
+    origin = (my - t) % sp
+    return jnp.where(origin > my, 0, jnp.where(origin == my, 1, 2))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash_attention(q, k, v, axis, sp, scale, interpret, blocks):
+    out, _ = _ring_flash_fwd_pass(q, k, v, axis, sp, scale, interpret, blocks)
+    return out
+
+
+def _ring_flash_fwd_pass(q, k, v, axis, sp, scale, interpret, blocks):
+    """The forward ring: flash per block pair, streaming-softmax merge.
+    Returns (out [B,Hq,Sl,D] in q.dtype, lse [B,Hq,Sl,1] fp32 — the GLOBAL
+    softmax statistics, exactly those of monolithic attention)."""
+    my = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(t, carry):
+        out, lse, kb, vb = carry
+        mode = _ring_modes(my, t, sp)
+        o_new, l_new = _block_flash_fwd(q, kb, vb, mode, scale, interpret, blocks)
+        out, lse = _merge(out, lse, o_new, l_new)
+        kb = jax.lax.ppermute(kb, axis, perm)
+        vb = jax.lax.ppermute(vb, axis, perm)
+        return out, lse, kb, vb
+
+    out0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full((*q.shape[:-1], 1), -1e30, jnp.float32)
+    out, lse, _, _ = jax.lax.fori_loop(0, sp, step, (out0, lse0, k, v))
+    return out.astype(q.dtype), lse
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis, sp, scale, interpret, blocks):
+    out, lse = _ring_flash_fwd_pass(q, k, v, axis, sp, scale, interpret, blocks)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis, sp, scale, interpret, blocks, res, g):
+    """Backward ring: rotate (kb, vb) exactly as the forward did, plus
+    dK/dV accumulators that ride along — after sp hops each accumulator is
+    back on the device owning that KV block. dQ accumulates locally."""
+    q, k, v, out, lse = res
+    my = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(t, carry):
+        dq, kb, vb, dkb, dvb = carry
+        mode = _ring_modes(my, t, sp)
+        dq_c, dk_c, dv_c = _block_flash_bwd(
+            q, kb, vb, out, lse, g, mode, scale, interpret, blocks
+        )
+        dq = dq + dq_c.astype(jnp.float32)
+        dkb = dkb + dk_c.astype(jnp.float32)
+        dvb = dvb + dv_c.astype(jnp.float32)
+        kb = jax.lax.ppermute(kb, axis, perm)
+        vb = jax.lax.ppermute(vb, axis, perm)
+        dkb = jax.lax.ppermute(dkb, axis, perm)
+        dvb = jax.lax.ppermute(dvb, axis, perm)
+        return dq, kb, vb, dkb, dvb
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    dq, _, _, dk, dv = jax.lax.fori_loop(0, sp, step, (dq0, k, v, dk0, dv0))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash_attention.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
 def ring_attention_local(
     q_loc: jnp.ndarray,
     k_loc: jnp.ndarray,
@@ -78,15 +214,50 @@ def ring_attention_local(
     axis: str,
     sp: int,
     sm_scale: Optional[float] = None,
+    impl: Optional[str] = None,
+    interpret: Optional[bool] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jnp.ndarray:
     """The ring program on LOCAL sequence shards — for callers already
     inside a ``shard_map`` whose mesh has ``axis`` (e.g. sequence
     parallelism inside a pipeline stage, models/llama.py::_pp_stage_setup).
-    q_loc: [B, H, S/sp, D]; k_loc/v_loc: [B, Hkv, S/sp, D]. Differentiable
-    under outer autodiff: ppermute transposes to the reverse rotation (a
-    bijection — none of psum's replication pitfalls)."""
+    q_loc: [B, H, S/sp, D]; k_loc/v_loc: [B, Hkv, S/sp, D].
+
+    impl: "flash" | "reference" | None (auto: flash when the LOCAL shard
+    shapes are TPU-tileable and not interpreting — same policy as
+    ops/attention.py::attention). The flash path differentiates through the
+    ring-level custom VJP; the einsum path through outer autodiff (ppermute
+    transposes to the reverse rotation — a bijection, none of psum's
+    replication pitfalls)."""
     d = q_loc.shape[-1]
     scale = sm_scale if sm_scale is not None else float(1.0 / (d**0.5))
+    interp = interpret if interpret is not None else _interpret_default()
+    flash_ok = flash_supported(q_loc.shape, k_loc.shape, block_q, block_k)
+    if impl is None:
+        impl = "flash" if (flash_ok and not interp) else "reference"
+    elif impl == "flash" and not flash_ok:
+        raise ValueError(
+            "ring flash attention requires local shards with equal, "
+            "block-divisible sequence lengths; got local q "
+            f"{q_loc.shape}, k {k_loc.shape}. Use impl='reference'."
+        )
+    if impl == "flash":
+        blocks = (block_q, block_k) if (block_q or block_k) else None
+        d_pad = _lane_pad(d)
+        if d_pad != d:
+            # zero-pad head dim to the lane width around the kernels
+            # (exact — same trick as ops/attention.py::attention); scale is
+            # already fixed from the true d
+            pad = ((0, 0), (0, 0), (0, 0), (0, d_pad - d))
+            out = _ring_flash_attention(
+                jnp.pad(q_loc, pad), jnp.pad(k_loc, pad), jnp.pad(v_loc, pad),
+                axis, sp, scale, interp, blocks,
+            )
+            return out[..., :d]
+        return _ring_flash_attention(
+            q_loc, k_loc, v_loc, axis, sp, scale, interp, blocks
+        )
     hq, hkv = q_loc.shape[1], k_loc.shape[1]
     group = hq // hkv
     my = jax.lax.axis_index(axis)
@@ -123,10 +294,15 @@ def ring_attention(
     axis: str = "sp",
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    impl: Optional[str] = None,
+    interpret: Optional[bool] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jnp.ndarray:
     """q/k/v: [B, H, S, D] GLOBAL shapes, sequence sharded over ``axis``
     (and batch over dp/fsdp if present). Returns [B, H, S, D] with the same
-    sharding.
+    sharding. impl/block_q/block_k select the in-chip block math (see
+    ``ring_attention_local``).
     """
     if not causal:
         raise NotImplementedError("ring attention currently implements causal LM")
@@ -147,7 +323,8 @@ def ring_attention(
     )
     def _ring(q_loc, k_loc, v_loc):
         return ring_attention_local(
-            q_loc, k_loc, v_loc, axis=axis, sp=sp, sm_scale=sm_scale
+            q_loc, k_loc, v_loc, axis=axis, sp=sp, sm_scale=sm_scale,
+            impl=impl, interpret=interpret, block_q=block_q, block_k=block_k,
         )
 
     return _ring(q, k, v)
