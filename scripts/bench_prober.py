@@ -39,6 +39,41 @@ def cache_ok() -> bool:
     return cached is not None
 
 
+REPORT = os.path.join(REPO, "tpu_test_report.txt")
+
+
+def run_tpu_tests() -> None:
+    """The tunnel just yielded a measurement, so it is healthy RIGHT NOW —
+    the only known-good moment to put the pallas kernels through the real
+    Mosaic lowering. Records the full pytest output (green or the lowering
+    failure — either is evidence) to tpu_test_report.txt."""
+    if os.path.exists(REPORT):
+        return
+    print("[prober] tunnel healthy — running tpu-marked tests", flush=True)
+    env = dict(os.environ)
+    env["RLT_TEST_ON_TPU"] = "1"
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/test_tpu.py", "-m", "tpu",
+             "-v", "--no-header"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=1800,
+        )
+    except subprocess.TimeoutExpired:
+        # the tunnel dropped mid-run: that is evidence about the TUNNEL,
+        # not the kernels — do NOT write the report, so the next healthy
+        # window retries instead of being blocked by a timeout stub
+        print("[prober] tpu test run timed out (tunnel dropped?); will "
+              "retry on the next healthy window", flush=True)
+        return
+    body = (proc.stdout or "") + (proc.stderr or "")
+    header = (f"# tpu-marked test run, rc={proc.returncode}, "
+              f"recorded {time.strftime('%Y-%m-%d %H:%M:%S %Z')}\n")
+    with open(REPORT, "w") as f:
+        f.write(header + body)
+    print(f"[prober] tpu test report written to {REPORT}", flush=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-hours", type=float, default=10.0)
@@ -52,6 +87,7 @@ def main() -> int:
     while time.time() < deadline:
         if cache_ok():
             print(f"[prober] on-chip measurement cached at {CACHE}; done")
+            run_tpu_tests()
             return 0
         attempt += 1
         print(f"[prober] attempt {attempt}: python bench.py --platform native",
@@ -71,6 +107,7 @@ def main() -> int:
             print("[prober] attempt wall-timeout (3600s)", flush=True)
         if cache_ok():
             print("[prober] success — measurement persisted")
+            run_tpu_tests()
             return 0
         print(f"[prober] sleeping {sleep:.0f}s", flush=True)
         time.sleep(sleep)
