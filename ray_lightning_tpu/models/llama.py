@@ -190,7 +190,12 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
     if cfg.n_experts:
         from ray_lightning_tpu.parallel.moe import moe_param_specs
 
-        layer_specs["moe"] = moe_param_specs(n_layers=cfg.n_layers)
+        # the moe leaves share the dense layers' leading stacked-layer
+        # entry ('pp': contiguous layer blocks per pipeline stage)
+        layer_specs["moe"] = {
+            k: P("pp", *list(s)[1:])
+            for k, s in moe_param_specs(n_layers=cfg.n_layers).items()
+        }
     else:
         layer_specs.update(
             w_gate=P("pp", "fsdp", "tp"),
@@ -245,7 +250,7 @@ def _act_constraint(x, mesh: Optional[Mesh], *entries):
 
 def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
                    input_fn=None, return_kv: bool = False,
-                   moe_lossless: bool = False):
+                   moe_lossless: bool = False, moe_fn=None):
     """One transformer block (pre-norm attention + gated MLP / MoE) shared
     by the scanned dense path and the pipeline stage path — the math must
     stay identical between them.
@@ -285,6 +290,11 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
         if moe_lossless:  # inference: no-drop routing, no dispatch tensors
             moe_out = moe_ffn_lossless(lp["moe"], h2, top_k=cfg.expert_top_k)
             aux = jnp.float32(0.0)
+        elif moe_fn is not None:
+            # pipeline stages inside shard_map pass an explicit impl
+            # (moe_ffn_local_experts over the 'ep' axis — GSPMD cannot
+            # partition the dispatch einsums for us there)
+            moe_out, aux = moe_fn(lp["moe"], h2)
         else:
             moe_out, aux = moe_ffn(
                 lp["moe"], h2, top_k=cfg.expert_top_k,
@@ -302,12 +312,23 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
 
 def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
                     seq_len: int, tp: int = 1, schedule: str = "gpipe",
-                    sp: int = 1):
+                    sp: int = 1, fsdp: int = 1):
     """Shared pipeline-stage plumbing for both pp schedules: the per-stage
     scan over a contiguous layer block (tp-aware via the psum reduce_fn,
-    sp-aware via in-stage ring attention), the [pp, L/pp, ...] stage
-    stacking, microbatch count, and the data spec (batch over 'dp',
-    sequence over 'sp'). The two schedules must never drift apart on this.
+    sp-aware via in-stage ring attention, fsdp-aware via just-in-time
+    per-layer all-gather), the [pp, L/pp, ...] stage stacking, microbatch
+    count, the data spec (batch over 'dp' and 'fsdp', sequence over 'sp'),
+    and the stage param spec. The two schedules must never drift on this.
+
+    fsdp > 1 is ZeRO-3-IN-STAGE: each stage's weights shard over the
+    'fsdp' axis at rest (the memory story for 8B-scale on small slices —
+    per-chip weights are O(params / (pp * fsdp))); inside the per-stage
+    layer scan each layer is ``all_gather``ed over 'fsdp' just before use,
+    so peak weight memory is one full layer + the sharded rest. The
+    gather's autodiff transpose is a reduce-scatter that both SUMS layer
+    grads across fsdp members (whose batch shards differ — 'fsdp' is also
+    a data axis) and re-shards them: exactly ZeRO-3 semantics, emitted by
+    XLA as collectives over ICI.
 
     tp collectives differ by schedule: GPipe differentiates the whole
     shard_map with autodiff, which handles a plain ``lax.psum``; 1F1B takes
@@ -316,6 +337,7 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
     custom-VJP pair instead (parallel/pipeline_1f1b.py). sp's ppermutes
     are bijections (transpose = reverse rotation), safe under both."""
     pp = mesh.shape["pp"]
+    ep = mesh.shape["ep"] if "ep" in mesh.axis_names else 1
     L = cfg.n_layers
     if L % pp != 0:
         raise ValueError(f"n_layers={L} must divide into pp={pp} stages")
@@ -326,6 +348,16 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
         )
     if sp > 1 and seq_len % sp:
         raise ValueError(f"sp={sp} must divide sequence length {seq_len}")
+    if cfg.n_experts:
+        if tp > 1 or fsdp > 1:
+            raise NotImplementedError(
+                "MoE pipeline stages compose with dp/ep for now; drop the "
+                f"tp/fsdp axes (mesh has tp={tp}, fsdp={fsdp})"
+            )
+        if ep > 1 and cfg.n_experts % ep:
+            raise ValueError(
+                f"ep={ep} must divide n_experts={cfg.n_experts}"
+            )
     hd = cfg.head_dim
 
     def stage_fn(stage_layers, xb):
@@ -359,7 +391,11 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
             )
 
             def attn_fn(q, k, v):
-                return ring_attention_local(q, k, v, axis="sp", sp=sp)
+                return ring_attention_local(
+                    q, k, v, axis="sp", sp=sp, impl=cfg.attn_impl,
+                    block_q=cfg.flash_block_q or None,
+                    block_k=cfg.flash_block_k or None,
+                )
         else:
             def attn_fn(q, k, v):
                 return attention(
@@ -368,27 +404,85 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
                     block_k=cfg.flash_block_k or None,
                 )
 
+        moe_fn = None
+        if cfg.n_experts:
+            from ray_lightning_tpu.parallel.moe import (
+                moe_ffn,
+                moe_ffn_local_experts,
+            )
+
+            if ep > 1:
+                # GSPMD can't partition einsums inside shard_map: expert
+                # parallelism is explicit here — full-router routing, local
+                # expert shard, psum over 'ep'
+                def moe_fn(p, h):
+                    return moe_ffn_local_experts(
+                        p, h, axis="ep", top_k=cfg.expert_top_k,
+                        capacity_factor=cfg.capacity_factor,
+                    )
+            else:
+                def moe_fn(p, h):
+                    return moe_ffn(
+                        p, h, top_k=cfg.expert_top_k,
+                        capacity_factor=cfg.capacity_factor,
+                    )
+
         def layer_fn(x, lp):
-            x, _ = _decoder_layer(x, lp, cfg, cos, sin, attn_fn, reduce_fn,
-                                  input_fn)
-            return x, None
+            if fsdp > 1:
+                # ZeRO-3 gather: reconstruct this layer's full weights from
+                # the fsdp shards just before use (under jax.checkpoint the
+                # backward re-gathers — the standard FSDP+remat trade)
+                lp = jax.tree_util.tree_map(
+                    lambda p, dim: p if dim < 0 else jax.lax.all_gather(
+                        p, "fsdp", axis=dim, tiled=True
+                    ),
+                    lp, fsdp_dims,
+                )
+            x, aux = _decoder_layer(x, lp, cfg, cos, sin, attn_fn, reduce_fn,
+                                    input_fn, moe_fn=moe_fn)
+            return x, aux
 
         fn = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
-        out, _ = jax.lax.scan(fn, xb, stage_layers)
+        out, auxs = jax.lax.scan(fn, xb, stage_layers)
+        if cfg.n_experts:
+            # per-stage aux = mean over this stage's layers; the pipeline
+            # schedule averages over (stage, microbatch) to match the dense
+            # path's jnp.mean over all layers
+            return out, jnp.mean(auxs)
         return out
 
     # [L, ...] -> [pp, L/pp, ...]: one contiguous block of layers per stage
     stage_params = jax.tree_util.tree_map(
         lambda p: p.reshape(pp, L // pp, *p.shape[1:]), params["layers"]
     )
+    if fsdp > 1:
+        stage_spec, fsdp_dims = _stage_specs_with_fsdp(
+            cfg, params["layers"], fsdp, with_tp=tp > 1
+        )
+    elif tp > 1 or (cfg.n_experts and ep > 1):
+        stage_spec, fsdp_dims = _stage_param_specs(cfg), None
+    else:
+        stage_spec, fsdp_dims = None, None
+    if stage_spec is not None:
+        # specs name every axis the layout CAN use; keep only those this
+        # mesh actually has (a shard_map spec naming a missing axis errors)
+        stage_spec = jax.tree_util.tree_map(
+            lambda s: _filter_spec(s, mesh), stage_spec,
+            is_leaf=lambda x: isinstance(x, P),
+        )
     m = cfg.pp_microbatches or pp
+    batch_axes = tuple(
+        a for a in ("dp", "fsdp")
+        if a in mesh.axis_names and mesh.shape[a] > 1
+    )
     batch_entry = (
-        "dp" if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else None
+        None if not batch_axes
+        else batch_axes[0] if len(batch_axes) == 1 else batch_axes
     )
     data_spec = P(batch_entry, "sp") if sp > 1 else (
         P(batch_entry) if batch_entry else P()
     )
-    return stage_fn, stage_params, m, data_spec
+    return stage_fn, stage_params, m, data_spec, stage_spec
 
 
 def _stage_param_specs(cfg: LlamaConfig):
@@ -401,9 +495,9 @@ def _stage_param_specs(cfg: LlamaConfig):
     def _to_stage_spec(spec: P) -> P:
         def keep(e):
             if isinstance(e, (tuple, list)):
-                kept = tuple(a for a in e if a in ("pp", "tp"))
+                kept = tuple(a for a in e if a in ("pp", "tp", "ep"))
                 return kept if kept else None
-            return e if e in ("pp", "tp") else None
+            return e if e in ("pp", "tp", "ep") else None
 
         entries = [keep(e) for e in spec]
         return P(entries[0], None, *entries[1:])
@@ -412,6 +506,55 @@ def _stage_param_specs(cfg: LlamaConfig):
         _to_stage_spec, param_specs(cfg)["layers"],
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+def _stage_specs_with_fsdp(cfg: LlamaConfig, layer_params: Dict[str, Any],
+                           fsdp: int, with_tp: bool):
+    """Stage param specs that ALSO keep param_specs' 'fsdp' entries (the
+    megatron layout is the single source of truth for which dim is
+    fsdp-shardable), plus the per-leaf gather dim the in-stage ZeRO-3
+    all-gather needs. Returns (spec_tree, dims_tree) where dims index the
+    SCANNED per-layer leaf (stage leaf minus the [pp, layer] dims); -1 =
+    leaf replicated within fsdp (norms; dims not divisible by fsdp — the
+    sentinel is an int, not None, because None vanishes as a pytree)."""
+    keep_axes = ("pp", "tp") if with_tp else ("pp",)
+
+    def one(spec: P, p) -> tuple:
+        def keep(e, allow_fsdp):
+            if isinstance(e, (tuple, list)):
+                kept = tuple(
+                    a for a in e
+                    if a in keep_axes or (allow_fsdp and a == "fsdp")
+                )
+                return kept if kept else None
+            ok = e in keep_axes or (allow_fsdp and e == "fsdp")
+            return e if ok else None
+
+        rest_shape = p.shape[1:]  # per-layer dims
+        entries = [keep(e, allow_fsdp=False) for e in spec]
+        dim = -1
+        for j, e in enumerate(spec):
+            if j == 0:
+                continue  # the layer dim becomes [pp, L/pp]
+            has_fsdp = e == "fsdp" or (
+                isinstance(e, (tuple, list)) and "fsdp" in e
+            )
+            # shard_map needs even shards; a non-divisible dim stays
+            # replicated within fsdp (same rule as fsdp_param_shardings)
+            if has_fsdp and rest_shape[j - 1] % fsdp == 0:
+                entries[j] = keep(e, allow_fsdp=True)
+                dim = j - 1
+                break
+        return P(entries[0], None, *entries[1:]), dim
+
+    pairs = jax.tree_util.tree_map(
+        one, param_specs(cfg)["layers"], layer_params,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    is_pair = lambda x: isinstance(x, tuple) and len(x) == 2 and isinstance(x[0], P)
+    specs = jax.tree_util.tree_map(lambda t: t[0], pairs, is_leaf=is_pair)
+    dims = jax.tree_util.tree_map(lambda t: t[1], pairs, is_leaf=is_pair)
+    return specs, dims
 
 
 def _forward_pp(
@@ -425,36 +568,30 @@ def _forward_pp(
     replicated outside the pipeline. Composes with 'dp' (each dp group runs
     its own pipeline on its batch shard), 'tp' (megatron layout inside each
     stage: heads/ffn column-sharded, explicit psum after the row-parallel
-    wo/w_down matmuls), and 'sp' (in-stage ring attention over local
-    sequence shards with global-position rope); fsdp inside a stage is
-    rejected loudly."""
+    wo/w_down matmuls), 'sp' (in-stage ring attention over local
+    sequence shards with global-position rope), 'fsdp' (ZeRO-3-in-stage:
+    stage weights sharded at rest, per-layer all-gather on use — see
+    _pp_stage_setup), and 'ep' for MoE configs (explicit expert
+    parallelism in stage via moe_ffn_local_experts; the aux loss rides
+    pipeline_apply's with_aux channel)."""
     from ray_lightning_tpu.parallel.pipeline import pipeline_apply
 
-    if cfg.n_experts:
-        raise NotImplementedError(
-            "pipeline parallelism with MoE layers is not supported yet; "
-            "use ep without pp (or dense layers with pp)"
-        )
-    if "fsdp" in mesh.axis_names and mesh.shape["fsdp"] > 1:
-        raise NotImplementedError(
-            f"pipeline parallelism composes with dp/tp/sp for now; mesh "
-            f"has fsdp={mesh.shape['fsdp']}. Drop the pp axis to use fsdp."
-        )
     tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
     sp = mesh.shape["sp"] if "sp" in mesh.axis_names else 1
+    fsdp = mesh.shape["fsdp"] if "fsdp" in mesh.axis_names else 1
     _, S = tokens.shape
     x = params["embed"][tokens]
-    stage_fn, stage_params, m, data_spec = _pp_stage_setup(
-        params, cfg, mesh, S, tp=tp, sp=sp
+    stage_fn, stage_params, m, data_spec, stage_spec = _pp_stage_setup(
+        params, cfg, mesh, S, tp=tp, sp=sp, fsdp=fsdp
     )
-    stage_spec = _stage_param_specs(cfg) if tp > 1 else None
-    x = pipeline_apply(
+    res = pipeline_apply(
         stage_fn, stage_params, x, mesh,
         axis="pp", num_microbatches=m, data_spec=data_spec,
-        param_spec=stage_spec,
+        param_spec=stage_spec, with_aux=bool(cfg.n_experts),
     )
+    x, aux = res if cfg.n_experts else (res, jnp.float32(0.0))
     x = rmsnorm(x, params["final_norm"])
-    return x @ params["lm_head"], jnp.float32(0.0)
+    return x @ params["lm_head"], aux
 
 
 def forward(
@@ -485,7 +622,12 @@ def forward(
 
     def attn_fn(q, k, v):
         if use_ring:
-            return ring_attention(q, k, v, mesh=mesh, axis="sp", causal=True)
+            return ring_attention(
+                q, k, v, mesh=mesh, axis="sp", causal=True,
+                impl=cfg.attn_impl,
+                block_q=cfg.flash_block_q or None,
+                block_k=cfg.flash_block_k or None,
+            )
         return attention(
             q, k, v, causal=True, impl=cfg.attn_impl,
             block_q=cfg.flash_block_q or None,
@@ -510,41 +652,60 @@ def _lm_loss_pp_1f1b(
     """1F1B-scheduled pipeline loss: the head + cross entropy run inside
     the last stage per microbatch so backward starts immediately
     (parallel/pipeline_1f1b.py). Logits are never materialized globally —
-    that is the memory point. Composes with dp and tp (megatron-in-stage,
+    that is the memory point. Composes with dp, tp (megatron-in-stage,
     same layout as the GPipe path; the schedule's manual VJP re-sums
-    in-stage psum cotangents over 'tp' correctly)."""
-    from ray_lightning_tpu.parallel.pipeline_1f1b import pipeline_1f1b_loss
+    in-stage psum cotangents over 'tp' correctly), and sp (in-stage ring
+    attention; the last stage sees a LOCAL sequence shard, so the
+    next-token mask zeroes only the final sp shard's last column and the
+    cross-shard loss reduction uses the g-operator — forward psum,
+    backward identity — to keep the manual VJP's cotangents unscaled)."""
+    from ray_lightning_tpu.parallel.pipeline_1f1b import (
+        pipeline_1f1b_loss,
+        psum_fwd_identity_bwd,
+    )
 
     if cfg.n_experts:
         raise NotImplementedError(
-            "pipeline parallelism with MoE layers is not supported yet"
+            "pipeline parallelism with MoE layers is not supported yet "
+            "under pp_schedule='1f1b'; use the gpipe schedule for pp x ep"
         )
-    for ax in ("fsdp", "sp"):
-        if ax in mesh.axis_names and mesh.shape[ax] > 1:
-            raise NotImplementedError(
-                f"pp_schedule='1f1b' composes with dp/tp only for now; mesh "
-                f"has {ax}={mesh.shape[ax]}. Drop the {ax} axis to use pp."
-            )
+    if "fsdp" in mesh.axis_names and mesh.shape["fsdp"] > 1:
+        raise NotImplementedError(
+            f"pp_schedule='1f1b' composes with dp/tp/sp for now; mesh has "
+            f"fsdp={mesh.shape['fsdp']}. Drop the fsdp axis to use pp."
+        )
     tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
+    sp = mesh.shape["sp"] if "sp" in mesh.axis_names else 1
     _, S = tokens.shape
     x = params["embed"][tokens]
     targets = jnp.roll(tokens, -1, axis=1)
-    stage_fn, stage_params, m, data_spec = _pp_stage_setup(
-        params, cfg, mesh, S, tp=tp, schedule="1f1b"
+    stage_fn, stage_params, m, data_spec, stage_spec = _pp_stage_setup(
+        params, cfg, mesh, S, tp=tp, schedule="1f1b", sp=sp
     )
 
     # NOTE: SPMD lockstep runs last_fn (head matmul + CE and its VJP) on
     # EVERY stage every tick with the result masked on non-last stages —
     # P-fold redundant head FLOPs, though wall-clock is gated by the
     # lockstep collectives either way. The per-tick logits are one
-    # [mb, S, V] microbatch (never the global [B, S, V]).
+    # [mb, S/sp, V] microbatch shard (never the global [B, S, V]).
     def last_fn(last_p, y, tgt):
         h = rmsnorm(y, last_p["final_norm"])
         logits = h @ last_p["lm_head"]
         losses = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), tgt
         )
-        mask = jnp.ones_like(losses).at[:, -1].set(0.0)
+        mask = jnp.ones_like(losses)
+        if sp > 1:
+            # only the GLOBAL last position is next-token-less; targets
+            # were rolled globally, so interior shard boundaries are valid
+            last_col = jnp.where(
+                jax.lax.axis_index("sp") == sp - 1, 0.0, 1.0
+            )
+            mask = mask.at[:, -1].set(last_col)
+            num = psum_fwd_identity_bwd(jnp.sum(losses * mask), "sp")
+            den = psum_fwd_identity_bwd(jnp.sum(mask), "sp")
+            return num / den
+        mask = mask.at[:, -1].set(0.0)
         return jnp.sum(losses * mask) / jnp.sum(mask)
 
     last_params = {
@@ -553,7 +714,8 @@ def _lm_loss_pp_1f1b(
     ce = pipeline_1f1b_loss(
         stage_fn, last_fn, stage_params, last_params, x, targets, mesh,
         axis="pp", num_microbatches=m, data_spec=data_spec,
-        param_spec=_stage_param_specs(cfg) if tp > 1 else None,
+        param_spec=stage_spec,
+        grad_reduce_axes=("sp",) if sp > 1 else (),
     )
     return ce, {"loss": ce, "ppl": jnp.exp(ce)}
 

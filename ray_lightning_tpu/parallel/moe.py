@@ -84,32 +84,18 @@ def moe_ffn_lossless(
     return out.reshape(b, s, d).astype(x.dtype)
 
 
-def moe_ffn(
-    params: Dict[str, Any],
-    x: jnp.ndarray,
-    top_k: int = 2,
-    capacity_factor: float = 1.5,
-    capacity: Optional[int] = None,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """x: [B, S, D] -> (out [B, S, D], aux_loss scalar).
+def _route(xt: jnp.ndarray, router: jnp.ndarray, top_k: int, capacity: int):
+    """Shared routing math: top-k selection, capacity-bounded queue
+    positions, dispatch/combine one-hots, and the load-balancing loss.
+    xt: [T, D] -> (disp [T, E, C], combine [T, E, C], aux scalar).
 
-    aux_loss is the Switch-Transformer load-balancing loss: n_experts x
-    sum_i(mean gate probability_i x raw pre-capacity assignment fraction_i).
-
-    ``capacity``: explicit per-expert slot count, overriding the
-    capacity_factor formula (exact integer bound — the float
-    capacity_factor math can round below an intended bound). Note:
-    generation does NOT use this; it routes through
-    :func:`moe_ffn_lossless`, which needs no dispatch tensors at all.
-    """
-    b, s, d = x.shape
-    e = params["router"].shape[-1]
-    t = b * s
-    xt = x.reshape(t, d)
-    if capacity is None:
-        capacity = max(1, int(capacity_factor * top_k * t / e))
-
-    logits = (xt.astype(jnp.float32) @ params["router"]).astype(jnp.float32)
+    aux is the Switch-Transformer loss: n_experts x sum_i(mean gate
+    probability_i x raw PRE-capacity assignment fraction_i) — the
+    capacity-truncated disp saturates for hot experts, under-penalizing
+    them exactly when balancing matters most."""
+    t = xt.shape[0]
+    e = router.shape[-1]
+    logits = (xt.astype(jnp.float32) @ router).astype(jnp.float32)
     gates = jax.nn.softmax(logits, axis=-1)  # [T, E]
 
     # top-k selection as dense one-hots
@@ -124,26 +110,90 @@ def moe_ffn(
     pos = jnp.cumsum(flat, axis=0) - flat  # slots used before each entry
     keep = (pos < capacity) * flat  # [K*T, E]
     pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
-    # dispatch [K*T, E, C]
-    disp_flat = keep[..., None] * pos_oh
-    disp = disp_flat.reshape(top_k, t, e, capacity).sum(axis=0)  # [T, E, C]
+    disp = (keep[..., None] * pos_oh).reshape(top_k, t, e, capacity).sum(axis=0)
     weights = (sel * top_vals[..., None]).sum(axis=1)  # [T, E] gate weights
     combine = disp * weights[:, :, None]  # [T, E, C]
 
-    # expert inputs [E, C, D] — the all-to-all happens here under GSPMD
-    expert_in = jnp.einsum("tec,td->ecd", disp, xt.astype(jnp.float32)).astype(x.dtype)
-    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_gate"])) * jnp.einsum(
-        "ecd,edf->ecf", expert_in, params["w_up"]
-    )
-    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_down"])  # [E, C, D]
-    out = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
-
-    # load-balancing auxiliary loss. f_i uses the raw router assignments
-    # (pre-capacity, Switch-Transformer style): the capacity-truncated disp
-    # saturates for hot experts, under-penalizing them exactly when
-    # balancing matters most.
     frac_tokens = jnp.mean(sel.sum(axis=1), axis=0)  # [E] assignment fraction
     frac_gates = jnp.mean(gates, axis=0)  # [E]
     aux = e * jnp.sum(frac_tokens * frac_gates) / top_k
+    return disp, combine, aux
 
+
+def _expert_ffn(disp, combine, xt, params) -> jnp.ndarray:
+    """Dispatch -> expert FFNs -> combine. disp/combine: [T, E', C] where
+    E' is however many experts ``params`` holds. Returns [T, D] fp32."""
+    expert_in = jnp.einsum(
+        "tec,td->ecd", disp, xt.astype(jnp.float32)
+    ).astype(params["w_gate"].dtype)
+    h = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", expert_in, params["w_gate"])
+    ) * jnp.einsum("ecd,edf->ecf", expert_in, params["w_up"])
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_down"])  # [E', C, D]
+    return jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
+
+
+def moe_ffn(
+    params: Dict[str, Any],
+    x: jnp.ndarray,
+    top_k: int = 2,
+    capacity_factor: float = 1.5,
+    capacity: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x: [B, S, D] -> (out [B, S, D], aux_loss scalar). GSPMD path: under
+    jit the [T, E, C] dispatch einsums against the ep-sharded weight stack
+    become all-to-alls over 'ep'.
+
+    ``capacity``: explicit per-expert slot count, overriding the
+    capacity_factor formula (exact integer bound — the float
+    capacity_factor math can round below an intended bound). Note:
+    generation does NOT use this; it routes through
+    :func:`moe_ffn_lossless`, which needs no dispatch tensors at all.
+    """
+    b, s, d = x.shape
+    e = params["router"].shape[-1]
+    t = b * s
+    xt = x.reshape(t, d)
+    if capacity is None:
+        capacity = max(1, int(capacity_factor * top_k * t / e))
+    disp, combine, aux = _route(xt, params["router"], top_k, capacity)
+    out = _expert_ffn(disp, combine, xt, params)
+    return out.reshape(b, s, d).astype(x.dtype), aux
+
+
+def moe_ffn_local_experts(
+    params: Dict[str, Any],
+    x: jnp.ndarray,
+    axis: str,
+    top_k: int = 2,
+    capacity_factor: float = 1.5,
+    capacity: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert parallelism for callers already INSIDE ``shard_map`` (pipeline
+    stages, models/llama.py::_pp_stage_setup) — where GSPMD cannot partition
+    the einsums for us: this member holds E/ep experts ([E_local, ...]
+    leaves, sharded over ``axis``) and the FULL (replicated) router.
+
+    Routing (gates, capacity positions, aux) runs over ALL E experts —
+    identical on every ep member, so top-k and capacity semantics match
+    :func:`moe_ffn` exactly; each member then slices the dispatch/combine
+    columns of its own experts, runs only those FFNs, and the final
+    ``psum`` over ``axis`` sums the per-expert contributions (each token's
+    output is a sum over its top-k experts, which live on different
+    members). aux needs no collective: it is computed from the full gate
+    matrix and is bitwise identical across the ep group.
+    """
+    b, s, d = x.shape
+    e = params["router"].shape[-1]
+    e_local = params["w_gate"].shape[0]
+    t = b * s
+    xt = x.reshape(t, d)
+    if capacity is None:
+        capacity = max(1, int(capacity_factor * top_k * t / e))
+    disp, combine, aux = _route(xt, params["router"], top_k, capacity)
+    start = jax.lax.axis_index(axis) * e_local
+    disp_l = jax.lax.dynamic_slice_in_dim(disp, start, e_local, axis=1)
+    comb_l = jax.lax.dynamic_slice_in_dim(combine, start, e_local, axis=1)
+    out = _expert_ffn(disp_l, comb_l, xt, params)
+    out = jax.lax.psum(out, axis)
     return out.reshape(b, s, d).astype(x.dtype), aux

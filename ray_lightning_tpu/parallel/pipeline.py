@@ -58,6 +58,7 @@ def pipeline_apply(
     num_microbatches: int = 2,
     data_spec: P = P(),
     param_spec: Any = None,
+    with_aux: bool = False,
 ) -> jnp.ndarray:
     """Run ``stage_fn`` sequentially across the 'pp' stages.
 
@@ -75,6 +76,13 @@ def pipeline_apply(
     tp-local weight shards and is responsible for the in-stage collectives
     (``psum`` over 'tp' after row-parallel matmuls). Default: each leaf is
     ``P(axis)`` (stage weights replicated within a stage).
+
+    ``with_aux``: stage_fn returns ``(activations, aux_scalar)`` and
+    pipeline_apply returns ``(outputs, aux)`` where aux is the mean of the
+    per-(stage, microbatch) scalars — inactive schedule ticks are masked
+    out, the stage sum rides a psum over ``axis``, and the result is
+    pmean'd over the data axes so every device returns the global mean
+    (MoE load-balancing losses through the pipeline).
     """
     pp = mesh.shape[axis]
     m = num_microbatches
@@ -99,7 +107,7 @@ def pipeline_apply(
         shard_map,
         mesh=mesh,
         in_specs=(param_spec, data_spec),
-        out_specs=data_spec,
+        out_specs=(data_spec, P()) if with_aux else data_spec,
         check_rep=False,
     )
     def _pipe(params_local, x_full):
@@ -110,7 +118,7 @@ def pipeline_apply(
         perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
 
         def tick(t, carry):
-            recv, outputs = carry
+            recv, outputs, aux_acc = carry
             mb_idx = t - stage
             active = (mb_idx >= 0) & (mb_idx < m)
             # stage 0 reads its microbatch; later stages read what arrived
@@ -119,8 +127,14 @@ def pipeline_apply(
                 micro[jnp.clip(mb_idx, 0, m - 1)],
                 recv,
             )
-            out = stage_fn(params_here, inp)
+            res = stage_fn(params_here, inp)
+            out, aux = res if with_aux else (res, None)
             out = jnp.where(active, out, jnp.zeros_like(out))
+            if with_aux:
+                # inactive ticks ran on garbage (zeros) input — mask them
+                aux_acc = aux_acc + jnp.where(
+                    active, aux.astype(jnp.float32), 0.0
+                )
             # the last stage records finished microbatches
             done_idx = jnp.clip(mb_idx, 0, m - 1)
             record = active & (stage == pp - 1)
@@ -133,17 +147,32 @@ def pipeline_apply(
             )
             # pass activations forward around the ring
             recv = jax.lax.ppermute(out, axis, perm_fwd)
-            return recv, outputs
+            return recv, outputs, aux_acc
 
         recv0 = jnp.zeros((mb, *x_full.shape[1:]), x_full.dtype)
-        out_shape = jax.eval_shape(stage_fn, params_here, recv0)
+        shapes = jax.eval_shape(stage_fn, params_here, recv0)
+        out_shape = shapes[0] if with_aux else shapes
         outputs0 = jnp.zeros((m, *out_shape.shape), out_shape.dtype)
-        _, outputs = jax.lax.fori_loop(0, pp + m - 1, tick, (recv0, outputs0))
+        _, outputs, aux_acc = jax.lax.fori_loop(
+            0, pp + m - 1, tick, (recv0, outputs0, jnp.float32(0.0))
+        )
         # only the last stage holds real outputs; broadcast around the ring
         outputs = jax.lax.psum(
             jnp.where(stage == pp - 1, outputs, jnp.zeros_like(outputs)), axis
         )
-        return outputs.reshape(m * mb, *out_shape.shape[1:])
+        acts = outputs.reshape(m * mb, *out_shape.shape[1:])
+        if not with_aux:
+            return acts
+        # mean over the pp * m (stage, microbatch) cells, then over the
+        # data axes so the scalar really is replicated (out_spec P())
+        aux = jax.lax.psum(aux_acc, axis) / (pp * m)
+        reduce_axes = tuple(
+            a for e in data_spec for a in
+            ((e,) if isinstance(e, str) else tuple(e or ()))
+        )
+        if reduce_axes:
+            aux = jax.lax.pmean(aux, reduce_axes)
+        return acts, aux
 
     return _pipe(stage_params, x)
 

@@ -98,6 +98,7 @@ def pipeline_1f1b_loss(
     num_microbatches: int = 2,
     data_spec: P = P(),
     param_spec: Any = None,
+    grad_reduce_axes: tuple = (),
 ) -> jnp.ndarray:
     """Mean-over-microbatches scalar loss of a 1F1B-scheduled pipeline.
 
@@ -115,6 +116,15 @@ def pipeline_1f1b_loss(
     activations enter column-parallel matmuls. A plain ``lax.psum`` yields
     tp-size-scaled weight gradients under this schedule's manual VJP
     (tested). Default: stage weights replicated within a stage.
+
+    ``grad_reduce_axes``: mesh axes over which activations are sharded but
+    stage/head weights are REPLICATED (sequence parallelism's 'sp'): each
+    member's manual VJP yields only its shard's weight-grad contribution,
+    so d_params/d_last are psum'd over these axes after the schedule. A
+    loss that spans such an axis must do its own cross-shard reduction
+    with :func:`psum_fwd_identity_bwd` (forward psum, backward identity) —
+    a plain ``lax.psum`` in ``last_fn`` would double cotangents under
+    ``jax.vjp`` exactly like the tp case above.
     """
     m = num_microbatches
     local_batch(x, data_spec, mesh, m)  # divisibility validation
@@ -126,7 +136,8 @@ def pipeline_1f1b_loss(
                 raise ValueError(
                     f"param_spec leaves must lead with {axis!r}; got {leaf}"
                 )
-    closure = _Closure(stage_fn, last_fn, mesh, axis, m, data_spec, param_spec)
+    closure = _Closure(stage_fn, last_fn, mesh, axis, m, data_spec, param_spec,
+                       grad_reduce_axes)
     return closure(stage_params, last_params, x, targets)
 
 
@@ -135,7 +146,7 @@ class _Closure:
     pieces (functions, mesh, schedule constants) live here."""
 
     def __init__(self, stage_fn, last_fn, mesh, axis, m, data_spec,
-                 param_spec=None):
+                 param_spec=None, grad_reduce_axes=()):
         self.stage_fn = stage_fn
         self.last_fn = last_fn
         self.mesh = mesh
@@ -143,6 +154,7 @@ class _Closure:
         self.m = m
         self.data_spec = data_spec
         self.param_spec = param_spec
+        self.grad_reduce_axes = tuple(grad_reduce_axes)
 
         @jax.custom_vjp
         def run(stage_params, last_params, x, targets):
@@ -337,17 +349,25 @@ class _Closure:
             # groups (each saw 1/ndata of the global batch)
             loss = jax.lax.psum(loss_sum, axis) * inv_m
             loss = _mean_over_data(loss, self.mesh, data_spec)
+
+            def _reduce_shards(a):
+                # weight grads over activation-sharded axes (sp): each
+                # member contributed only its shard — SUM, don't average
+                for ax in self.grad_reduce_axes:
+                    a = jax.lax.psum(a, ax)
+                return a
+
             d_params = jax.tree_util.tree_map(
-                lambda a: _mean_over_data(a * inv_m, self.mesh, data_spec)[
-                    None
-                ],
+                lambda a: _mean_over_data(
+                    _reduce_shards(a) * inv_m, self.mesh, data_spec
+                )[None],
                 d_params,
             )
             d_last = jax.tree_util.tree_map(
                 lambda a: _mean_over_data(
-                    jax.lax.psum(
+                    _reduce_shards(jax.lax.psum(
                         jnp.where(stage == pp - 1, a, jnp.zeros_like(a)), axis
-                    ) * inv_m,
+                    )) * inv_m,
                     self.mesh, data_spec,
                 ),
                 d_last,

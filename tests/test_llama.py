@@ -286,21 +286,155 @@ def test_train_pp_1f1b_mesh(tmp_root):
     assert np.isfinite(float(trainer.callback_metrics["val_loss"]))
 
 
-def test_pp_rejects_unsupported_combos():
+def test_pp_fsdp_forward_matches_dense():
+    """Pipeline x ZeRO-3-in-stage: stage weights sharded over 'fsdp' with
+    per-layer all-gather on use must be numerically identical to the plain
+    scanned forward, and the gather's reduce-scatter transpose must
+    produce the same gradients (fsdp is also a data axis here, so a
+    missing cross-member grad sum would show immediately)."""
+    import dataclasses
+
     from ray_lightning_tpu.models.llama import forward, init_params
 
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
     mesh = build_mesh(MeshSpec(axes={"pp": 2, "fsdp": 2, "dp": 2}))
-    cfg = LlamaConfig.tiny()
     params = init_params(jax.random.key(0), cfg)
-    tokens = jnp.zeros((4, cfg.max_seq), jnp.int32)
-    with pytest.raises(NotImplementedError, match="fsdp"):
-        forward(params, tokens, cfg, mesh)
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(0, cfg.vocab_size, (8, cfg.max_seq)),
+        jnp.int32,
+    )
+    ref, _ = jax.jit(lambda p, t: forward(p, t, cfg))(params, tokens)
+    piped, _ = jax.jit(lambda p, t: forward(p, t, cfg, mesh))(params, tokens)
+    err = float(jnp.max(jnp.abs(ref - piped)))
+    assert err < 1e-4, err
 
-    moe_cfg = LlamaConfig.tiny_moe()
+    def loss(fn_mesh):
+        def f(p):
+            logits, _ = forward(p, tokens, cfg, fn_mesh)
+            return (logits.astype(jnp.float32) ** 2).mean()
+        return f
+
+    g_ref = jax.jit(jax.grad(loss(None)))(params)
+    g_pp = jax.jit(jax.grad(loss(mesh)))(params)
+    for name in ("wq", "wo", "w_down", "attn_norm"):
+        a, b = g_ref["layers"][name], g_pp["layers"][name]
+        gerr = float(jnp.max(jnp.abs(a - b)))
+        scale = float(jnp.max(jnp.abs(a))) + 1e-12
+        assert gerr < 1e-5 + 1e-3 * scale, (name, gerr, scale)
+
+
+def test_train_pp_fsdp_mesh(tmp_root):
+    """Full train step through the Trainer on pp=2 x fsdp=2 x dp=2 — the
+    8B-on-small-slices memory recipe (VERDICT r2 weak #4)."""
+    cfg = LlamaConfig.tiny()
+    strategy = rlt.XLAStrategy(
+        mesh_spec=MeshSpec(axes={"pp": 2, "fsdp": 2, "dp": 2}),
+        sharding_policy=ShardingPolicy(
+            zero_stage=3, data_axes=("dp", "fsdp"), shard_axes=("fsdp",),
+            min_shard_size=0,
+        ),
+    )
+    module = LlamaModule(cfg, lr=3e-3, warmup_steps=2, total_steps=50)
+    dm = SyntheticLMDataModule(cfg, batch_size=8, n_train=32)
+    trainer = get_trainer(tmp_root, max_epochs=1, strategy=strategy,
+                          limit_train_batches=None, checkpoint_callback=False)
+    trainer.fit(module, datamodule=dm)
+    assert "val_loss" in trainer.callback_metrics
+    assert np.isfinite(float(trainer.callback_metrics["val_loss"]))
+
+
+def test_pp_ep_forward_matches_dense():
+    """Pipeline x expert parallelism: in-stage MoE with experts sharded
+    over 'ep' (full-router routing, local expert FFNs, psum combine) must
+    match the dense GSPMD forward. capacity_factor is set high enough
+    that capacity never binds — the dense path computes capacity from the
+    full batch, the pipeline from a microbatch, so only the no-drop
+    regime is exactly comparable."""
+    import dataclasses
+
+    from ray_lightning_tpu.models.llama import forward, init_params
+
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny_moe(), dtype=jnp.float32, capacity_factor=4.0,
+    )
+    mesh = build_mesh(MeshSpec(axes={"pp": 2, "ep": 2, "dp": 2}))
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(0, cfg.vocab_size, (8, cfg.max_seq)),
+        jnp.int32,
+    )
+    ref, aux_ref = jax.jit(lambda p, t: forward(p, t, cfg))(params, tokens)
+    piped, aux_pp = jax.jit(lambda p, t: forward(p, t, cfg, mesh))(params, tokens)
+    err = float(jnp.max(jnp.abs(ref - piped)))
+    assert err < 1e-4, err
+    # aux is a mean of per-microbatch estimates (bilinear in per-batch
+    # means, so not bitwise equal to the full-batch value) — same scale
+    assert abs(float(aux_ref) - float(aux_pp)) < 0.2 * abs(float(aux_ref))
+
+    def loss(fn_mesh):
+        def f(p):
+            logits, _ = forward(p, tokens, cfg, fn_mesh)
+            return (logits.astype(jnp.float32) ** 2).mean()
+        return f
+
+    g_ref = jax.jit(jax.grad(loss(None)))(params)
+    g_pp = jax.jit(jax.grad(loss(mesh)))(params)
+    for path in (("moe", "w_gate"), ("moe", "router"), ("wq",)):
+        a, b = g_ref["layers"], g_pp["layers"]
+        for k in path:
+            a, b = a[k], b[k]
+        gerr = float(jnp.max(jnp.abs(a - b)))
+        scale = float(jnp.max(jnp.abs(a))) + 1e-12
+        assert gerr < 1e-5 + 1e-3 * scale, (path, gerr, scale)
+
+
+def test_train_pp_ep_mesh(tmp_root, no_xla_cache):
+    """Full fit of the MoE flagship on pp=2 x ep=2 x dp=2 through the
+    Trainer — the aux loss survives the pipeline (with_aux channel)."""
+    cfg = LlamaConfig.tiny_moe()
+    strategy = rlt.XLAStrategy(
+        mesh_spec=MeshSpec(axes={"pp": 2, "ep": 2, "dp": 2}),
+        sharding_policy=ShardingPolicy(data_axes=("dp",)),
+    )
+    module = LlamaModule(cfg, lr=3e-3, warmup_steps=2, total_steps=50)
+    dm = SyntheticLMDataModule(cfg, batch_size=8, n_train=32)
+    trainer = get_trainer(tmp_root, max_epochs=1, strategy=strategy,
+                          limit_train_batches=None, checkpoint_callback=False)
+    trainer.fit(module, datamodule=dm)
+    assert "val_loss" in trainer.callback_metrics
+    assert np.isfinite(float(trainer.callback_metrics["val_loss"]))
+    assert "val_moe_aux" in trainer.callback_metrics
+
+
+def test_pp_rejects_unsupported_combos():
+    import dataclasses
+
+    from ray_lightning_tpu.models.llama import forward, init_params
+
+    # 1f1b has a manual VJP; its fsdp composition is still rejected loudly
+    mesh = build_mesh(MeshSpec(axes={"pp": 2, "fsdp": 2, "dp": 2}))
+    cfg = dataclasses.replace(LlamaConfig.tiny(), pp_schedule="1f1b")
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((8, cfg.max_seq), jnp.int32)
+    with pytest.raises(NotImplementedError, match="fsdp"):
+        from ray_lightning_tpu.models.llama import lm_loss
+
+        lm_loss(params, tokens, cfg, mesh)
+
+    # MoE under 1f1b is still rejected loudly
+    from ray_lightning_tpu.models.llama import lm_loss
+
+    moe_cfg = dataclasses.replace(LlamaConfig.tiny_moe(), pp_schedule="1f1b")
     moe_mesh = build_mesh(MeshSpec(axes={"pp": 2, "dp": 4}))
     moe_params = init_params(jax.random.key(0), moe_cfg)
     with pytest.raises(NotImplementedError, match="MoE"):
-        forward(moe_params, tokens, moe_cfg, moe_mesh)
+        lm_loss(moe_params, tokens, moe_cfg, moe_mesh)
+
+    # MoE pipeline stages don't compose with in-stage tp yet
+    moe_tp_mesh = build_mesh(MeshSpec(axes={"pp": 2, "tp": 2, "dp": 2}))
+    moe_gpipe = LlamaConfig.tiny_moe()
+    with pytest.raises(NotImplementedError, match="MoE"):
+        forward(moe_params, tokens, moe_gpipe, moe_tp_mesh)
 
     odd = LlamaConfig(vocab_size=64, dim=32, n_layers=3, n_heads=2,
                       n_kv_heads=2, ffn_dim=64, max_seq=32, remat=False)
@@ -399,6 +533,46 @@ def test_pp_sp_matches_dense_loss_and_grads():
     err = float(jnp.max(jnp.abs(g_ref["embed"] - g_pp["embed"])))
     scale = float(jnp.max(jnp.abs(g_ref["embed"]))) + 1e-12
     assert err < 1e-5 + 1e-3 * scale, ("embed", err)
+
+
+def test_pp_1f1b_sp_matches_dense_loss_and_grads():
+    """1F1B composed with sequence parallelism (pp=2 x sp=2 x dp=2): the
+    last stage computes the loss on a LOCAL sequence shard — the next-token
+    mask must zero only the final sp shard's last column and the
+    cross-shard reduction must use the g-operator (a plain psum would
+    double cotangents under the manual VJP); weight grads are psum'd over
+    sp (each member saw only its sequence shard). All of it must match the
+    dense path (VERDICT r2 weak #4 last composition)."""
+    import dataclasses
+
+    from ray_lightning_tpu.models.llama import init_params, lm_loss
+
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(), dtype=jnp.float32, pp_schedule="1f1b",
+        pp_microbatches=2,
+    )
+    mesh = build_mesh(MeshSpec(axes={"pp": 2, "sp": 2, "dp": 2}))
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(6).integers(0, cfg.vocab_size, (8, cfg.max_seq)),
+        jnp.int32,
+    )
+    dense = lambda p: lm_loss(p, tokens, cfg, None)[0]
+    piped = lambda p: lm_loss(p, tokens, cfg, mesh)[0]
+    l_ref = float(jax.jit(dense)(params))
+    l_pp = float(jax.jit(piped)(params))
+    assert abs(l_ref - l_pp) < 1e-4, (l_ref, l_pp)
+    g_ref = jax.jit(jax.grad(dense))(params)
+    g_pp = jax.jit(jax.grad(piped))(params)
+    for name in ("wq", "wk", "wo", "w_down"):
+        a, b = g_ref["layers"][name], g_pp["layers"][name]
+        err = float(jnp.max(jnp.abs(a - b)))
+        scale = float(jnp.max(jnp.abs(a))) + 1e-12
+        assert err < 1e-5 + 1e-3 * scale, (name, err, scale)
+    for name in ("embed", "lm_head", "final_norm"):
+        err = float(jnp.max(jnp.abs(g_ref[name] - g_pp[name])))
+        scale = float(jnp.max(jnp.abs(g_ref[name]))) + 1e-12
+        assert err < 1e-5 + 1e-3 * scale, (name, err)
 
 
 def test_train_pp_sp_mesh(tmp_root):
