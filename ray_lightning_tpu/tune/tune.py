@@ -252,6 +252,24 @@ def run(
         )
     max_concurrent_trials = min(max_concurrent_trials, len(trials)) or 1
 
+    # nested in-trial worker spawns (a trainable using RayStrategy or the
+    # runtime directly) initialize a PROCESS-LOCAL runtime inside the trial
+    # actor. When the caller declared bundle structure (a
+    # PlacementGroupFactory), cap that runtime's logical CPU capacity to
+    # the worker bundles (total minus the head bundle = the trial driver),
+    # so concurrent trials draw workers from their own reservations
+    # instead of each seeing the whole host — the bundle is enforced, not
+    # advisory. None / plain-dict demands have no head/worker structure
+    # and keep the historical behavior (nested runtime sizes itself); an
+    # explicit RLT_NUM_CPUS in trial_env always wins.
+    nested_cpus: Optional[float] = None
+    if isinstance(resources_per_trial, PlacementGroupFactory):
+        nested_cpus = max(
+            trial_demand.get("CPU", 1.0)
+            - resources_per_trial.bundles[0].get("CPU", 0.0),
+            0.0,
+        )
+
     def _demand_fits_now() -> bool:
         # the trial actor's reservation must land on ONE node — aggregate
         # availability across nodes is not placeable
@@ -295,10 +313,13 @@ def run(
                     "runs alone on the biggest node)"
                 )
             demand = {k: min(v, biggest.get(k, 0.0)) for k, v in demand.items()}
+        env = dict(trial_env or {})
+        if nested_cpus is not None:
+            env.setdefault("RLT_NUM_CPUS", str(nested_cpus))
         (trial._actor,) = rt.create_actors(
             [(_TrialRunner, (), {})],
             names=[f"tune-{name}-{trial.trial_id}-{time.monotonic_ns()}"],
-            env=trial_env,
+            env=env,
             demands=[demand],
         )
         trial._future = trial._actor.run.remote(
