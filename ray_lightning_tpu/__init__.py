@@ -36,6 +36,7 @@ from ray_lightning_tpu.strategies.ray_strategies import (
     HorovodRayStrategy,
     RayShardedStrategy,
 )
+from ray_lightning_tpu import interop
 
 __version__ = "0.1.0"
 
@@ -67,4 +68,5 @@ __all__ = [
     "RayTPUStrategy",
     "HorovodRayStrategy",
     "RayShardedStrategy",
+    "interop",
 ]

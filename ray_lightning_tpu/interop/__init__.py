@@ -1,0 +1,20 @@
+"""Torch interop: run existing torch ``pl.LightningModule``s distributed
+on TPU by compiling their forward graph to JAX (torch.fx) and mapping
+their optimizer/criterion configuration to optax."""
+from ray_lightning_tpu.interop.torch_bridge import (
+    TORCH_AVAILABLE,
+    TorchModuleAdapter,
+    UnsupportedTorchOp,
+    adapt_torch_module,
+    torch_loss_to_jax,
+    torch_optimizer_to_optax,
+)
+
+__all__ = [
+    "TORCH_AVAILABLE",
+    "TorchModuleAdapter",
+    "UnsupportedTorchOp",
+    "adapt_torch_module",
+    "torch_loss_to_jax",
+    "torch_optimizer_to_optax",
+]
