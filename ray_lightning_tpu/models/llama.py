@@ -654,11 +654,15 @@ def _lm_loss_pp_1f1b(
     (parallel/pipeline_1f1b.py). Logits are never materialized globally —
     that is the memory point. Composes with dp, tp (megatron-in-stage,
     same layout as the GPipe path; the schedule's manual VJP re-sums
-    in-stage psum cotangents over 'tp' correctly), and sp (in-stage ring
+    in-stage psum cotangents over 'tp' correctly), sp (in-stage ring
     attention; the last stage sees a LOCAL sequence shard, so the
     next-token mask zeroes only the final sp shard's last column and the
     cross-shard loss reduction uses the g-operator — forward psum,
-    backward identity — to keep the manual VJP's cotangents unscaled)."""
+    backward identity — to keep the manual VJP's cotangents unscaled),
+    and fsdp (ZeRO-3-in-stage: the per-layer all_gather's transpose
+    already sums shard grads across fsdp, so the schedule's final
+    reduction psums each grad leaf only over batch axes its spec does
+    NOT mention — parallel/pipeline_1f1b.py::_reduce_grad)."""
     from ray_lightning_tpu.parallel.pipeline_1f1b import (
         pipeline_1f1b_loss,
         psum_fwd_identity_bwd,
@@ -669,18 +673,14 @@ def _lm_loss_pp_1f1b(
             "pipeline parallelism with MoE layers is not supported yet "
             "under pp_schedule='1f1b'; use the gpipe schedule for pp x ep"
         )
-    if "fsdp" in mesh.axis_names and mesh.shape["fsdp"] > 1:
-        raise NotImplementedError(
-            f"pp_schedule='1f1b' composes with dp/tp/sp for now; mesh has "
-            f"fsdp={mesh.shape['fsdp']}. Drop the fsdp axis to use pp."
-        )
     tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
     sp = mesh.shape["sp"] if "sp" in mesh.axis_names else 1
+    fsdp = mesh.shape["fsdp"] if "fsdp" in mesh.axis_names else 1
     _, S = tokens.shape
     x = params["embed"][tokens]
     targets = jnp.roll(tokens, -1, axis=1)
     stage_fn, stage_params, m, data_spec, stage_spec = _pp_stage_setup(
-        params, cfg, mesh, S, tp=tp, schedule="1f1b", sp=sp
+        params, cfg, mesh, S, tp=tp, schedule="1f1b", sp=sp, fsdp=fsdp
     )
 
     # NOTE: SPMD lockstep runs last_fn (head matmul + CE and its VJP) on

@@ -344,32 +344,46 @@ class _Closure:
 
             inv_m = 1.0 / m
             ndata = _data_axes_size(data_spec, self.mesh)
+            batch_axes = data_axes_of(data_spec)
             # loss / d_last live on the last stage, d_x on stage 0: select
             # and broadcast around the pp ring; grads average over data
             # groups (each saw 1/ndata of the global batch)
             loss = jax.lax.psum(loss_sum, axis) * inv_m
             loss = _mean_over_data(loss, self.mesh, data_spec)
 
-            def _reduce_shards(a):
-                # weight grads over activation-sharded axes (sp): each
-                # member contributed only its shard — SUM, don't average
+            def _reduce_grad(a, spec):
+                """Cross-member reduction for one weight-grad leaf.
+
+                - grad_reduce_axes (sp): weights replicated, activations
+                  sharded, no collective ties them — explicit SUM.
+                - batch axes (dp, and fsdp when it carries batch): a
+                  member's vjp yields d(its own loss term)/dw, so the
+                  global-mean-loss grad is the SUM over members / ndata.
+                  EXCEPT axes the leaf's spec mentions (ZeRO-3 leaves
+                  sharded over fsdp): there the in-body all_gather
+                  transposed to a psum_scatter that ALREADY summed across
+                  that axis — summing again would double-count.
+                """
                 for ax in self.grad_reduce_axes:
                     a = jax.lax.psum(a, ax)
-                return a
+                mentioned = set()
+                for e in spec:
+                    if isinstance(e, str):
+                        mentioned.add(e)
+                    elif isinstance(e, (tuple, list)):
+                        mentioned.update(e)
+                for ax in batch_axes:
+                    if ax not in mentioned:
+                        a = jax.lax.psum(a, ax)
+                return a * (inv_m / ndata)
 
             d_params = jax.tree_util.tree_map(
-                lambda a: _mean_over_data(
-                    _reduce_shards(a) * inv_m, self.mesh, data_spec
-                )[None],
-                d_params,
+                lambda a, s: _reduce_grad(a, s)[None], d_params, param_spec,
             )
             d_last = jax.tree_util.tree_map(
-                lambda a: _mean_over_data(
-                    _reduce_shards(jax.lax.psum(
-                        jnp.where(stage == pp - 1, a, jnp.zeros_like(a)), axis
-                    )) * inv_m,
-                    self.mesh, data_spec,
-                ),
+                lambda a: _reduce_grad(jax.lax.psum(
+                    jnp.where(stage == pp - 1, a, jnp.zeros_like(a)), axis
+                ), P()),
                 d_last,
             )
             # dx is per-data-shard (out_spec data_spec) but the loss is the
