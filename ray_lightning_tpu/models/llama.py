@@ -26,6 +26,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_lightning_tpu.core.module import LightningModule
 from ray_lightning_tpu.ops.attention import attention
+from ray_lightning_tpu.ops.losses import (
+    chunked_softmax_cross_entropy,
+    masked_softmax_cross_entropy,
+)
 from ray_lightning_tpu.ops.rmsnorm import rmsnorm
 from ray_lightning_tpu.ops.rope import apply_rope, rope_angles
 
@@ -53,6 +57,13 @@ class LlamaConfig:
     expert_top_k: int = 2
     capacity_factor: float = 1.5
     moe_aux_weight: float = 0.01
+    # sequence-chunked LM loss (ops/losses.py): 0/1 = monolithic logits;
+    # N>1 = CE computed over N sequence chunks under remat, so peak
+    # logits memory is O(B*(S/N)*V) instead of O(B*S*V) — the usual
+    # activation peak at large vocab. Ignored under pp (the 1f1b path
+    # already never materializes global logits) and sp (sequence is
+    # sharded; chunking would reshard).
+    loss_chunks: int = 0
     # microbatches when the mesh has a 'pp' axis (0 = one per stage)
     pp_microbatches: int = 0
     # "gpipe": differentiable fill-drain (composes with dp and tp);
@@ -599,14 +610,20 @@ def forward(
     tokens: jnp.ndarray,
     cfg: LlamaConfig,
     mesh: Optional[Mesh] = None,
-) -> jnp.ndarray:
-    """tokens [B, S] -> logits [B, S, V].
+    return_hidden: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """tokens [B, S] -> (logits [B, S, V], moe_aux scalar). With
+    ``return_hidden`` the first element is instead the final-norm hidden
+    states [B, S, D] — the chunked-loss path applies the head itself, one
+    sequence chunk at a time.
 
     Data axes: batch over ('dp','fsdp'); sequence over 'sp' (ring attention
     handles cross-shard attention when the mesh has sp>1); layers over 'pp'
     (GPipe schedule) when the mesh has pipeline stages.
     """
     if mesh is not None and "pp" in mesh.axis_names and mesh.shape["pp"] > 1:
+        if return_hidden:
+            raise ValueError("return_hidden is not supported on the pp path")
         return _forward_pp(params, tokens, cfg, mesh)
     B, S = tokens.shape
     hd = cfg.head_dim
@@ -642,6 +659,8 @@ def forward(
     scanned = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
     x, aux_losses = jax.lax.scan(scanned, x, params["layers"])
     x = rmsnorm(x, params["final_norm"])
+    if return_hidden:
+        return x, jnp.mean(aux_losses)
     logits = x @ params["lm_head"]
     return logits, jnp.mean(aux_losses)
 
@@ -737,13 +756,26 @@ def lm_loss(
         and cfg.pp_schedule == "1f1b"
     ):
         return _lm_loss_pp_1f1b(params, tokens, cfg, mesh)
-    logits, moe_aux = forward(params, tokens, cfg, mesh)
     targets = jnp.roll(tokens, -1, axis=1)
-    losses = optax.softmax_cross_entropy_with_integer_labels(
-        logits.astype(jnp.float32), targets
+    mask = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
+    chunkable = cfg.loss_chunks > 1 and not (
+        mesh is not None and any(
+            ax in mesh.axis_names and mesh.shape[ax] > 1
+            for ax in ("pp", "sp")
+        )
     )
-    mask = jnp.ones_like(losses).at[:, -1].set(0.0)
-    ce = jnp.sum(losses * mask) / jnp.sum(mask)
+    if chunkable:
+        # never materialize [B, S, V]: CE over sequence chunks under
+        # remat (ops/losses.py) — the activation-memory peak at large
+        # vocab drops by the chunk count
+        h, moe_aux = forward(params, tokens, cfg, mesh, return_hidden=True)
+        total, count = chunked_softmax_cross_entropy(
+            h, params["lm_head"], targets, mask, cfg.loss_chunks
+        )
+    else:
+        logits, moe_aux = forward(params, tokens, cfg, mesh)
+        total, count = masked_softmax_cross_entropy(logits, targets, mask)
+    ce = total / count
     loss = ce + (cfg.moe_aux_weight * moe_aux if cfg.n_experts else 0.0)
     logs = {"loss": loss, "ppl": jnp.exp(ce)}
     if cfg.n_experts:

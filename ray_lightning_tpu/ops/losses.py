@@ -1,0 +1,71 @@
+"""Memory-lean LM losses.
+
+The naive decoder-LM loss materializes [B, S, V] fp32 logits (plus their
+cotangent in backward) — at llama3-8B shapes (V=128k, S=8k) that is tens
+of GiB per batch element, and it is usually the activation-memory peak of
+the whole train step. :func:`chunked_softmax_cross_entropy` computes the
+same loss over SEQUENCE chunks inside a ``lax.scan`` whose body is
+``jax.checkpoint``ed: forward keeps only the scalar partial sums, and
+backward rematerializes one chunk's logits at a time — peak logits
+memory drops from O(B*S*V) to O(B*(S/chunks)*V) exactly, with bitwise-
+matching loss values (the sum over chunks is the sum over positions).
+
+The head matmul stays a large MXU-shaped [B*C, D] x [D, V] contraction
+per chunk, so this trades a little recompute (the head matmul twice) for
+the dominant memory term — the standard large-vocab recipe. The
+reference has no training-loss surface of its own (it wraps user torch
+modules); this is native capability on the flagship family
+(models/llama.py::lm_loss, ``LlamaConfig.loss_chunks``).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def chunked_softmax_cross_entropy(
+    h: jnp.ndarray,
+    w: jnp.ndarray,
+    targets: jnp.ndarray,
+    mask: jnp.ndarray,
+    n_chunks: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Masked next-token CE without materializing full logits.
+
+    h: [B, S, D] final hidden states (post final-norm); w: [D, V] head;
+    targets/mask: [B, S]. Returns (sum of masked per-token losses, sum of
+    mask) — callers divide. S must divide by ``n_chunks``.
+    """
+    b, s, d = h.shape
+    if s % n_chunks:
+        raise ValueError(f"sequence {s} must divide into {n_chunks} chunks")
+    c = s // n_chunks
+    # [n, B, C, ...] scan layout
+    hc = h.reshape(b, n_chunks, c, d).swapaxes(0, 1)
+    tc = targets.reshape(b, n_chunks, c).swapaxes(0, 1)
+    mc = mask.reshape(b, n_chunks, c).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def body(carry, inp):
+        h_i, t_i, m_i = inp
+        logits = (h_i @ w).astype(jnp.float32)  # [B, C, V] — one chunk only
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, t_i)
+        return carry + jnp.sum(losses * m_i), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (hc, tc, mc))
+    return total, jnp.sum(mask)
+
+
+def masked_softmax_cross_entropy(
+    logits: jnp.ndarray,
+    targets: jnp.ndarray,
+    mask: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The monolithic reference path: full [B, S, V] logits in one shot."""
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets
+    )
+    return jnp.sum(losses * mask), jnp.sum(mask)

@@ -16,6 +16,7 @@ from ray_lightning_tpu.models.resnet import CIFARDataModule, ResNetClassifier
 from tests.utils import get_trainer
 
 
+@pytest.mark.slow
 def test_resnet_trains_and_batchstats_update(tmp_root):
     model = ResNetClassifier(arch="resnet18", lr=0.05)
     dm = CIFARDataModule(batch_size=16, n_train=128, n_val=64)
