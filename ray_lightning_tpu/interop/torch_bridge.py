@@ -29,13 +29,16 @@ The resulting :class:`TorchModuleAdapter` is a first-class
 pl.Trainer semantics on the outside, XLA on the inside.
 
 Scope (stated honestly): modules whose ``forward`` is fx-traceable over
-the supported op set below. Data-dependent Python control flow inside
-``forward``, custom autograd functions, or stateful layers (BatchNorm
-running stats) raise :class:`UnsupportedTorchOp` at ADAPT time — loudly,
-with the offending node named — never silently at train time. A custom
-``training_step`` body is not traced; its near-universal shape
-(forward -> criterion -> log) is what the adapter's step provides, and
-``step_fn=`` overrides it for anything else.
+the supported op set below — including BatchNorm1d/2d, whose running
+stats thread through the step as mutated collections (the Trainer's
+flax-batch_stats contract) and are masked out of the optimizer.
+Data-dependent Python control flow inside ``forward``, custom autograd
+functions, or unmapped layers raise :class:`UnsupportedTorchOp` at
+ADAPT time — loudly, with the offending node named — never silently at
+train time. A custom ``training_step`` body is not traced; its
+near-universal shape (forward -> criterion -> log) is what the
+adapter's step provides, and ``step_fn=`` overrides it for anything
+else.
 """
 from __future__ import annotations
 
@@ -129,10 +132,61 @@ def _dropout(x, p, rng):
     return jnp.where(keep, x / (1.0 - p), 0.0)
 
 
-def fx_to_jax(module) -> Tuple[Callable, Dict[str, jnp.ndarray]]:
+def _batch_norm(p, prefix, x, mod, train, updates):
+    """nn.BatchNorm1d/2d with running-stat threading. Train mode
+    normalizes with batch statistics and records the momentum-updated
+    running stats into ``updates`` (the adapter returns them as
+    ``mutated_params`` so the Trainer threads them like flax
+    batch_stats); eval mode normalizes with the imported running stats.
+    Matches torch: normalization uses the biased variance, the running
+    update uses the unbiased one."""
+    eps = mod.eps
+    momentum = mod.momentum  # None rejected at adapt time (_check_module)
+    reduce_axes = (0,) + tuple(range(2, x.ndim))
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    use_batch_stats = train or not mod.track_running_stats
+    if use_batch_stats:
+        mean = jnp.mean(x.astype(jnp.float32), axis=reduce_axes)
+        var = jnp.var(x.astype(jnp.float32), axis=reduce_axes)
+        if train and mod.track_running_stats:
+            n = x.size / mean.size
+            unbiased = var * (n / max(n - 1.0, 1.0))
+            mk, vk = f"{prefix}.running_mean", f"{prefix}.running_var"
+            # chain off this step's earlier update when the SAME module
+            # instance runs more than once per forward (torch applies the
+            # EMAs sequentially); stats accumulate in fp32 ALWAYS — under
+            # bf16-mixed the incoming view is bf16 but the Trainer writes
+            # mutated values back into the fp32 masters, and torch-side
+            # export needs fp32
+            rm = updates.get(mk, p[mk]).astype(jnp.float32)
+            rv = updates.get(vk, p[vk]).astype(jnp.float32)
+            updates[mk] = jax.lax.stop_gradient(
+                (1.0 - momentum) * rm + momentum * mean
+            )
+            updates[vk] = jax.lax.stop_gradient(
+                (1.0 - momentum) * rv + momentum * unbiased
+            )
+    else:
+        mean = p[f"{prefix}.running_mean"]
+        var = p[f"{prefix}.running_var"]
+    y = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + eps)
+    if mod.affine:
+        y = y * p[f"{prefix}.weight"].reshape(shape) + p[
+            f"{prefix}.bias"
+        ].reshape(shape)
+    return y.astype(x.dtype)
+
+
+def fx_to_jax(
+    module,
+) -> Tuple[Callable, Dict[str, jnp.ndarray], Tuple[str, ...]]:
     """Trace ``module.forward`` with torch.fx and build
-    ``apply(params, *inputs, dropout_rng=None)`` plus the initial param
-    pytree (state_dict keys/layouts preserved for lossless round-trip).
+    ``apply(params, *inputs, dropout_rng=None, train=False) ->
+    (out, state_updates)`` plus the initial param pytree and the
+    TRAINABLE key set (named_parameters; float buffers like BatchNorm
+    running stats live in the pytree too — state_dict keys/layouts
+    preserved for lossless round-trip — but must be masked out of the
+    optimizer; ``state_updates`` carries their forward-mutated values).
 
     Raises :class:`UnsupportedTorchOp` naming the first unmappable node.
     """
@@ -140,14 +194,27 @@ def fx_to_jax(module) -> Tuple[Callable, Dict[str, jnp.ndarray]]:
     modules = dict(gm.named_modules())
 
     params: Dict[str, jnp.ndarray] = {}
+    trainable = []
     for name, p in module.named_parameters():
         params[name] = jnp.asarray(_np(p))
-    buffers = {name: jnp.asarray(_np(b)) for name, b in module.named_buffers()}
+        trainable.append(name)
+    consts: Dict[str, jnp.ndarray] = {}
+    for name, b in module.named_buffers():
+        arr = _np(b)
+        if np.issubdtype(arr.dtype, np.floating):
+            # float buffers (running stats) thread through the step
+            params[name] = jnp.asarray(arr)
+        else:
+            # int buffers (num_batches_tracked) would break value_and_grad
+            # over the pytree; they stay static (torch side keeps its own)
+            consts[name] = jnp.asarray(arr)
 
-    def apply(p: Dict[str, jnp.ndarray], *inputs, dropout_rng=None):
+    def apply(p: Dict[str, jnp.ndarray], *inputs, dropout_rng=None,
+              train: bool = False):
         env: Dict[str, Any] = {}
         it = iter(inputs)
         rng = dropout_rng
+        updates: Dict[str, jnp.ndarray] = {}
 
         def look(a):
             if isinstance(a, torch.fx.Node):
@@ -163,14 +230,14 @@ def fx_to_jax(module) -> Tuple[Callable, Dict[str, jnp.ndarray]]:
                 env[node.name] = next(it)
             elif node.op == "get_attr":
                 target = str(node.target)
-                env[node.name] = p.get(target, buffers.get(target))
+                env[node.name] = p.get(target, consts.get(target))
                 if env[node.name] is None:
                     raise UnsupportedTorchOp(f"get_attr {target!r} not found")
             elif node.op == "call_module":
                 mod = modules[node.target]
                 x = look(node.args[0])
                 env[node.name] = _call_module(
-                    p, str(node.target), mod, x, rng
+                    p, str(node.target), mod, x, rng, train, updates
                 )
                 if isinstance(mod, nn.Dropout) and rng is not None:
                     rng, _ = jax.random.split(rng)
@@ -185,7 +252,7 @@ def fx_to_jax(module) -> Tuple[Callable, Dict[str, jnp.ndarray]]:
                     look(dict(node.kwargs)),
                 )
             elif node.op == "output":
-                return look(node.args[0])
+                return look(node.args[0]), updates
         raise AssertionError("fx graph had no output node")
 
     # eagerly validate the graph against the supported set: adapt-time
@@ -198,7 +265,7 @@ def fx_to_jax(module) -> Tuple[Callable, Dict[str, jnp.ndarray]]:
         elif node.op == "call_method":
             _check_method(node.target)
 
-    return apply, params
+    return apply, params, tuple(trainable)
 
 
 def _check_module(mod, name):
@@ -206,19 +273,31 @@ def _check_module(mod, name):
         nn.Linear, nn.ReLU, nn.GELU, nn.Tanh, nn.Sigmoid, nn.SiLU, nn.ELU,
         nn.LeakyReLU, nn.Softplus, nn.LayerNorm, nn.Embedding, nn.Dropout,
         nn.Flatten, nn.Identity, nn.Conv2d, nn.MaxPool2d, nn.AvgPool2d,
-        nn.Softmax, nn.LogSoftmax,
+        nn.Softmax, nn.LogSoftmax, nn.BatchNorm1d, nn.BatchNorm2d,
     )
     if not isinstance(mod, supported):
         raise UnsupportedTorchOp(
             f"layer {name!r} ({type(mod).__name__}) is not in the bridge's "
-            "supported set; stateful layers (BatchNorm) and custom modules "
-            "need a native rlt.LightningModule"
+            "supported set; custom modules need a native rlt.LightningModule"
+        )
+    if (
+        isinstance(mod, (nn.BatchNorm1d, nn.BatchNorm2d))
+        and mod.track_running_stats
+        and mod.momentum is None
+    ):
+        # torch's momentum=None means a CUMULATIVE moving average weighted
+        # by num_batches_tracked — different math, not silently a 0.1 EMA
+        raise UnsupportedTorchOp(
+            f"layer {name!r}: BatchNorm(momentum=None) uses a cumulative "
+            "moving average; set an explicit momentum"
         )
 
 
-def _call_module(p, prefix, mod, x, rng):
+def _call_module(p, prefix, mod, x, rng, train, updates):
     if isinstance(mod, nn.Linear):
         return _linear(p, prefix, x, mod.bias is not None)
+    if isinstance(mod, (nn.BatchNorm1d, nn.BatchNorm2d)):
+        return _batch_norm(p, prefix, x, mod, train, updates)
     if isinstance(mod, nn.LayerNorm):
         return _layer_norm(
             p, prefix, x, tuple(mod.normalized_shape), mod.eps,
@@ -546,7 +625,9 @@ class TorchModuleAdapter(LightningModule):
             raise RuntimeError("torch is not installed")
         super().__init__()
         self.torch_module = torch_module
-        self._apply_fn, self._initial_params = fx_to_jax(torch_module)
+        self._apply_fn, self._initial_params, self._trainable_keys = (
+            fx_to_jax(torch_module)
+        )
         criterion = (
             loss_fn
             or getattr(torch_module, "criterion", None)
@@ -573,8 +654,12 @@ class TorchModuleAdapter(LightningModule):
         # loaded checkpoint), not re-initialized
         return dict(self._initial_params)
 
-    def forward(self, params, *inputs, dropout_rng=None):
-        return self._apply_fn(params, *inputs, dropout_rng=dropout_rng)
+    def forward(self, params, *inputs, dropout_rng=None, train=False,
+                with_updates=False):
+        out, updates = self._apply_fn(
+            params, *inputs, dropout_rng=dropout_rng, train=train
+        )
+        return (out, updates) if with_updates else out
 
     @staticmethod
     def _split_batch(batch):
@@ -597,20 +682,28 @@ class TorchModuleAdapter(LightningModule):
         if self._step_fn is not None:
             return self._step_fn(self, params, batch)
         x, y = self._split_batch(batch)
-        out = self.forward(
-            params, x, dropout_rng=self.step_rng if train else None
+        out, updates = self.forward(
+            params, x, dropout_rng=self.step_rng if train else None,
+            train=train, with_updates=True,
         )
-        return self._loss(out, y), out
+        return self._loss(out, y), out, updates
 
     def training_step(self, params, batch, batch_idx):
         res = self._step(params, batch, train=True)
-        loss = res[0] if isinstance(res, tuple) else res
+        if not isinstance(res, tuple):
+            self.log("train_loss", res)
+            return res
+        loss, updates = res[0], (res[2] if len(res) > 2 else None)
         self.log("train_loss", loss)
+        if updates:
+            # batch-norm running stats: ride back as mutated collections
+            # (the Trainer takes these over the optimizer's no-op update)
+            return {"loss": loss, "mutated_params": updates}
         return loss
 
     def validation_step(self, params, batch, batch_idx):
         res = self._step(params, batch, train=False)
-        loss, out = res if isinstance(res, tuple) else (res, None)
+        loss, out = (res[0], res[1]) if isinstance(res, tuple) else (res, None)
         self.log("val_loss", loss)
         if out is not None and out.ndim >= 2 and jnp.issubdtype(
             jnp.asarray(self._split_batch(batch)[1]).dtype, jnp.integer
@@ -628,9 +721,17 @@ class TorchModuleAdapter(LightningModule):
         return self.forward(params, x)
 
     def configure_optimizers(self):
-        return torch_optimizer_to_optax(
+        tx = torch_optimizer_to_optax(
             self.torch_module, total_steps=self._total_steps
         )
+        if len(self._trainable_keys) != len(self._initial_params):
+            # float buffers (running stats) live in the pytree for
+            # threading/round-trip but must never be optimizer-updated
+            # (AdamW would weight-decay them)
+            trainable = set(self._trainable_keys)
+            mask = {k: k in trainable for k in self._initial_params}
+            tx = optax.masked(tx, mask)
+        return tx
 
     # -------------------------------------------------------------- #
     def export_to_torch(self):
@@ -638,10 +739,16 @@ class TorchModuleAdapter(LightningModule):
         keys/layouts were preserved) and return it."""
         if self.params is None:
             raise RuntimeError("no trained params yet; call fit() first")
-        state = {
-            k: torch.from_numpy(np.array(jax.device_get(v)))
-            for k, v in self.params.items()
-        }
+        def to_torch(v):
+            arr = np.array(jax.device_get(v))
+            if arr.dtype.name == "bfloat16":
+                # torch.from_numpy cannot take ml_dtypes arrays; go
+                # through fp32 (load_state_dict re-casts to the torch
+                # param's dtype on copy)
+                arr = arr.astype(np.float32)
+            return torch.from_numpy(arr)
+
+        state = {k: to_torch(v) for k, v in self.params.items()}
         missing, unexpected = self.torch_module.load_state_dict(
             state, strict=False
         )

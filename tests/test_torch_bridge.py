@@ -109,17 +109,111 @@ def test_optimizer_translation():
 
 
 def test_unsupported_layer_fails_at_adapt_time():
-    class WithBatchNorm(nn.Module):
+    class WithGRU(nn.Module):
         def __init__(self):
             super().__init__()
-            self.net = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1d(4))
+            self.rnn = nn.GRU(4, 4)
             self.criterion = nn.MSELoss()
 
         def forward(self, x):
-            return self.net(x)
+            return self.rnn(x)[0]
 
-    with pytest.raises(UnsupportedTorchOp, match="BatchNorm"):
-        adapt_torch_module(WithBatchNorm())
+    with pytest.raises(UnsupportedTorchOp, match="GRU"):
+        adapt_torch_module(WithGRU())
+
+    class CumulativeBN(nn.Module):
+        def __init__(self):
+            super().__init__()
+            # momentum=None is torch's CUMULATIVE moving average — a
+            # different update rule, rejected rather than silently 0.1
+            self.bn = nn.BatchNorm1d(4, momentum=None)
+            self.fc = nn.Linear(4, 2)
+            self.criterion = nn.MSELoss()
+
+        def forward(self, x):
+            return self.fc(self.bn(x))
+
+    with pytest.raises(UnsupportedTorchOp, match="momentum"):
+        adapt_torch_module(CumulativeBN())
+
+
+class TorchBNNet(nn.Module):
+    """CNN with BatchNorm — running stats must thread through training
+    (mutated_params) and stay out of the optimizer."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2d(1, 4, 3, padding=1)
+        self.bn = nn.BatchNorm2d(4)
+        self.fc = nn.Linear(4 * 8 * 8, 10)
+        self.criterion = nn.CrossEntropyLoss()
+
+    def forward(self, x):
+        x = torch.relu(self.bn(self.conv(x)))
+        return self.fc(torch.flatten(x, 1))
+
+    def configure_optimizers(self):
+        return torch.optim.AdamW(self.parameters(), lr=1e-2, weight_decay=0.1)
+
+
+def test_batchnorm_eval_parity_and_train_updates():
+    """Eval: imported running stats give torch-identical outputs. Train:
+    one adapter step updates the running stats exactly as torch does on
+    the same batch (biased batch var for normalization, unbiased for the
+    running update, momentum 0.1)."""
+    tm = TorchBNNet()
+    # make running stats non-trivial before the eval comparison
+    tm.train()
+    with torch.no_grad():
+        tm(torch.randn(16, 1, 8, 8))
+    tm.eval()
+    adapted = adapt_torch_module(tm)
+    params = adapted.init_params(jax.random.key(0))
+    x = np.random.default_rng(2).normal(size=(4, 1, 8, 8)).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x)).numpy()
+    out = np.asarray(adapted.forward(params, jnp.asarray(x)))
+    assert np.max(np.abs(ref - out)) < 1e-4
+
+    # one train-mode forward: compare the running-stat update to torch's
+    out_j, updates = adapted.forward(
+        params, jnp.asarray(x), train=True, with_updates=True
+    )
+    assert set(updates) == {"bn.running_mean", "bn.running_var"}
+    tm.train()
+    with torch.no_grad():
+        tm(torch.from_numpy(x))
+    for key, torch_val in (
+        ("bn.running_mean", tm.bn.running_mean),
+        ("bn.running_var", tm.bn.running_var),
+    ):
+        err = float(np.max(np.abs(np.asarray(updates[key]) - torch_val.numpy())))
+        assert err < 1e-5, (key, err)
+
+
+def test_batchnorm_trains_through_trainer(tmp_root):
+    """Fit a BN net end to end: running stats move (mutated_params path),
+    the optimizer never touches them (AdamW weight decay would shrink
+    them), and the trained module round-trips to torch."""
+    tm = TorchBNNet()
+    adapted = adapt_torch_module(tm)
+    init_mean = np.asarray(adapted.init_params(jax.random.key(0))["bn.running_mean"])
+
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(64, 1, 8, 8)).astype(np.float32) + 2.0
+    ys = rng.integers(0, 10, 64).astype(np.int32)
+    batches = [(xs[i:i + 16], ys[i:i + 16]) for i in range(0, 64, 16)]
+    trainer = get_trainer(tmp_root, max_epochs=2, checkpoint_callback=False)
+    trainer.fit(adapted, train_dataloaders=batches, val_dataloaders=batches[:1])
+
+    new_mean = np.asarray(adapted.params["bn.running_mean"])
+    assert np.max(np.abs(new_mean - init_mean)) > 0.1  # stats moved
+    trained = adapted.export_to_torch()
+    trained.eval()
+    with torch.no_grad():
+        ref = trained(torch.from_numpy(xs[:4])).numpy()
+    out = np.asarray(adapted.forward(adapted.params, jnp.asarray(xs[:4])))
+    assert np.max(np.abs(ref - out)) < 1e-4
 
 
 def test_missing_criterion_is_loud():
