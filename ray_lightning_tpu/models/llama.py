@@ -360,10 +360,15 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
     if sp > 1 and seq_len % sp:
         raise ValueError(f"sp={sp} must divide sequence length {seq_len}")
     if cfg.n_experts:
-        if tp > 1 or fsdp > 1:
+        if fsdp > 1:
             raise NotImplementedError(
-                "MoE pipeline stages compose with dp/ep for now; drop the "
-                f"tp/fsdp axes (mesh has tp={tp}, fsdp={fsdp})"
+                "MoE pipeline stages compose with dp/ep/tp for now; drop "
+                f"the fsdp axis (mesh has fsdp={fsdp})"
+            )
+        if tp > 1 and schedule != "gpipe":
+            raise NotImplementedError(
+                "MoE with in-stage tp needs GPipe (autodiff handles the "
+                "plain psum; the 1f1b manual VJP would double cotangents)"
             )
         if ep > 1 and cfg.n_experts % ep:
             raise ValueError(
@@ -422,14 +427,17 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
                 moe_ffn_local_experts,
             )
 
-            if ep > 1:
+            if ep > 1 or tp > 1:
                 # GSPMD can't partition einsums inside shard_map: expert
                 # parallelism is explicit here — full-router routing, local
-                # expert shard, psum over 'ep'
+                # expert shard, megatron-split expert FFNs when tp>1, one
+                # psum over (ep, tp) completing both reductions
                 def moe_fn(p, h):
                     return moe_ffn_local_experts(
-                        p, h, axis="ep", top_k=cfg.expert_top_k,
+                        p, h, axis="ep" if ep > 1 else None,
+                        top_k=cfg.expert_top_k,
                         capacity_factor=cfg.capacity_factor,
+                        tp_axis="tp" if tp > 1 else None,
                     )
             else:
                 def moe_fn(p, h):
@@ -690,7 +698,8 @@ def _lm_loss_pp_1f1b(
     if cfg.n_experts:
         raise NotImplementedError(
             "pipeline parallelism with MoE layers is not supported yet "
-            "under pp_schedule='1f1b'; use the gpipe schedule for pp x ep"
+            "under pp_schedule='1f1b'; the gpipe schedule covers pp x ep "
+            "(and pp x ep x tp)"
         )
     tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
     sp = mesh.shape["sp"] if "sp" in mesh.axis_names else 1

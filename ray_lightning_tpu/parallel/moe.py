@@ -164,15 +164,17 @@ def moe_ffn(
 def moe_ffn_local_experts(
     params: Dict[str, Any],
     x: jnp.ndarray,
-    axis: str,
+    axis: Optional[str],
     top_k: int = 2,
     capacity_factor: float = 1.5,
     capacity: Optional[int] = None,
+    tp_axis: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Expert parallelism for callers already INSIDE ``shard_map`` (pipeline
     stages, models/llama.py::_pp_stage_setup) — where GSPMD cannot partition
     the einsums for us: this member holds E/ep experts ([E_local, ...]
-    leaves, sharded over ``axis``) and the FULL (replicated) router.
+    leaves, sharded over ``axis``; ``axis=None`` = all experts local) and
+    the FULL (replicated) router.
 
     Routing (gates, capacity positions, aux) runs over ALL E experts —
     identical on every ep member, so top-k and capacity semantics match
@@ -182,6 +184,11 @@ def moe_ffn_local_experts(
     output is a sum over its top-k experts, which live on different
     members). aux needs no collective: it is computed from the full gate
     matrix and is bitwise identical across the ep group.
+
+    ``tp_axis``: megatron tensor parallelism INSIDE each expert — w_gate/
+    w_up column-sharded and w_down row-sharded over that axis, so each
+    member computes a partial-F contribution; the combine is linear, so
+    one psum (over ep and tp together) completes both reductions.
     """
     b, s, d = x.shape
     e = params["router"].shape[-1]
@@ -191,9 +198,18 @@ def moe_ffn_local_experts(
     if capacity is None:
         capacity = max(1, int(capacity_factor * top_k * t / e))
     disp, combine, aux = _route(xt, params["router"], top_k, capacity)
-    start = jax.lax.axis_index(axis) * e_local
-    disp_l = jax.lax.dynamic_slice_in_dim(disp, start, e_local, axis=1)
-    comb_l = jax.lax.dynamic_slice_in_dim(combine, start, e_local, axis=1)
-    out = _expert_ffn(disp_l, comb_l, xt, params)
-    out = jax.lax.psum(out, axis)
+    ep_sharded = axis is not None and e_local != e
+    if ep_sharded:
+        start = jax.lax.axis_index(axis) * e_local
+        disp = jax.lax.dynamic_slice_in_dim(disp, start, e_local, axis=1)
+        combine = jax.lax.dynamic_slice_in_dim(combine, start, e_local, axis=1)
+    out = _expert_ffn(disp, combine, xt, params)
+    # psum over ep only when this member really holds an expert SLICE (a
+    # psum of full outputs would multiply by the group size); tp partials
+    # always need their sum
+    reduce_axes = ((axis,) if ep_sharded else ()) + (
+        (tp_axis,) if tp_axis is not None else ()
+    )
+    if reduce_axes:
+        out = jax.lax.psum(out, reduce_axes)
     return out.reshape(b, s, d).astype(x.dtype), aux
