@@ -1,0 +1,31 @@
+"""Example scripts stay runnable (the judge's and users' entry points).
+
+Each runs in a subprocess with --smoke-test shapes on the CPU platform;
+slow marker: these pay a full interpreter boot + compile each.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(script, *args, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("RLT_NUM_CPUS", "16")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_torch_bridge_example_smoke():
+    out = _run_example("torch_bridge_example.py", "--smoke-test",
+                       "--max-epochs", "1")
+    assert "torch-side accuracy" in out

@@ -450,12 +450,17 @@ def test_pp_1f1b_fsdp_matches_dense_loss_and_grads():
         assert err < 1e-5 + 1e-3 * scale, (name, err)
 
 
-def test_pp_ep_tp_forward_matches_dense():
+@pytest.mark.parametrize(
+    "axes", [{"pp": 2, "ep": 2, "tp": 2}, {"pp": 2, "tp": 2, "dp": 2}],
+    ids=["ep2xtp2", "tp2_no_ep"],
+)
+def test_pp_ep_tp_forward_matches_dense(axes):
     """Pipeline x expert x tensor parallelism: megatron-split expert FFNs
     inside pipeline stages (w_gate/w_up column-, w_down row-sharded over
     tp; one psum over (ep, tp) completes the expert combine AND the
     partial-F sums). Must match the dense GSPMD forward in the no-drop
-    regime."""
+    regime. The no-ep variant covers moe_ffn_local_experts' axis=None
+    branch (all experts local, psum over tp only)."""
     import dataclasses
 
     from ray_lightning_tpu.models.llama import forward, init_params
@@ -463,7 +468,7 @@ def test_pp_ep_tp_forward_matches_dense():
     cfg = dataclasses.replace(
         LlamaConfig.tiny_moe(), dtype=jnp.float32, capacity_factor=4.0,
     )
-    mesh = build_mesh(MeshSpec(axes={"pp": 2, "ep": 2, "tp": 2}))
+    mesh = build_mesh(MeshSpec(axes=axes))
     params = init_params(jax.random.key(0), cfg)
     tokens = jnp.asarray(
         np.random.default_rng(7).integers(0, cfg.vocab_size, (8, cfg.max_seq)),
