@@ -7,6 +7,12 @@ from typing import Any, Dict
 
 
 class Callback:
+    # checkpoint-WRITING callbacks set this True; the Trainer dispatches
+    # them after all other callbacks (PTL semantics) so the state they
+    # snapshot — EarlyStopping patience, user counters — reflects the hook
+    # having already run everywhere else
+    saves_checkpoints = False
+
     @property
     def state_key(self) -> str:
         return type(self).__name__

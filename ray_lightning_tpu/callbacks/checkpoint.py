@@ -15,6 +15,7 @@ from ray_lightning_tpu.callbacks.base import Callback
 
 class ModelCheckpoint(Callback):
     CHECKPOINT_EXT = ".ckpt"
+    saves_checkpoints = True
 
     def __init__(
         self,
@@ -42,9 +43,16 @@ class ModelCheckpoint(Callback):
         self.last_model_path: str = ""
         self.best_k_models: Dict[str, float] = {}
 
+    @staticmethod
+    def default_dirpath(trainer) -> str:
+        """Single source of truth for the dirpath default — the launcher's
+        crash-relaunch scanner resolves through this too, so the two can
+        never drift onto different directories."""
+        return os.path.join(trainer.default_root_dir, "checkpoints")
+
     def setup(self, trainer, module, stage: str) -> None:
         if self.dirpath is None:
-            self.dirpath = os.path.join(trainer.default_root_dir, "checkpoints")
+            self.dirpath = self.default_dirpath(trainer)
 
     def _is_better(self, score: float, reference: float) -> bool:
         return score < reference if self.mode == "min" else score > reference

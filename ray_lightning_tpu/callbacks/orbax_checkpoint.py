@@ -14,6 +14,7 @@ import os
 from typing import Any, Dict, Optional
 
 import jax
+import numpy as np
 
 from ray_lightning_tpu.callbacks.base import Callback
 
@@ -30,6 +31,8 @@ class OrbaxModelCheckpoint(Callback):
     """Periodic async checkpoints of (params, opt_state, step) with
     retention, via ocp.CheckpointManager."""
 
+    saves_checkpoints = True
+
     def __init__(
         self,
         dirpath: Optional[str] = None,
@@ -45,9 +48,16 @@ class OrbaxModelCheckpoint(Callback):
         self.async_save = async_save
         self._manager: Optional["ocp.CheckpointManager"] = None
 
+    @staticmethod
+    def default_dirpath(trainer) -> str:
+        """Single source of truth for the dirpath default — the launcher's
+        crash-relaunch scanner resolves through this too, so the two can
+        never drift onto different directories."""
+        return os.path.join(trainer.default_root_dir, "orbax_ckpt")
+
     def setup(self, trainer, module, stage: str) -> None:
         if self.dirpath is None:
-            self.dirpath = os.path.join(trainer.default_root_dir, "orbax_ckpt")
+            self.dirpath = self.default_dirpath(trainer)
         options = ocp.CheckpointManagerOptions(
             max_to_keep=self.max_to_keep,
             enable_async_checkpointing=self.async_save,
@@ -64,6 +74,21 @@ class OrbaxModelCheckpoint(Callback):
         items = {"params": ocp.args.StandardSave(trainer._params)}
         if trainer._opt_state is not None:
             items["opt_state"] = ocp.args.StandardSave(trainer._opt_state)
+        # metadata lets a crash-relaunch run the FULL resume protocol, not
+        # just the weights: epoch loop position plus the trainer's shared
+        # aux state (callback states, callback metrics, module extras) —
+        # carried as one msgpack stream inside a uint8 array (orbax items
+        # must be array pytrees; the stream already round-trips numpy)
+        from ray_lightning_tpu.utils.serialization import to_state_stream
+
+        aux = to_state_stream(trainer.collect_aux_state())
+        items["meta"] = ocp.args.StandardSave(
+            {
+                "epoch": np.asarray(trainer.current_epoch),
+                "epoch_complete": np.asarray(bool(trainer._epoch_ended)),
+                "aux": np.frombuffer(aux, dtype=np.uint8).copy(),
+            }
+        )
         self._manager.save(trainer.global_step, args=ocp.args.Composite(**items))
 
     def on_fit_end(self, trainer, module) -> None:
@@ -87,8 +112,15 @@ class OrbaxModelCheckpoint(Callback):
         step: Optional[int] = None,
     ) -> Dict[str, Any]:
         """Restore onto the templates' shardings — templates may use a
-        DIFFERENT mesh than the save ran on; orbax reshards on read."""
-        manager = ocp.CheckpointManager(os.path.abspath(dirpath))
+        DIFFERENT mesh than the save ran on; orbax reshards on read.
+
+        The result always carries ``step``; ``opt_state`` and ``meta``
+        (epoch, for crash-relaunch resume) appear when present on disk —
+        checkpoints from older versions lack ``meta``, weights-only saves
+        lack ``opt_state``.
+        """
+        dirpath = os.path.abspath(dirpath)
+        manager = ocp.CheckpointManager(dirpath)
         try:
             step = step if step is not None else manager.latest_step()
             if step is None:
@@ -97,11 +129,18 @@ class OrbaxModelCheckpoint(Callback):
                 ocp.utils.to_shape_dtype_struct, tree
             )
             items = {"params": ocp.args.StandardRestore(to_abstract(params_template))}
-            if opt_state_template is not None:
+            step_dir = os.path.join(dirpath, str(step))
+            if opt_state_template is not None and os.path.isdir(
+                os.path.join(step_dir, "opt_state")
+            ):
                 items["opt_state"] = ocp.args.StandardRestore(
                     to_abstract(opt_state_template)
                 )
+            if os.path.isdir(os.path.join(step_dir, "meta")):
+                items["meta"] = ocp.args.StandardRestore()
             restored = manager.restore(step, args=ocp.args.Composite(**items))
-            return dict(restored.items())
+            out = dict(restored.items())
+            out["step"] = int(step)
+            return out
         finally:
             manager.close()

@@ -186,6 +186,11 @@ class Trainer:
             isinstance(c, ModelCheckpoint) for c in self.callbacks
         ):
             self.callbacks.append(ModelCheckpoint())
+        # checkpoint-writing callbacks dispatch LAST (PTL semantics): the
+        # state they snapshot must reflect every other callback having
+        # already processed the hook (stable within each group, so the
+        # save/restore state-key enumeration is unchanged between runs)
+        self.callbacks.sort(key=lambda c: c.saves_checkpoints)
 
         if logger is True:
             self.logger: Optional[Logger] = CSVLogger(
@@ -205,6 +210,10 @@ class Trainer:
         self.num_val_batches = 0
         self.val_enabled = False
         self._val_ran_this_epoch = False
+        # False while inside an epoch's batch loop: checkpoints written then
+        # (val_check_interval saves) record epoch_complete=False so a resume
+        # re-runs the partial epoch instead of skipping its remainder
+        self._epoch_ended = True
         self.callback_metrics: Dict[str, np.ndarray] = {}
         self.logged_metrics: Dict[str, Any] = {}
         self._module: Optional[LightningModule] = None
@@ -216,6 +225,9 @@ class Trainer:
         self._rng_root = None
         self._datamodule = None
         self._restored_ckpt: Optional[Dict[str, Any]] = None
+        # set by the launcher on a max_failures relaunch: newest checkpoint
+        # the crashed worker group wrote ("orbax:<dir>" for the sharded path)
+        self._relaunch_ckpt_path: Optional[str] = None
 
     # ------------------------------------------------------------------ #
     # public properties
@@ -673,8 +685,21 @@ class Trainer:
                 self._params
             )
 
+        relaunch_ckpt = getattr(self, "_relaunch_ckpt_path", None)
+        if relaunch_ckpt is not None:
+            # crash relaunch: the newest mid-run state beats whatever
+            # ckpt_path the original fit() call carried
+            ckpt_path = relaunch_ckpt
         if ckpt_path is not None:
-            self._restore_checkpoint(ckpt_path)
+            if ckpt_path.startswith("orbax@"):
+                # "orbax@<step>:<dir>" — a step pinned by the crash-relaunch
+                # scanner so a stale step in a reused dir can't win
+                step_s, d = ckpt_path[len("orbax@"):].split(":", 1)
+                self._restore_orbax(d, step=int(step_s))
+            elif ckpt_path.startswith("orbax:"):
+                self._restore_orbax(ckpt_path[len("orbax:"):])
+            else:
+                self._restore_checkpoint(ckpt_path)
 
         train_step = self._build_train_step()
         val_step = self._build_eval_step("val") if val_loader is not None else None
@@ -725,6 +750,10 @@ class Trainer:
             if val_loader
             else 0
         )
+        # before the hooks: a save_checkpoint() from on_train_epoch_start
+        # must already record this epoch as partial, not the previous one's
+        # completed state
+        self._epoch_ended = False
         self._hook("on_train_epoch_start")
         aggregator = _EpochAggregator()
         t_epoch = time.perf_counter()
@@ -760,6 +789,7 @@ class Trainer:
 
         for batch_idx, batch in enumerate(train_loader):
             if limit_train is not None and batch_idx >= limit_train:
+                self._epoch_ended = True
                 break
             device_batch = self.strategy.shard_batch(batch)
             self._cb("on_train_batch_start", batch, batch_idx)
@@ -792,6 +822,10 @@ class Trainer:
             if 0 <= self.max_steps <= self.global_step:
                 self.should_stop = True
                 break
+        else:
+            # the loop ran to its natural end; only a max_steps break leaves
+            # the epoch marked partial so epoch-end saves resume correctly
+            self._epoch_ended = True
 
         # epoch-level train metrics
         epoch_metrics = aggregator.reduce(self._module._log_meta.get)
@@ -1030,6 +1064,7 @@ class Trainer:
         params_host = jax.device_get(self._params if self._params is not None else model._params)
         ckpt: Dict[str, Any] = {
             "epoch": self.current_epoch,
+            "epoch_complete": bool(self._epoch_ended),
             "global_step": self.global_step,
             "rlt_version": __version__,
             "state_dict": flax_serialization.to_state_dict(params_host),
@@ -1051,9 +1086,75 @@ class Trainer:
 
     def save_checkpoint(self, filepath: str, weights_only: bool = False) -> None:
         ckpt = self.dump_checkpoint(weights_only)
-        os.makedirs(os.path.dirname(os.path.abspath(filepath)), exist_ok=True)
-        with open(filepath, "wb") as f:
+        filepath = os.path.abspath(filepath)
+        os.makedirs(os.path.dirname(filepath), exist_ok=True)
+        # write-then-rename: a process killed mid-save (the exact moment the
+        # crash-relaunch path later scans this directory) must never leave a
+        # truncated .ckpt that the relaunch would pick as "newest"
+        tmp = filepath + ".tmp"
+        with open(tmp, "wb") as f:
             f.write(to_state_stream(ckpt))
+        os.replace(tmp, filepath)
+
+    def collect_aux_state(self) -> Dict[str, Any]:
+        """Non-array resume state shared by BOTH checkpoint formats:
+        callback states (EarlyStopping patience, ModelCheckpoint best-k),
+        callback metrics, and the module's ``on_save_checkpoint`` extras.
+        The orbax callback serializes this alongside the sharded arrays."""
+        from ray_lightning_tpu.callbacks.base import collect_callback_states
+
+        user: Dict[str, Any] = {
+            "epoch": self.current_epoch,
+            "global_step": self.global_step,
+        }
+        self._module.on_save_checkpoint(user)
+        return {
+            "callbacks": collect_callback_states(self.callbacks),
+            "callback_metrics": {
+                k: np.asarray(v) for k, v in self.callback_metrics.items()
+            },
+            "user": user,
+        }
+
+    def _restore_aux_state(self, ckpt: Dict[str, Any]) -> None:
+        """Apply the shared resume protocol: callback states, callback
+        metrics, and the module's ``on_load_checkpoint``. ``ckpt`` is the
+        full dict for the .ckpt format, or the reassembled aux dict for
+        orbax — both carry the same keys."""
+        from ray_lightning_tpu.callbacks.base import restore_callback_states
+
+        restore_callback_states(self.callbacks, ckpt.get("callbacks", {}))
+        for k, v in ckpt.get("callback_metrics", {}).items():
+            self.callback_metrics[k] = np.asarray(v)
+        self._module.on_load_checkpoint(ckpt)
+
+    def _restore_orbax(self, dirpath: str, step: Optional[int] = None) -> None:
+        """Resume from an orbax step (default: latest) onto the CURRENT
+        shardings (``self._params``/``self._opt_state`` are the freshly-
+        initialized templates at this point in ``_fit_impl``; orbax
+        reshards on read)."""
+        from ray_lightning_tpu.callbacks.orbax_checkpoint import (
+            OrbaxModelCheckpoint,
+        )
+
+        restored = OrbaxModelCheckpoint.restore(
+            dirpath, self._params, self._opt_state, step=step
+        )
+        self._params = restored["params"]
+        if "opt_state" in restored:
+            self._opt_state = restored["opt_state"]
+        self.global_step = restored["step"]
+        meta = restored.get("meta")
+        if meta is not None:
+            epoch = int(np.asarray(meta["epoch"]))
+            complete = bool(np.asarray(meta.get("epoch_complete", True)))
+            self.current_epoch = epoch + 1 if complete else epoch
+            aux = meta.get("aux")
+            if aux is not None:
+                aux = load_state_stream(np.asarray(aux).tobytes())
+                # user extras merge top-level so on_load_checkpoint sees
+                # the same dict shape on_save_checkpoint wrote into
+                self._restore_aux_state({**aux, **aux.get("user", {})})
 
     def _restore_checkpoint(self, ckpt_path: str) -> None:
         with open(ckpt_path, "rb") as f:
@@ -1080,11 +1181,10 @@ class Trainer:
                 self._opt_state,
                 host_opt,
             )
-        self.current_epoch = int(ckpt.get("epoch", 0)) + 1
+        # a mid-epoch save (epoch_complete False) resumes by re-running its
+        # epoch from the start — some batches retrain, none are skipped;
+        # checkpoints from older versions lack the flag and keep epoch + 1
+        base = int(ckpt.get("epoch", 0))
+        self.current_epoch = base + 1 if ckpt.get("epoch_complete", True) else base
         self.global_step = int(ckpt.get("global_step", 0))
-        from ray_lightning_tpu.callbacks.base import restore_callback_states
-
-        restore_callback_states(self.callbacks, ckpt.get("callbacks", {}))
-        for k, v in ckpt.get("callback_metrics", {}).items():
-            self.callback_metrics[k] = np.asarray(v)
-        self._module.on_load_checkpoint(ckpt)
+        self._restore_aux_state(ckpt)

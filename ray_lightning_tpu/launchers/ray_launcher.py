@@ -224,9 +224,14 @@ class RayLauncher:
         # Failure handling: the reference surfaces a worker crash only as a
         # failed future and gives up (SURVEY §5 "a deliberate gap to improve
         # on, not replicate"); here a crashed worker group is torn down and
-        # relaunched up to strategy.max_failures times.
+        # relaunched up to strategy.max_failures times, resuming from the
+        # newest checkpoint THIS run wrote (not the initial payload — a
+        # crash at epoch 9/10 must not restart at epoch 0).
         max_failures = getattr(self._strategy, "max_failures", 0)
         attempt = 0
+        launch_t0 = time.time()
+        if trainer is not None:
+            trainer._relaunch_ckpt_path = None
         while True:
             try:
                 self.setup_workers()
@@ -241,13 +246,86 @@ class RayLauncher:
                 if attempt >= max_failures or not e.is_process_failure:
                     raise
                 attempt += 1
+                resume = None
+                if trainer is not None:
+                    resume = self._find_relaunch_checkpoint(trainer, launch_t0)
+                    trainer._relaunch_ckpt_path = resume
                 rank_zero_info(
-                    "worker failure; relaunching (attempt %d/%d)",
+                    "worker failure; relaunching (attempt %d/%d)%s",
                     attempt,
                     max_failures,
+                    f" resuming from {resume}" if resume else " from scratch",
                 )
             finally:
                 self.teardown_workers()
+
+    @staticmethod
+    def _find_relaunch_checkpoint(trainer, not_before: float) -> Optional[str]:
+        """Newest checkpoint the crashed worker group left behind, so the
+        relaunched group continues instead of restarting (checkpoints land
+        on the driver's filesystem because workers are host-local actors;
+        cross-host workers need a shared filesystem for this to engage).
+
+        ``not_before`` fences out stale files from a previous run sharing
+        the same dirpath — resuming from those would silently skip training.
+        """
+        candidates = []  # (mtime, resume spec) — families compete on recency
+        weights_only = []  # fallback tier: params but no optimizer/callbacks
+        for cb in trainer.checkpoint_callbacks:
+            d = cb.dirpath or cb.default_dirpath(trainer)
+            if not os.path.isdir(d):
+                continue
+            tier = weights_only if cb.save_weights_only else candidates
+            for name in os.listdir(d):
+                if not name.endswith(".ckpt"):
+                    continue
+                path = os.path.join(d, name)
+                try:
+                    mtime = os.path.getmtime(path)
+                except OSError:
+                    continue
+                if mtime >= not_before:
+                    tier.append((mtime, path))
+        # orbax checkpoints (sharded/async path): the newest FRESH step is
+        # pinned into the spec ("orbax@<step>:<dir>") — restoring "latest"
+        # could pick a stale step when the dirpath is reused across runs —
+        # and its mtime competes with the .ckpt files so a monitor-gated
+        # .ckpt from epoch 1 cannot shadow an epoch-8 step
+        try:
+            from ray_lightning_tpu.callbacks.orbax_checkpoint import (
+                OrbaxModelCheckpoint,
+            )
+        except Exception:  # pragma: no cover - orbax not installed
+            OrbaxModelCheckpoint = None
+        for cb in trainer.callbacks if OrbaxModelCheckpoint else []:
+            if not isinstance(cb, OrbaxModelCheckpoint):
+                continue
+            d = cb.dirpath or cb.default_dirpath(trainer)
+            if not os.path.isdir(d):
+                continue
+            fresh = []  # (mtime, step)
+            for name in os.listdir(d):
+                if not name.isdigit():
+                    continue
+                try:
+                    mtime = os.path.getmtime(os.path.join(d, name))
+                except OSError:
+                    continue
+                if mtime >= not_before:
+                    fresh.append((mtime, int(name)))
+            if fresh:
+                mtime, step = max(fresh)
+                candidates.append((mtime, f"orbax@{step}:{d}"))
+        if candidates:
+            return max(candidates)[1]
+        if weights_only:
+            rank_zero_info(
+                "relaunch is resuming from a save_weights_only checkpoint: "
+                "params are restored but the optimizer state and callback "
+                "states restart fresh"
+            )
+            return max(weights_only)[1]
+        return None
 
     # ------------------------------------------------------------------ #
     def _worker_demand(self) -> Dict[str, float]:
