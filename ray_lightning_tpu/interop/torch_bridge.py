@@ -245,6 +245,15 @@ def fx_to_jax(
                 env[node.name] = _call_function(
                     node.target, look(node.args), look(dict(node.kwargs)), rng
                 )
+                if (
+                    _is_functional_dropout(node.target)
+                    and rng is not None
+                    and _dropout_site_active(node)
+                ):
+                    # same rng discipline as the nn.Dropout branch: split
+                    # after every stochastic site so multiple F.dropout
+                    # calls never reuse one key (correlated masks)
+                    rng, _ = jax.random.split(rng)
             elif node.op == "call_method":
                 self_val = look(node.args[0])
                 env[node.name] = _call_method(
@@ -413,6 +422,22 @@ def _function_map():
     return _FUNCTION_MAP
 
 
+def _is_functional_dropout(target) -> bool:
+    import torch.nn.functional as F
+
+    return target is F.dropout
+
+
+def _dropout_site_active(node) -> bool:
+    """A site traced with an explicit ``training=False`` (permanently
+    inert) neither applies a mask nor consumes an rng split — fx records
+    the flag as a literal in the node's args/kwargs."""
+    training = node.kwargs.get(
+        "training", node.args[2] if len(node.args) > 2 else True
+    )
+    return training is not False
+
+
 def _check_function(target):
     import torch.nn.functional as F
 
@@ -426,6 +451,9 @@ def _call_function(target, args, kwargs, rng):
     import torch.nn.functional as F
 
     if target is F.dropout:
+        training = kwargs.get("training", args[2] if len(args) > 2 else True)
+        if training is False:  # permanently-inert site: identity
+            return args[0]
         p = kwargs.get("p", args[1] if len(args) > 1 else 0.5)
         return _dropout(args[0], p, rng)
     fn = _function_map().get(target)
@@ -539,6 +567,16 @@ def torch_optimizer_to_optax(
     else:
         opt = cfg
 
+    if len(opt.param_groups) > 1:
+        # fail-loud contract: silently applying group-0 hyperparameters to
+        # every parameter would change training (bias/norm exclusion is
+        # the common multi-group pattern)
+        raise UnsupportedTorchOp(
+            f"optimizer with {len(opt.param_groups)} param_groups; the "
+            "bridge maps one group's hyperparameters onto all parameters "
+            "— use optax.multi_transform via configure_optimizers on the "
+            "adapter for per-group settings"
+        )
     g = opt.param_groups[0]
     lr = g["lr"]
     schedule = _torch_scheduler_to_optax(sched, lr, total_steps)

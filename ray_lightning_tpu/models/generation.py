@@ -217,6 +217,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    pad_id: Optional[int] = None,
 ) -> jnp.ndarray:
     """Generate ``max_new_tokens`` after ``prompt`` [B, P] (dense prompts;
     all rows share length P). Returns [B, P + max_new_tokens].
@@ -224,7 +225,19 @@ def generate(
     The prompt is consumed by ONE batched ``prefill`` pass (the training
     layer math filling the cache), then one compiled ``lax.scan`` samples
     the new tokens. temperature 0 = greedy; > 0 = categorical sampling.
+
+    ``pad_id`` is accepted for backward compatibility with the ragged
+    teacher-forcing signature and ignored: dense prompts have no padding.
     """
+    if pad_id is not None:
+        import warnings
+
+        warnings.warn(
+            "generate(pad_id=...) is deprecated and ignored: prompts are "
+            "dense (all rows share length P), so there is nothing to pad",
+            DeprecationWarning,
+            stacklevel=2,
+        )
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if rng is None:

@@ -294,10 +294,13 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
     att = attn_fn(q, k, v)
     att = att.swapaxes(1, 2).reshape(B, S, nh * hd)
     x = x + red(att @ lp["wo"])
-    h2 = fin(rmsnorm(x, lp["mlp_norm"]))
     if cfg.n_experts and "moe" in lp:
         from ray_lightning_tpu.parallel.moe import moe_ffn, moe_ffn_lossless
 
+        # NOT fin-wrapped: the moe impl wraps its own input over (ep, tp)
+        # when it needs the f operator (vjp_safe) — a second wrap here
+        # would double the input cotangent's tp psum under 1F1B
+        h2 = rmsnorm(x, lp["mlp_norm"])
         if moe_lossless:  # inference: no-drop routing, no dispatch tensors
             moe_out = moe_ffn_lossless(lp["moe"], h2, top_k=cfg.expert_top_k)
             aux = jnp.float32(0.0)
@@ -313,6 +316,7 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
             )
         x = x + moe_out
     else:
+        h2 = fin(rmsnorm(x, lp["mlp_norm"]))
         gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
         x = x + red(gated @ lp["w_down"])
         aux = jnp.float32(0.0)
@@ -360,16 +364,6 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
     if sp > 1 and seq_len % sp:
         raise ValueError(f"sp={sp} must divide sequence length {seq_len}")
     if cfg.n_experts:
-        if fsdp > 1:
-            raise NotImplementedError(
-                "MoE pipeline stages compose with dp/ep/tp for now; drop "
-                f"the fsdp axis (mesh has fsdp={fsdp})"
-            )
-        if tp > 1 and schedule != "gpipe":
-            raise NotImplementedError(
-                "MoE with in-stage tp needs GPipe (autodiff handles the "
-                "plain psum; the 1f1b manual VJP would double cotangents)"
-            )
         if ep > 1 and cfg.n_experts % ep:
             raise ValueError(
                 f"ep={ep} must divide n_experts={cfg.n_experts}"
@@ -431,13 +425,16 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
                 # GSPMD can't partition einsums inside shard_map: expert
                 # parallelism is explicit here — full-router routing, local
                 # expert shard, megatron-split expert FFNs when tp>1, one
-                # psum over (ep, tp) completing both reductions
+                # psum over (ep, tp) completing both reductions. Under the
+                # 1F1B manual VJP those collectives go through the f/g
+                # custom-VJP pair instead (vjp_safe; see moe.py docstring)
                 def moe_fn(p, h):
                     return moe_ffn_local_experts(
                         p, h, axis="ep" if ep > 1 else None,
                         top_k=cfg.expert_top_k,
                         capacity_factor=cfg.capacity_factor,
                         tp_axis="tp" if tp > 1 else None,
+                        vjp_safe=schedule == "1f1b",
                     )
             else:
                 def moe_fn(p, h):
@@ -535,8 +532,11 @@ def _stage_specs_with_fsdp(cfg: LlamaConfig, layer_params: Dict[str, Any],
     all-gather needs. Returns (spec_tree, dims_tree) where dims index the
     SCANNED per-layer leaf (stage leaf minus the [pp, layer] dims); -1 =
     leaf replicated within fsdp (norms; dims not divisible by fsdp — the
-    sentinel is an int, not None, because None vanishes as a pytree)."""
-    keep_axes = ("pp", "tp") if with_tp else ("pp",)
+    sentinel is an int, not None, because None vanishes as a pytree).
+
+    'ep' is always kept: MoE expert stacks stay expert-sharded at rest
+    alongside their fsdp shard (the mesh filter drops 'ep' when absent)."""
+    keep_axes = ("pp", "tp", "ep") if with_tp else ("pp", "ep")
 
     def one(spec: P, p) -> tuple:
         def keep(e, allow_fsdp):
@@ -695,12 +695,6 @@ def _lm_loss_pp_1f1b(
         psum_fwd_identity_bwd,
     )
 
-    if cfg.n_experts:
-        raise NotImplementedError(
-            "pipeline parallelism with MoE layers is not supported yet "
-            "under pp_schedule='1f1b'; the gpipe schedule covers pp x ep "
-            "(and pp x ep x tp)"
-        )
     tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
     sp = mesh.shape["sp"] if "sp" in mesh.axis_names else 1
     fsdp = mesh.shape["fsdp"] if "fsdp" in mesh.axis_names else 1
@@ -739,13 +733,19 @@ def _lm_loss_pp_1f1b(
     last_params = {
         "final_norm": params["final_norm"], "lm_head": params["lm_head"]
     }
-    ce = pipeline_1f1b_loss(
+    res = pipeline_1f1b_loss(
         stage_fn, last_fn, stage_params, last_params, x, targets, mesh,
         axis="pp", num_microbatches=m, data_spec=data_spec,
         param_spec=stage_spec,
         grad_reduce_axes=("sp",) if sp > 1 else (),
+        with_aux=bool(cfg.n_experts),
+        aux_weight=cfg.moe_aux_weight if cfg.n_experts else 0.0,
     )
-    return ce, {"loss": ce, "ppl": jnp.exp(ce)}
+    if cfg.n_experts:
+        loss, aux = res
+        ce = loss - cfg.moe_aux_weight * aux
+        return loss, {"loss": loss, "ppl": jnp.exp(ce), "moe_aux": aux}
+    return res, {"loss": res, "ppl": jnp.exp(res)}
 
 
 def lm_loss(

@@ -169,6 +169,7 @@ def moe_ffn_local_experts(
     capacity_factor: float = 1.5,
     capacity: Optional[int] = None,
     tp_axis: Optional[str] = None,
+    vjp_safe: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Expert parallelism for callers already INSIDE ``shard_map`` (pipeline
     stages, models/llama.py::_pp_stage_setup) — where GSPMD cannot partition
@@ -189,6 +190,21 @@ def moe_ffn_local_experts(
     w_up column-sharded and w_down row-sharded over that axis, so each
     member computes a partial-F contribution; the combine is linear, so
     one psum (over ep and tp together) completes both reductions.
+
+    ``vjp_safe``: collectives expressed through the megatron f/g
+    custom-VJP pair instead of plain ``lax.psum`` — REQUIRED when the
+    caller differentiates the enclosing shard_map body with a manual
+    ``jax.vjp`` (the 1F1B schedule), where psum's psum-transpose would
+    scale cotangents by the group size. Placement: the replicated input
+    and router enter the per-member partial computation through the f
+    operator (backward re-sums each member's partial cotangent), the
+    combine exits through the g operator (backward identity). The aux
+    scalar is computed REPLICATED on every member yet its input/router
+    cotangents pass the same f-sum, so it is seeded through
+    :func:`~ray_lightning_tpu.parallel.pipeline_1f1b.scale_bwd` with
+    1/group-size — the f-sum then restores exactly one copy. Leave False
+    under autodiff-of-shard_map (GPipe), whose unmapped-input transpose
+    rules need the plain psum.
     """
     b, s, d = x.shape
     e = params["router"].shape[-1]
@@ -197,19 +213,38 @@ def moe_ffn_local_experts(
     xt = x.reshape(t, d)
     if capacity is None:
         capacity = max(1, int(capacity_factor * top_k * t / e))
-    disp, combine, aux = _route(xt, params["router"], top_k, capacity)
     ep_sharded = axis is not None and e_local != e
-    if ep_sharded:
-        start = jax.lax.axis_index(axis) * e_local
-        disp = jax.lax.dynamic_slice_in_dim(disp, start, e_local, axis=1)
-        combine = jax.lax.dynamic_slice_in_dim(combine, start, e_local, axis=1)
-    out = _expert_ffn(disp, combine, xt, params)
     # psum over ep only when this member really holds an expert SLICE (a
     # psum of full outputs would multiply by the group size); tp partials
     # always need their sum
     reduce_axes = ((axis,) if ep_sharded else ()) + (
         (tp_axis,) if tp_axis is not None else ()
     )
+    router = params["router"]
+    if vjp_safe and reduce_axes:
+        from ray_lightning_tpu.parallel.pipeline_1f1b import (
+            identity_fwd_psum_bwd,
+            psum_fwd_identity_bwd,
+            scale_bwd,
+        )
+
+        xt = identity_fwd_psum_bwd(xt, reduce_axes)
+        router = identity_fwd_psum_bwd(router, reduce_axes)
+    disp, combine, aux = _route(xt, router, top_k, capacity)
+    if vjp_safe and reduce_axes:
+        group = 1
+        for a in reduce_axes:  # static: the custom-VJP closure needs a const
+            group *= jax.lax.axis_size(a)
+        aux = scale_bwd(aux, 1.0 / group)
+    if ep_sharded:
+        start = jax.lax.axis_index(axis) * e_local
+        disp = jax.lax.dynamic_slice_in_dim(disp, start, e_local, axis=1)
+        combine = jax.lax.dynamic_slice_in_dim(combine, start, e_local, axis=1)
+    out = _expert_ffn(disp, combine, xt, params)
     if reduce_axes:
-        out = jax.lax.psum(out, reduce_axes)
+        out = (
+            psum_fwd_identity_bwd(out, reduce_axes)
+            if vjp_safe
+            else jax.lax.psum(out, reduce_axes)
+        )
     return out.reshape(b, s, d).astype(x.dtype), aux

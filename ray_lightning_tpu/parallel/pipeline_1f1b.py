@@ -45,14 +45,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ray_lightning_tpu.parallel.pipeline import data_axes_of, local_batch
 
 
-def psum_fwd_identity_bwd(x, axis: str):
-    """Megatron's "g" operator: forward = psum over ``axis``, backward =
-    identity. Required (with :func:`identity_fwd_psum_bwd`) for tensor
-    parallelism inside a MANUALLY-vjp'd shard_map body: JAX transposes
-    ``lax.psum`` to ``lax.psum``, so a plain psum doubles the cotangent per
-    stage traversal (axis-size factor, compounding across stages). Outside
-    autodiff (e.g. the GPipe path, grad-of-shard_map) compensates via the
-    unmapped-input rules and must keep the plain psum."""
+def psum_fwd_identity_bwd(x, axis):
+    """Megatron's "g" operator: forward = psum over ``axis`` (one name or a
+    tuple of names), backward = identity. Required (with
+    :func:`identity_fwd_psum_bwd`) for tensor parallelism inside a
+    MANUALLY-vjp'd shard_map body: JAX transposes ``lax.psum`` to
+    ``lax.psum``, so a plain psum doubles the cotangent per stage traversal
+    (axis-size factor, compounding across stages). Outside autodiff (e.g.
+    the GPipe path, grad-of-shard_map) compensates via the unmapped-input
+    rules and must keep the plain psum."""
 
     @jax.custom_vjp
     def fn(x):
@@ -62,16 +63,33 @@ def psum_fwd_identity_bwd(x, axis: str):
     return fn(x)
 
 
-def identity_fwd_psum_bwd(x, axis: str):
+def identity_fwd_psum_bwd(x, axis):
     """Megatron's "f" operator: forward = identity, backward = psum over
-    ``axis``. Placed where a replicated activation enters column-parallel
-    matmuls so each shard's partial input-cotangent is re-summed."""
+    ``axis`` (one name or a tuple of names). Placed where a replicated
+    value enters per-member partial computations (column-parallel matmuls,
+    expert shards) so each member's partial cotangent is re-summed."""
 
     @jax.custom_vjp
     def fn(x):
         return x
 
     fn.defvjp(lambda x: (x, None), lambda _, ct: (jax.lax.psum(ct, axis),))
+    return fn(x)
+
+
+def scale_bwd(x, factor):
+    """Forward identity; backward scales the cotangent by ``factor``.
+
+    Used for values computed REPLICATED across a member group whose
+    cotangents will later be summed by an f-operator: seeding each member
+    with cotangent/group-size makes the f-sum recover exactly one copy
+    (the MoE aux loss under the 1F1B manual VJP)."""
+
+    @jax.custom_vjp
+    def fn(x):
+        return x
+
+    fn.defvjp(lambda x: (x, None), lambda _, ct: (ct * factor,))
     return fn(x)
 
 
@@ -99,6 +117,8 @@ def pipeline_1f1b_loss(
     data_spec: P = P(),
     param_spec: Any = None,
     grad_reduce_axes: tuple = (),
+    with_aux: bool = False,
+    aux_weight: float = 0.0,
 ) -> jnp.ndarray:
     """Mean-over-microbatches scalar loss of a 1F1B-scheduled pipeline.
 
@@ -125,6 +145,16 @@ def pipeline_1f1b_loss(
     with :func:`psum_fwd_identity_bwd` (forward psum, backward identity) —
     a plain ``lax.psum`` in ``last_fn`` would double cotangents under
     ``jax.vjp`` exactly like the tp case above.
+
+    ``with_aux``: stage_fn returns ``(activations, aux_scalar)`` (MoE load
+    balancing); the call returns ``(loss, aux)`` where aux is the mean over
+    (stage, microbatch) — matching GPipe's ``pipeline_apply(with_aux=True)``
+    — and ``loss`` already includes ``aux_weight * aux``. The aux OUTPUT is
+    a metric: differentiating it directly yields zero (its gradient flows
+    through ``loss`` via ``aux_weight``). The backward phase seeds each
+    (stage, microbatch) vjp with an aux cotangent of ``aux_weight / P`` so
+    the scheduled accumulation times the final ``1/m`` yields exactly
+    ``d(aux_weight * mean_over_stages_and_microbatches)``.
     """
     m = num_microbatches
     local_batch(x, data_spec, mesh, m)  # divisibility validation
@@ -137,7 +167,7 @@ def pipeline_1f1b_loss(
                     f"param_spec leaves must lead with {axis!r}; got {leaf}"
                 )
     closure = _Closure(stage_fn, last_fn, mesh, axis, m, data_spec, param_spec,
-                       grad_reduce_axes)
+                       grad_reduce_axes, with_aux, aux_weight)
     return closure(stage_params, last_params, x, targets)
 
 
@@ -146,7 +176,8 @@ class _Closure:
     pieces (functions, mesh, schedule constants) live here."""
 
     def __init__(self, stage_fn, last_fn, mesh, axis, m, data_spec,
-                 param_spec=None, grad_reduce_axes=()):
+                 param_spec=None, grad_reduce_axes=(), with_aux=False,
+                 aux_weight=0.0):
         self.stage_fn = stage_fn
         self.last_fn = last_fn
         self.mesh = mesh
@@ -155,21 +186,26 @@ class _Closure:
         self.data_spec = data_spec
         self.param_spec = param_spec
         self.grad_reduce_axes = tuple(grad_reduce_axes)
+        self.with_aux = with_aux
+        self.aux_weight = aux_weight
 
         @jax.custom_vjp
         def run(stage_params, last_params, x, targets):
             return self._forward_only(stage_params, last_params, x, targets)
 
         def fwd(stage_params, last_params, x, targets):
-            loss, grads = self._forward_backward(
+            out, grads = self._forward_backward(
                 stage_params, last_params, x, targets
             )
-            return loss, (grads, targets)
+            return out, (grads, targets)
 
         def bwd(res, g):
             import numpy as np
 
             (d_stage, d_last, d_x), targets = res
+            # with_aux: g = (g_loss, g_aux); the aux output is a metric —
+            # its gradient contribution already rides loss via aux_weight
+            g = g[0] if self.with_aux else g
             scale = lambda t: jax.tree_util.tree_map(lambda a: a * g, t)
             # integer targets carry a symbolic-zero (float0) cotangent
             if jnp.issubdtype(targets.dtype, jnp.floating):
@@ -202,10 +238,12 @@ class _Closure:
         stage_fn, last_fn = self.stage_fn, self.last_fn
         param_spec, last_spec, data_spec = self._specs(stage_params)
 
+        with_aux = self.with_aux
+
         @partial(
             shard_map, mesh=self.mesh,
             in_specs=(param_spec, last_spec, data_spec, data_spec),
-            out_specs=P(), check_rep=False,
+            out_specs=(P(), P()) if with_aux else P(), check_rep=False,
         )
         def _pipe(params_local, last_p, x_full, tgt_full):
             stage = jax.lax.axis_index(axis)
@@ -216,26 +254,38 @@ class _Closure:
             perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
 
             def tick(t, carry):
-                recv, loss_sum = carry
+                recv, loss_sum, aux_sum = carry
                 mb_idx = t - stage
                 active = (mb_idx >= 0) & (mb_idx < m)
                 safe = jnp.clip(mb_idx, 0, m - 1)
                 inp = jnp.where(stage == 0, micro[safe], recv)
-                y = stage_fn(params_here, inp)
+                res = stage_fn(params_here, inp)
+                y, aux_j = res if with_aux else (res, jnp.float32(0.0))
                 y = jnp.where(active, y, jnp.zeros_like(y))
+                aux_sum = aux_sum + jnp.where(
+                    active, aux_j.astype(jnp.float32), 0.0
+                )
                 loss_j = last_fn(last_p, y, tgt[safe])
                 loss_sum = loss_sum + jnp.where(
                     active & (stage == pp - 1), loss_j, 0.0
                 )
                 recv = jax.lax.ppermute(y, axis, perm_fwd)
-                return recv, loss_sum
+                return recv, loss_sum, aux_sum
 
             recv0 = jnp.zeros(mb_shape, x_full.dtype)
-            _, loss_sum = jax.lax.fori_loop(
-                0, pp + m - 1, tick, (recv0, jnp.float32(0.0))
+            _, loss_sum, aux_sum = jax.lax.fori_loop(
+                0, pp + m - 1, tick,
+                (recv0, jnp.float32(0.0), jnp.float32(0.0)),
             )
             loss = jax.lax.psum(loss_sum, axis) / m
-            return _mean_over_data(loss, self.mesh, data_spec)
+            loss = _mean_over_data(loss, self.mesh, data_spec)
+            if not with_aux:
+                return loss
+            # mean over (stage, microbatch) then data groups — the same
+            # estimate GPipe's pipeline_apply(with_aux=True) reports
+            aux = jax.lax.psum(aux_sum, axis) / (pp * m)
+            aux = _mean_over_data(aux, self.mesh, data_spec)
+            return loss + self.aux_weight * aux, aux
 
         return _pipe(stage_params, last_params, x, targets)
 
@@ -247,11 +297,15 @@ class _Closure:
         stage_fn, last_fn = self.stage_fn, self.last_fn
         param_spec, last_spec, data_spec = self._specs(stage_params)
         w = min(2 * pp - 1, m)  # ring slots: max residency is 2(P-1)+1
+        with_aux = self.with_aux
+        aux_ct_val = jnp.float32(self.aux_weight / pp)
 
         @partial(
             shard_map, mesh=self.mesh,
             in_specs=(param_spec, last_spec, data_spec, data_spec),
-            out_specs=(P(), param_spec, last_spec, data_spec),
+            out_specs=((P(), P(), param_spec, last_spec, data_spec)
+                       if with_aux else
+                       (P(), param_spec, last_spec, data_spec)),
             check_rep=False,
         )
         def _pipe(params_local, last_p, x_full, tgt_full):
@@ -271,14 +325,18 @@ class _Closure:
 
             def tick(t, carry):
                 (recv_f, recv_b, ring, d_params, d_last, d_x_micro,
-                 loss_sum) = carry
+                 loss_sum, aux_sum) = carry
 
                 # ---- forward phase: stage s, microbatch t - s ----
                 mb_f = t - stage
                 act_f = (mb_f >= 0) & (mb_f < m)
                 safe_f = jnp.clip(mb_f, 0, m - 1)
                 x_in = jnp.where(stage == 0, micro[safe_f], recv_f)
-                y = stage_fn(params_here, x_in)
+                res_f = stage_fn(params_here, x_in)
+                y, aux_f = res_f if with_aux else (res_f, jnp.float32(0.0))
+                aux_sum = aux_sum + jnp.where(
+                    act_f, aux_f.astype(jnp.float32), 0.0
+                )
                 y = jnp.where(act_f, y, jnp.zeros_like(y))
                 # last stage: apply head+loss now and seed the cotangent
                 loss_j, vjp_last = jax.vjp(
@@ -310,7 +368,14 @@ class _Closure:
                 cot = jnp.where(is_last, cot_self, recv_b)
                 cot = jnp.where(act_b, cot, jnp.zeros_like(cot))
                 _, vjp_stage = jax.vjp(stage_fn, params_here, x_saved)
-                d_p_j, d_x_j = vjp_stage(cot.astype(y.dtype))
+                if with_aux:
+                    # the aux loss enters the total directly at THIS stage:
+                    # seed its cotangent here (aux_weight / P, so that the
+                    # final 1/m scaling yields d of the (stage, mb)-mean)
+                    aux_ct = jnp.where(act_b, aux_ct_val, 0.0)
+                    d_p_j, d_x_j = vjp_stage((cot.astype(y.dtype), aux_ct))
+                else:
+                    d_p_j, d_x_j = vjp_stage(cot.astype(y.dtype))
                 d_params = jax.tree_util.tree_map(
                     lambda a, u: a + jnp.where(act_b, u.astype(jnp.float32), 0.0),
                     d_params, d_p_j,
@@ -330,15 +395,15 @@ class _Closure:
                 recv_f = jax.lax.ppermute(y, axis, perm_fwd)
                 recv_b = jax.lax.ppermute(d_x_j, axis, perm_bwd)
                 return (recv_f, recv_b, ring, d_params, d_last, d_x_micro,
-                        loss_sum)
+                        loss_sum, aux_sum)
 
             recv_f0 = jnp.zeros(mb_shape, x_full.dtype)
             recv_b0 = jnp.zeros(mb_shape, x_full.dtype)
             ring0 = jnp.zeros((w, *mb_shape), x_full.dtype)
             d_x0 = jnp.zeros((m, *mb_shape), jnp.float32)
             carry = (recv_f0, recv_b0, ring0, zeros_p, zeros_last, d_x0,
-                     jnp.float32(0.0))
-            (_, _, _, d_params, d_last, d_x_micro, loss_sum) = (
+                     jnp.float32(0.0), jnp.float32(0.0))
+            (_, _, _, d_params, d_last, d_x_micro, loss_sum, aux_sum) = (
                 jax.lax.fori_loop(0, 2 * pp + m - 2, tick, carry)
             )
 
@@ -350,6 +415,10 @@ class _Closure:
             # groups (each saw 1/ndata of the global batch)
             loss = jax.lax.psum(loss_sum, axis) * inv_m
             loss = _mean_over_data(loss, self.mesh, data_spec)
+            if with_aux:
+                aux = jax.lax.psum(aux_sum, axis) / (pp * m)
+                aux = _mean_over_data(aux, self.mesh, data_spec)
+                loss = loss + self.aux_weight * aux
 
             def _reduce_grad(a, spec):
                 """Cross-member reduction for one weight-grad leaf.
@@ -394,13 +463,21 @@ class _Closure:
                 axis,
             ) * (inv_m / ndata)
             d_x = d_x.reshape(m * mb_shape[0], *mb_shape[1:])
+            if with_aux:
+                return loss, aux, d_params, d_last, d_x
             return loss, d_params, d_last, d_x
 
-        loss, d_params, d_last, d_x = _pipe(stage_params, last_params, x, targets)
+        res = _pipe(stage_params, last_params, x, targets)
+        if with_aux:
+            loss, aux, d_params, d_last, d_x = res
+            out = (loss, aux)
+        else:
+            loss, d_params, d_last, d_x = res
+            out = loss
         cast = jax.tree_util.tree_map
         d_params = cast(lambda g, p: g.astype(p.dtype), d_params, stage_params)
         d_last = cast(lambda g, p: g.astype(p.dtype), d_last, last_params)
-        return loss, (d_params, d_last, d_x.astype(x.dtype))
+        return out, (d_params, d_last, d_x.astype(x.dtype))
 
 
 def _mean_over_data(value, mesh: Mesh, data_spec: P):

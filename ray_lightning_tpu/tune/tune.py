@@ -269,6 +269,12 @@ def run(
             - resources_per_trial.bundles[0].get("CPU", 0.0),
             0.0,
         )
+        if nested_cpus == 0.0:
+            # head-bundle-only factory: exporting RLT_NUM_CPUS=0 would give
+            # nested worker spawns a zero-CPU runtime that queues forever;
+            # leave the nested runtime to size itself and let the trial
+            # driver's own bundle govern placement
+            nested_cpus = None
 
     def _demand_fits_now() -> bool:
         # the trial actor's reservation must land on ONE node — aggregate

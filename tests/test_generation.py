@@ -57,6 +57,18 @@ def test_generate_greedy_matches_iterated_full_forward():
     assert bool(jnp.all(out == seq)), (out.tolist(), seq.tolist())
 
 
+def test_generate_accepts_deprecated_pad_id():
+    """pad_id= survived from the teacher-forcing signature: accepted with a
+    DeprecationWarning (ignored — dense prompts have no padding) instead of
+    a TypeError breaking existing callers."""
+    cfg = _cfg()
+    params = init_params(jax.random.key(1), cfg)
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    with pytest.warns(DeprecationWarning, match="pad_id"):
+        out = generate(params, prompt, cfg, max_new_tokens=2, pad_id=0)
+    assert out.shape == (1, 5)
+
+
 def test_generate_temperature_sampling_runs():
     cfg = _cfg()
     params = init_params(jax.random.key(2), cfg)

@@ -108,6 +108,67 @@ def test_optimizer_translation():
     assert np.isfinite(np.asarray(u2["w"])).all()
 
 
+def test_multi_param_group_optimizer_refused():
+    """configure_optimizers with several param_groups (bias/norm exclusion)
+    must refuse at adapt time — group-0 hyperparameters silently applied
+    to every parameter would change training."""
+
+    class TwoGroups(PlStyleMLP):
+        def configure_optimizers(self):
+            decay, no_decay = [], []
+            for name, p in self.named_parameters():
+                (no_decay if "bias" in name else decay).append(p)
+            return torch.optim.AdamW(
+                [{"params": decay, "weight_decay": 0.1},
+                 {"params": no_decay, "weight_decay": 0.0}],
+                lr=1e-3,
+            )
+
+    with pytest.raises(UnsupportedTorchOp, match="param_groups"):
+        torch_optimizer_to_optax(TwoGroups())
+
+
+def test_functional_dropout_sites_get_distinct_keys():
+    """Two F.dropout calls in one forward must use different PRNG keys —
+    identical masks on equal shapes silently correlate the regularization."""
+    import torch.nn.functional as F
+
+    class DoubleDropout(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(32, 32)
+            self.criterion = nn.MSELoss()
+
+        def forward(self, x):
+            x = F.dropout(x, p=0.5, training=self.training)
+            y = F.dropout(torch.zeros_like(x), p=0.5, training=self.training)
+            return self.fc(x + y)
+
+        def configure_optimizers(self):
+            return torch.optim.Adam(self.parameters(), lr=1e-3)
+
+    x = jnp.ones((64, 32))
+    rng = jax.random.key(0)
+
+    # same rng, same input, two F.dropout sites: with a SHARED key the
+    # masks are identical and a - b is exactly zero everywhere
+    class SameInputDouble(DoubleDropout):
+        def forward(self, x):
+            a = F.dropout(x, p=0.5, training=self.training)
+            b = F.dropout(x, p=0.5, training=self.training)
+            return a - b
+
+    probe = adapt_torch_module(SameInputDouble())
+    params = probe.init_params(None)
+    diff = probe.forward(params, x, dropout_rng=rng, train=True)
+    assert float(jnp.max(jnp.abs(diff))) > 0.0, (
+        "both F.dropout sites produced identical masks (shared rng key)"
+    )
+    # determinism: the same rng reproduces the same masks
+    diff2 = probe.forward(params, x, dropout_rng=rng, train=True)
+    assert np.allclose(np.asarray(diff), np.asarray(diff2))
+
+
 def test_unsupported_layer_fails_at_adapt_time():
     class WithGRU(nn.Module):
         def __init__(self):
