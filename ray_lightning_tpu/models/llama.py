@@ -576,6 +576,28 @@ def _stage_specs_with_fsdp(cfg: LlamaConfig, layer_params: Dict[str, Any],
     return specs, dims
 
 
+def _pp_embed_lookup(params: Dict[str, Any], tokens: jnp.ndarray,
+                     mesh: Mesh) -> jnp.ndarray:
+    """Token-embedding gather for the pipeline paths.
+
+    The table rests sharded P(None, ('fsdp', 'tp')) while the pipeline's
+    data spec wants the gather output batch-sharded over ('dp', 'fsdp')
+    with D replicated — 'fsdp' must MOVE from the table's D dim to the
+    output's batch dim, a dim-moving reshard XLA's SPMD partitioner can
+    only perform by full rematerialization (replicate + repartition; it
+    warns "Involuntary full rematerialization", burning HBM bandwidth on
+    the activation every step). All-gathering the TABLE over 'fsdp' first
+    keeps the gather local: the output lands batch-sharded directly and
+    only a cheap same-dim all-gather over 'tp' remains
+    (tests/test_llama.py::test_pp_fsdp_embed_gather_has_no_full_remat)."""
+    embed = params["embed"]
+    if "fsdp" in mesh.axis_names and mesh.shape["fsdp"] > 1:
+        embed = jax.lax.with_sharding_constraint(
+            embed, NamedSharding(mesh, _filter_spec(P(None, "tp"), mesh))
+        )
+    return embed[tokens]
+
+
 def _forward_pp(
     params: Dict[str, Any],
     tokens: jnp.ndarray,
@@ -599,7 +621,7 @@ def _forward_pp(
     sp = mesh.shape["sp"] if "sp" in mesh.axis_names else 1
     fsdp = mesh.shape["fsdp"] if "fsdp" in mesh.axis_names else 1
     _, S = tokens.shape
-    x = params["embed"][tokens]
+    x = _pp_embed_lookup(params, tokens, mesh)
     stage_fn, stage_params, m, data_spec, stage_spec = _pp_stage_setup(
         params, cfg, mesh, S, tp=tp, sp=sp, fsdp=fsdp
     )
@@ -699,7 +721,7 @@ def _lm_loss_pp_1f1b(
     sp = mesh.shape["sp"] if "sp" in mesh.axis_names else 1
     fsdp = mesh.shape["fsdp"] if "fsdp" in mesh.axis_names else 1
     _, S = tokens.shape
-    x = params["embed"][tokens]
+    x = _pp_embed_lookup(params, tokens, mesh)
     targets = jnp.roll(tokens, -1, axis=1)
     stage_fn, stage_params, m, data_spec, stage_spec = _pp_stage_setup(
         params, cfg, mesh, S, tp=tp, schedule="1f1b", sp=sp, fsdp=fsdp

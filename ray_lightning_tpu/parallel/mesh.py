@@ -88,8 +88,9 @@ def build_mesh(
     axes = spec.resolved(len(devices))
     names = tuple(axes)
     shape = tuple(axes[n] for n in names)
+    hybrid = spec.dcn_axes and jax.process_count() > 1
     try:
-        if spec.dcn_axes and jax.process_count() > 1:
+        if hybrid:
             ici_shape = tuple(
                 1 if n in spec.dcn_axes else axes[n] for n in names
             )
@@ -102,5 +103,34 @@ def build_mesh(
         else:
             arr = mesh_utils.create_device_mesh(shape, devices=devices)
     except Exception:
-        arr = np.asarray(devices).reshape(shape)
+        if hybrid:
+            # the fallback must PRESERVE the dcn contract (dcn axes span
+            # processes/slices, ici axes stay within one): order devices
+            # by process, lay the dcn axes slowest-varying, then transpose
+            # into the caller's axis order. A plain reshape would put
+            # whichever axis happens to be first across processes.
+            ici = int(np.prod([
+                axes[n] for n in names if n not in spec.dcn_axes
+            ]))
+            per_proc: Dict[int, int] = {}
+            for d in devices:
+                per_proc[d.process_index] = per_proc.get(d.process_index, 0) + 1
+            if set(per_proc.values()) != {ici}:
+                # an ici axis would cross a process boundary — its
+                # collectives would silently ride DCN, the exact perf
+                # cliff dcn_axes exists to prevent
+                raise ValueError(
+                    f"dcn_axes {spec.dcn_axes}: ici axes need "
+                    f"{ici} devices per process, but processes hold "
+                    f"{sorted(per_proc.values())}; adjust the mesh axes "
+                    "or dcn_axes to match the slice topology"
+                )
+            devs = sorted(devices, key=lambda d: (d.process_index, d.id))
+            order = [n for n in names if n in spec.dcn_axes] + [
+                n for n in names if n not in spec.dcn_axes
+            ]
+            arr = np.asarray(devs).reshape([axes[n] for n in order])
+            arr = arr.transpose([order.index(n) for n in names])
+        else:
+            arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, names)

@@ -408,6 +408,53 @@ def test_train_pp_ep_mesh(tmp_root, no_xla_cache):
     assert "val_moe_aux" in trainer.callback_metrics
 
 
+@pytest.mark.slow
+def test_pp_fsdp_embed_gather_has_no_full_remat(tmp_root):
+    """The pp x fsdp token-embedding gather must not trigger XLA's
+    "Involuntary full rematerialization" (fsdp moving from the table's D
+    dim to the output's batch dim): _pp_embed_lookup all-gathers the table
+    over fsdp first so the gather stays local. The warning is a compiler
+    stderr log, so compile in a subprocess and scan it."""
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent("""
+        import os
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        import jax; jax.config.update("jax_platforms", "cpu")
+        import dataclasses
+        import jax.numpy as jnp
+        from ray_lightning_tpu.models.llama import (
+            LlamaConfig, init_params, lm_loss, shardings_for_mesh,
+        )
+        from ray_lightning_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        for schedule in ("gpipe", "1f1b"):
+            cfg = dataclasses.replace(
+                LlamaConfig.tiny(), dtype=jnp.float32, pp_microbatches=2,
+                pp_schedule=schedule,
+            )
+            mesh = build_mesh(MeshSpec(axes={"pp": 2, "fsdp": 2, "dp": 2}))
+            params = init_params(jax.random.key(0), cfg)
+            sh = shardings_for_mesh(cfg, mesh)
+            params = jax.tree_util.tree_map(jax.device_put, params, sh)
+            tokens = jnp.zeros((8, cfg.max_seq), jnp.int32)
+            jax.jit(
+                jax.grad(lambda p: lm_loss(p, tokens, cfg, mesh)[0])
+            ).lower(params).compile()
+        print("COMPILED-OK")
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=560,
+    )
+    assert "COMPILED-OK" in proc.stdout, proc.stderr[-2000:]
+    assert "Involuntary full rematerialization" not in proc.stderr, (
+        "XLA full-remat warning is back:\n" + proc.stderr[-2000:]
+    )
+
+
 def test_pp_1f1b_fsdp_matches_dense_loss_and_grads():
     """1F1B composed with ZeRO-3-in-stage (pp=2 x fsdp=2 x dp=2): under
     the manual VJP the per-layer all_gather transposes to a psum_scatter

@@ -231,6 +231,65 @@ def test_client_mode_init_requires_authkey():
 
 
 @pytest.mark.slow
+def test_hybrid_dcn_mesh_spans_processes(tmp_root):
+    """MeshSpec.dcn_axes on a REAL 2-process run (RayStrategy workers each
+    own 2 devices): the mesh must lay the dcn axis ('dp') ACROSS the two
+    worker processes — its collectives would ride DCN on multi-slice
+    hardware — while the ici axis ('fsdp') stays inside one process. This
+    exercises parallel/mesh.py's create_hybrid_device_mesh branch, which
+    only activates at jax.process_count() > 1."""
+    import json
+
+    from ray_lightning_tpu.parallel.mesh import MeshSpec
+    from ray_lightning_tpu.parallel.sharding import ShardingPolicy
+
+    from tests.utils import BoringModel, get_trainer
+
+    marker = os.path.join(tmp_root, "mesh_layout.json")
+
+    class RecordMeshModel(BoringModel):
+        def on_fit_start(self):
+            import jax as j
+
+            mesh = self.trainer.strategy.mesh
+            if j.process_index() == 0 and mesh is not None:
+                layout = [
+                    [int(d.process_index) for d in row]
+                    for row in mesh.devices
+                ]
+                with open(marker, "w") as f:
+                    json.dump(
+                        {
+                            "axis_names": list(mesh.axis_names),
+                            "layout": layout,
+                            "process_count": j.process_count(),
+                        },
+                        f,
+                    )
+
+    strategy = RayStrategy(
+        num_workers=2, platform="cpu", devices_per_worker=2,
+        mesh_spec=MeshSpec(axes={"dp": 2, "fsdp": 2}, dcn_axes=("dp",)),
+        sharding_policy=ShardingPolicy(data_axes=("dp",)),
+    )
+    trainer = get_trainer(
+        tmp_root, max_epochs=1, strategy=strategy, checkpoint_callback=False
+    )
+    trainer.fit(RecordMeshModel())
+    assert trainer.state.status == "finished"
+    with open(marker) as f:
+        rec = json.load(f)
+    assert rec["process_count"] == 2
+    assert rec["axis_names"] == ["dp", "fsdp"]
+    layout = rec["layout"]  # [dp][fsdp] -> process index
+    # dcn axis 'dp': the two dp rows live on DIFFERENT processes
+    assert layout[0][0] != layout[1][0], layout
+    # ici axis 'fsdp': within a dp row, one process only
+    assert layout[0][0] == layout[0][1], layout
+    assert layout[1][0] == layout[1][1], layout
+
+
+@pytest.mark.slow
 def test_client_mode_fit(node_agent, tmp_root):
     """Ray-Client parity (reference tests/test_client.py:17-23): the driver
     contributes zero resources; the example's train function runs with every
