@@ -35,10 +35,12 @@ flax-batch_stats contract) and are masked out of the optimizer.
 Data-dependent Python control flow inside ``forward``, custom autograd
 functions, or unmapped layers raise :class:`UnsupportedTorchOp` at
 ADAPT time — loudly, with the offending node named — never silently at
-train time. A custom ``training_step`` body is not traced; its
-near-universal shape (forward -> criterion -> log) is what the
-adapter's step provides, and ``step_fn=`` overrides it for anything
-else.
+train time. A custom ``training_step`` body IS traced (loss functionals,
+criterion submodules, auxiliary loss terms; ``self.log`` inlines away);
+an untraceable body (manual optimization, data-dependent control flow)
+refuses at adapt time pointing at ``step_fn=`` — the bridge never
+silently substitutes forward -> criterion semantics for a step the user
+customized.
 """
 from __future__ import annotations
 
@@ -177,8 +179,66 @@ def _batch_norm(p, prefix, x, mod, train, updates):
     return y.astype(x.dtype)
 
 
+def _trace_step_method(module, method: str = "training_step"):
+    """Symbolically trace ``module.<method>((x, y), batch_idx)`` with the
+    module itself as the fx root (param names keep their state_dict keys).
+    ``self.log``/``self.log_dict`` are patched to no-ops for the duration
+    — fx inlines them to nothing; the adapter's own step re-logs the
+    loss. The batch is specialized to an (x, y) pair."""
+    from torch.fx._symbolic_trace import PH
+
+    class _StepTracer(torch.fx.Tracer):
+        traced_func_name = method
+
+    def _canon(graph):
+        # guard nodes (eq + _assert) embed the specialized VALUE; exclude
+        # them so only real semantic differences remain
+        skip = (operator.eq, torch._assert)
+        return [
+            (n.op, str(n.target), str(n.args), str(n.kwargs))
+            for n in graph.nodes
+            if not (n.op == "call_function" and n.target in skip)
+        ]
+
+    cls = type(module)
+    sentinel = object()
+    saved = {}
+    for name in ("log", "log_dict"):
+        saved[name] = cls.__dict__.get(name, sentinel)
+        setattr(cls, name, lambda self, *a, **k: None)
+    try:
+        tracer = _StepTracer()
+        graph = tracer.trace(
+            module, concrete_args={"batch": (PH, PH), "batch_idx": 0}
+        )
+        # a step that USES batch_idx constant-folds it invisibly (python
+        # arithmetic on the concrete int leaves no node); re-trace with
+        # different values — any graph difference means the step's math
+        # depends on batch_idx and would silently run as step 0. (A
+        # heuristic: pathological f with f(0)==f(1)==f(7) still slips by.)
+        for probe in (1, 7):
+            g2 = _StepTracer().trace(
+                module, concrete_args={"batch": (PH, PH), "batch_idx": probe}
+            )
+            if _canon(graph) != _canon(g2):
+                raise UnsupportedTorchOp(
+                    f"{method} uses batch_idx, which tracing specializes "
+                    "to a constant"
+                )
+        return torch.fx.GraphModule(tracer.root, graph)
+    finally:
+        for name, orig in saved.items():
+            if orig is sentinel:
+                delattr(cls, name)
+            else:
+                setattr(cls, name, orig)
+
+
 def fx_to_jax(
     module,
+    trace_training_step: bool = False,
+    extract_params: bool = True,
+    step_method: str = "training_step",
 ) -> Tuple[Callable, Dict[str, jnp.ndarray], Tuple[str, ...]]:
     """Trace ``module.forward`` with torch.fx and build
     ``apply(params, *inputs, dropout_rng=None, train=False) ->
@@ -188,22 +248,61 @@ def fx_to_jax(
     preserved for lossless round-trip — but must be masked out of the
     optimizer; ``state_updates`` carries their forward-mutated values).
 
+    ``trace_training_step``: trace the module's ``training_step`` instead
+    — ``apply(params, x, y, ...)`` then returns the step's own loss (the
+    user's custom loss math, aux terms and all), not the forward output.
+
     Raises :class:`UnsupportedTorchOp` naming the first unmappable node.
     """
-    gm = torch.fx.symbolic_trace(module)
+    if trace_training_step:
+        gm = _trace_step_method(module, step_method)
+    else:
+        gm = torch.fx.symbolic_trace(module)
     modules = dict(gm.named_modules())
+    n_placeholders = sum(
+        1 for n in gm.graph.nodes if n.op == "placeholder"
+    )
+    out_spec = None
+    if trace_training_step:
+        # a step whose only effect was self.log(...) traces to a None
+        # return — its semantics (which metrics, which names) are gone
+        def _contains_node(a):
+            if isinstance(a, torch.fx.Node):
+                return True
+            if isinstance(a, (tuple, list)):
+                return any(_contains_node(x) for x in a)
+            if isinstance(a, dict):
+                return any(_contains_node(v) for v in a.values())
+            return False
+
+        out_node = next(n for n in gm.graph.nodes if n.op == "output")
+        if not _contains_node(out_node.args):
+            raise UnsupportedTorchOp(
+                f"{step_method} returns no value (its logs cannot be "
+                "traced); return the loss"
+            )
+        # pytree-aware tracing flattens the step's return; keep the spec
+        # so dict returns ({'loss': ..., ...}) reassemble
+        codegen = getattr(gm.graph, "_codegen", None)
+        pytree_info = getattr(codegen, "pytree_info", None)
+        out_spec = getattr(pytree_info, "out_spec", None)
 
     params: Dict[str, jnp.ndarray] = {}
     trainable = []
-    for name, p in module.named_parameters():
-        params[name] = jnp.asarray(_np(p))
-        trainable.append(name)
+    if extract_params:
+        # skipped for a SECOND trace of the same module (the step trace):
+        # the caller already holds the converted pytree — re-converting
+        # every weight would double adapt latency and host memory
+        for name, p in module.named_parameters():
+            params[name] = jnp.asarray(_np(p))
+            trainable.append(name)
     consts: Dict[str, jnp.ndarray] = {}
     for name, b in module.named_buffers():
         arr = _np(b)
         if np.issubdtype(arr.dtype, np.floating):
             # float buffers (running stats) thread through the step
-            params[name] = jnp.asarray(arr)
+            if extract_params:
+                params[name] = jnp.asarray(arr)
         else:
             # int buffers (num_batches_tracked) would break value_and_grad
             # over the pytree; they stay static (torch side keeps its own)
@@ -212,6 +311,12 @@ def fx_to_jax(
     def apply(p: Dict[str, jnp.ndarray], *inputs, dropout_rng=None,
               train: bool = False):
         env: Dict[str, Any] = {}
+        if trace_training_step and len(inputs) < n_placeholders:
+            # concrete_args specialization (batch_idx=0) leaves guarded
+            # placeholders in the graph; feed their specialized value.
+            # (Never pad a plain forward trace — a missing input there is
+            # a caller bug that must fail, not become a silent 0.)
+            inputs = inputs + (0,) * (n_placeholders - len(inputs))
         it = iter(inputs)
         rng = dropout_rng
         updates: Dict[str, jnp.ndarray] = {}
@@ -235,10 +340,26 @@ def fx_to_jax(
                     raise UnsupportedTorchOp(f"get_attr {target!r} not found")
             elif node.op == "call_module":
                 mod = modules[node.target]
-                x = look(node.args[0])
-                env[node.name] = _call_module(
-                    p, str(node.target), mod, x, rng, train, updates
-                )
+                if isinstance(mod, _loss_module_types()):
+                    # criterion submodules take (out, target), not one
+                    # input — positionally or by keyword
+                    cargs = look(node.args)
+                    ckw = look(dict(node.kwargs))
+                    out_v = cargs[0] if cargs else ckw.pop("input", None)
+                    y_v = (
+                        cargs[1] if len(cargs) > 1 else ckw.pop("target", None)
+                    )
+                    if out_v is None or y_v is None or ckw:
+                        raise UnsupportedTorchOp(
+                            f"criterion call {node.target!r}: unsupported "
+                            f"arguments {sorted(ckw)}; pass step_fn="
+                        )
+                    env[node.name] = torch_loss_to_jax(mod)(out_v, y_v)
+                else:
+                    x = look(node.args[0])
+                    env[node.name] = _call_module(
+                        p, str(node.target), mod, x, rng, train, updates
+                    )
                 if isinstance(mod, nn.Dropout) and rng is not None:
                     rng, _ = jax.random.split(rng)
             elif node.op == "call_function":
@@ -261,7 +382,17 @@ def fx_to_jax(
                     look(dict(node.kwargs)),
                 )
             elif node.op == "output":
-                return look(node.args[0]), updates
+                out = look(node.args[0])
+                if trace_training_step and isinstance(out, (list, tuple)):
+                    if out_spec is not None:
+                        # reassemble the step's real return shape (scalar,
+                        # or pl's documented {'loss': ..., ...} dict)
+                        import torch.utils._pytree as _pt
+
+                        out = _pt.tree_unflatten(list(out), out_spec)
+                    elif len(out) == 1:
+                        out = out[0]
+                return out, updates
         raise AssertionError("fx graph had no output node")
 
     # eagerly validate the graph against the supported set: adapt-time
@@ -270,11 +401,18 @@ def fx_to_jax(
         if node.op == "call_module":
             _check_module(modules[node.target], node.target)
         elif node.op == "call_function":
-            _check_function(node.target)
+            _check_function(node.target, node)
         elif node.op == "call_method":
             _check_method(node.target)
 
     return apply, params, tuple(trainable)
+
+
+def _loss_module_types():
+    return (
+        nn.CrossEntropyLoss, nn.MSELoss, nn.L1Loss, nn.BCEWithLogitsLoss,
+        nn.NLLLoss,
+    )
 
 
 def _check_module(mod, name):
@@ -283,7 +421,12 @@ def _check_module(mod, name):
         nn.LeakyReLU, nn.Softplus, nn.LayerNorm, nn.Embedding, nn.Dropout,
         nn.Flatten, nn.Identity, nn.Conv2d, nn.MaxPool2d, nn.AvgPool2d,
         nn.Softmax, nn.LogSoftmax, nn.BatchNorm1d, nn.BatchNorm2d,
-    )
+    ) + _loss_module_types()
+    if isinstance(mod, _loss_module_types()):
+        # criterion options (label_smoothing, weight, reduction) change
+        # the math the jax mapping reproduces — refuse at adapt time
+        _validate_loss_module_options(mod, type(mod).__name__)
+        return
     if not isinstance(mod, supported):
         raise UnsupportedTorchOp(
             f"layer {name!r} ({type(mod).__name__}) is not in the bridge's "
@@ -375,8 +518,52 @@ def _build_function_map():
         F.avg_pool2d: lambda x, k, stride=None, padding=0: _pool2d(
             x, k, stride, padding, "avg"
         ),
+        # loss functionals: traced training_step bodies call these
+        # directly; the math comes from _LOSS_IMPLS, shared with the
+        # criterion-module path (torch_loss_to_jax) so they cannot diverge
+        F.cross_entropy: _loss_functional("cross_entropy"),
+        F.mse_loss: _loss_functional("mse_loss"),
+        F.l1_loss: _loss_functional("l1_loss"),
+        F.binary_cross_entropy_with_logits: _loss_functional(
+            "binary_cross_entropy_with_logits"
+        ),
+        F.nll_loss: _loss_functional("nll_loss"),
+        # guard nodes fx inserts for concrete_args (batch_idx specialization)
+        operator.eq: operator.eq,
+        torch._assert: lambda cond, msg=None: None,
     }
     return m
+
+
+# torch loss-functional defaults that the jax mappings above reproduce; any
+# OTHER value silently changes the math, so it must refuse at adapt time
+_LOSS_DEFAULTS = {
+    "weight": None, "size_average": None, "reduce": None,
+    "reduction": "mean", "ignore_index": -100, "label_smoothing": 0.0,
+    "pos_weight": None,
+}
+
+
+def _loss_functional(name):
+    def wrapped(*args, **kwargs):
+        jfn = _LOSS_IMPLS[name]
+        out = args[0] if len(args) > 0 else kwargs.pop("input")
+        y = args[1] if len(args) > 1 else kwargs.pop("target")
+        for k, v in kwargs.items():
+            if v is None or isinstance(v, (bool, int, float, str)):
+                if k in _LOSS_DEFAULTS and v is not None and v != _LOSS_DEFAULTS[k]:
+                    raise UnsupportedTorchOp(
+                        f"F.{name}({k}={v!r}): only the default is mapped; "
+                        "pass step_fn= for custom loss options"
+                    )
+            else:  # arrays (weight=, pos_weight=) change the math
+                raise UnsupportedTorchOp(
+                    f"F.{name}({k}=<tensor>): not mapped; pass step_fn="
+                )
+        return jfn(out, y)
+
+    wrapped._rlt_loss_name = name
+    return wrapped
 
 
 def _torch_mean(x, dim=None, keepdim=False):
@@ -438,13 +625,36 @@ def _dropout_site_active(node) -> bool:
     return training is not False
 
 
-def _check_function(target):
+def _check_function(target, node=None):
     import torch.nn.functional as F
 
     if target not in _function_map():
         raise UnsupportedTorchOp(f"call_function {target!r}")
     if target is F.dropout:
         return
+    name = getattr(_function_map().get(target), "_rlt_loss_name", None)
+    if name is not None and node is not None:
+        # refuse non-default loss options at ADAPT time (the comment-level
+        # contract): a tensor kwarg appears as an fx Node, a scalar one as
+        # a literal — both change the math the jax mapping reproduces
+        for i, a in enumerate(node.args[2:], start=2):
+            if a is not None:
+                raise UnsupportedTorchOp(
+                    f"F.{name}: positional argument {i} is not mapped; "
+                    "pass step_fn= for custom loss options"
+                )
+        for k, v in node.kwargs.items():
+            if k in ("input", "target"):
+                continue
+            if isinstance(v, torch.fx.Node):
+                raise UnsupportedTorchOp(
+                    f"F.{name}({k}=<tensor>): not mapped; pass step_fn="
+                )
+            if k in _LOSS_DEFAULTS and v is not None and v != _LOSS_DEFAULTS[k]:
+                raise UnsupportedTorchOp(
+                    f"F.{name}({k}={v!r}): only the default is mapped; "
+                    "pass step_fn= for custom loss options"
+                )
 
 
 def _call_function(target, args, kwargs, rng):
@@ -509,38 +719,75 @@ def _call_method(name, self_val, args, kwargs):
 # --------------------------------------------------------------------- #
 # criterion / optimizer translation
 # --------------------------------------------------------------------- #
+# single source of truth for the loss math — the functional path
+# (_loss_functional entries in the function map) and the criterion-module
+# path (torch_loss_to_jax) must never diverge
+_LOSS_IMPLS: Dict[str, Callable] = {
+    "cross_entropy": lambda out, y: (
+        optax.softmax_cross_entropy_with_integer_labels(
+            out.astype(jnp.float32), y
+        ).mean()
+    ),
+    "mse_loss": lambda out, y: jnp.mean((out.astype(jnp.float32) - y) ** 2),
+    "l1_loss": lambda out, y: jnp.mean(jnp.abs(out.astype(jnp.float32) - y)),
+    "binary_cross_entropy_with_logits": lambda out, y: (
+        optax.sigmoid_binary_cross_entropy(out.astype(jnp.float32), y).mean()
+    ),
+    "nll_loss": lambda out, y: -jnp.mean(
+        jnp.take_along_axis(out.astype(jnp.float32), y[:, None], axis=-1)[:, 0]
+    ),
+}
+
+_LOSS_MODULE_NAMES = {
+    "CrossEntropyLoss": "cross_entropy",
+    "MSELoss": "mse_loss",
+    "L1Loss": "l1_loss",
+    "BCEWithLogitsLoss": "binary_cross_entropy_with_logits",
+    "NLLLoss": "nll_loss",
+}
+
+
+def _validate_loss_module_options(criterion, name: str) -> None:
+    """A criterion constructed with non-default options (label_smoothing,
+    weight, reduction='sum', ...) computes DIFFERENT math than the mapped
+    jax loss — refuse, never silently drop the option."""
+    for attr, default in (
+        ("reduction", "mean"),
+        ("label_smoothing", 0.0),
+        ("ignore_index", -100),
+    ):
+        v = getattr(criterion, attr, default)
+        if v != default:
+            raise UnsupportedTorchOp(
+                f"{name}({attr}={v!r}): only the default is mapped; pass "
+                "loss_fn=/step_fn= for custom loss options"
+            )
+    for attr in ("weight", "pos_weight"):
+        if getattr(criterion, attr, None) is not None:
+            raise UnsupportedTorchOp(
+                f"{name}({attr}=...): not mapped; pass loss_fn=/step_fn="
+            )
+
+
 def torch_loss_to_jax(criterion) -> Callable:
     """Map a torch criterion (instance or functional) to a
     ``loss(outputs, targets) -> scalar`` jax function."""
-    import torch.nn.functional as F
-
-    name = (
-        type(criterion).__name__ if isinstance(criterion, nn.Module)
-        else getattr(criterion, "__name__", str(criterion))
-    )
-    if name in ("CrossEntropyLoss", "cross_entropy"):
-        return lambda out, y: optax.softmax_cross_entropy_with_integer_labels(
-            out.astype(jnp.float32), y
-        ).mean()
-    if name in ("MSELoss", "mse_loss"):
-        return lambda out, y: jnp.mean((out.astype(jnp.float32) - y) ** 2)
-    if name in ("L1Loss", "l1_loss"):
-        return lambda out, y: jnp.mean(jnp.abs(out.astype(jnp.float32) - y))
-    if name in ("BCEWithLogitsLoss", "binary_cross_entropy_with_logits"):
-        return lambda out, y: optax.sigmoid_binary_cross_entropy(
-            out.astype(jnp.float32), y
-        ).mean()
-    if name in ("NLLLoss", "nll_loss"):
-        return lambda out, y: -jnp.mean(
-            jnp.take_along_axis(
-                out.astype(jnp.float32), y[:, None], axis=-1
-            )[:, 0]
-        )
+    if isinstance(criterion, nn.Module):
+        name = type(criterion).__name__
+        key = _LOSS_MODULE_NAMES.get(name)
+        if key is not None:
+            _validate_loss_module_options(criterion, name)
+            return _LOSS_IMPLS[key]
+    else:
+        key = getattr(criterion, "__name__", str(criterion))
+        if key in _LOSS_IMPLS:
+            return _LOSS_IMPLS[key]
     if callable(criterion) and not isinstance(criterion, nn.Module):
         # assume an already-jax-compatible callable
         return criterion
     raise UnsupportedTorchOp(
-        f"criterion {name!r}; pass loss_fn= with a jax-compatible callable"
+        f"criterion {type(criterion).__name__!r}; pass loss_fn= with a "
+        "jax-compatible callable"
     )
 
 
@@ -629,6 +876,35 @@ def _torch_scheduler_to_optax(sched, lr, total_steps):
     if kind == "CosineAnnealingLR":
         steps = total_steps or sched.T_max
         return optax.cosine_decay_schedule(lr, decay_steps=steps)
+    if kind == "ExponentialLR":
+        return optax.exponential_decay(
+            lr, transition_steps=1, decay_rate=sched.gamma
+        )
+    if kind == "OneCycleLR":
+        # the ctor kwargs (pct_start, div_factor, ...) are NOT stored as
+        # attributes; torch resolves them into param_groups (initial_lr /
+        # max_lr / min_lr) and _schedule_phases (warmup end step)
+        steps = sched.total_steps
+        g = sched.optimizer.param_groups[0]
+        max_lr, init, final = g["max_lr"], g["initial_lr"], g["min_lr"]
+        phases = getattr(sched, "_schedule_phases", None)
+        warm = (
+            max(1, int(phases[0]["end_step"]) + 1)
+            if phases
+            else max(1, int(steps * 0.3))
+        )
+        if getattr(sched, "_anneal_func_type", "cos") == "linear":
+            return optax.join_schedules(
+                [
+                    optax.linear_schedule(init, max_lr, warm),
+                    optax.linear_schedule(max_lr, final, steps - warm),
+                ],
+                boundaries=[warm],
+            )
+        return optax.warmup_cosine_decay_schedule(
+            init_value=init, peak_value=max_lr, warmup_steps=warm,
+            decay_steps=steps, end_value=final,
+        )
     warnings.warn(
         f"lr scheduler {kind!r} is not translated; using constant lr={lr}"
     )
@@ -650,6 +926,18 @@ class TorchModuleAdapter(LightningModule):
     ``self.loss_fn`` on the torch module). ``step_fn(adapter, params,
     batch)`` overrides the default (x, y) -> criterion(forward(x), y)
     step entirely.
+
+    A user-defined ``training_step`` on the torch module is TRACED (its
+    custom loss math, auxiliary terms, functional/criterion losses, pl's
+    dict return all compile to the jax step; ``self.log`` calls inline
+    away and the adapter re-logs the loss). A user-defined
+    ``validation_step`` is traced the same way and drives ``val_loss``.
+    If a body cannot be traced — manual optimization, data-dependent
+    control flow, ``batch_idx``-dependent math, unmapped ops, non-default
+    loss options — the adapter refuses at ADAPT time pointing at
+    ``step_fn=``; it never silently substitutes different semantics.
+    ``ignore_training_step=True`` / ``ignore_validation_step=True`` opt
+    back into the plain forward -> criterion step/validation.
     """
 
     def __init__(
@@ -658,6 +946,8 @@ class TorchModuleAdapter(LightningModule):
         loss_fn: Optional[Any] = None,
         step_fn: Optional[Callable] = None,
         total_steps: Optional[int] = None,
+        ignore_training_step: bool = False,
+        ignore_validation_step: bool = False,
     ):
         if not TORCH_AVAILABLE:
             raise RuntimeError("torch is not installed")
@@ -666,17 +956,56 @@ class TorchModuleAdapter(LightningModule):
         self._apply_fn, self._initial_params, self._trainable_keys = (
             fx_to_jax(torch_module)
         )
+        self._step_apply = None
+        self._val_apply = None
+        if (
+            step_fn is None
+            and not ignore_training_step
+            and _user_defined_method(torch_module, "training_step")
+        ):
+            try:
+                self._step_apply, _, _ = fx_to_jax(
+                    torch_module, trace_training_step=True,
+                    extract_params=False,
+                )
+            except Exception as e:
+                raise UnsupportedTorchOp(
+                    "the module defines its own training_step but it could "
+                    f"not be traced ({type(e).__name__}: {e}); the bridge "
+                    "will not silently substitute forward -> criterion "
+                    "semantics. Pass step_fn= to express the step in jax, "
+                    "or ignore_training_step=True if the default step is "
+                    "actually equivalent"
+                ) from e
+        if (
+            step_fn is None
+            and not ignore_validation_step
+            and _user_defined_method(torch_module, "validation_step")
+        ):
+            try:
+                self._val_apply, _, _ = fx_to_jax(
+                    torch_module, trace_training_step=True,
+                    extract_params=False, step_method="validation_step",
+                )
+            except Exception as e:
+                raise UnsupportedTorchOp(
+                    "the module defines its own validation_step but it "
+                    f"could not be traced ({type(e).__name__}: {e}); pass "
+                    "ignore_validation_step=True for the default "
+                    "forward -> criterion validation, or step_fn= for full "
+                    "control"
+                ) from e
         criterion = (
             loss_fn
             or getattr(torch_module, "criterion", None)
             or getattr(torch_module, "loss_fn", None)
         )
-        if criterion is None:
+        if criterion is None and self._step_apply is None:
             raise ValueError(
                 "no criterion found: pass loss_fn=, or set .criterion / "
                 ".loss_fn on the torch module"
             )
-        self._loss = torch_loss_to_jax(criterion)
+        self._loss = torch_loss_to_jax(criterion) if criterion is not None else None
         self._step_fn = step_fn
         self._total_steps = total_steps
         hp = getattr(torch_module, "hparams", None)
@@ -720,9 +1049,16 @@ class TorchModuleAdapter(LightningModule):
         if self._step_fn is not None:
             return self._step_fn(self, params, batch)
         x, y = self._split_batch(batch)
+        rng = self.step_rng if train else None
+        if self._step_apply is not None:
+            # the user's traced training_step computes the loss itself
+            out, updates = self._step_apply(
+                params, x, y, dropout_rng=rng, train=train
+            )
+            loss = out["loss"] if isinstance(out, dict) else out
+            return loss, None, updates
         out, updates = self.forward(
-            params, x, dropout_rng=self.step_rng if train else None,
-            train=train, with_updates=True,
+            params, x, dropout_rng=rng, train=train, with_updates=True,
         )
         return self._loss(out, y), out, updates
 
@@ -740,9 +1076,28 @@ class TorchModuleAdapter(LightningModule):
         return loss
 
     def validation_step(self, params, batch, batch_idx):
-        res = self._step(params, batch, train=False)
-        loss, out = (res[0], res[1]) if isinstance(res, tuple) else (res, None)
-        self.log("val_loss", loss)
+        if self._val_apply is not None and self._step_fn is None:
+            # the user's own traced validation_step computes val_loss
+            x, y = self._split_batch(batch)
+            out, _ = self._val_apply(params, x, y, train=False)
+            loss = out["loss"] if isinstance(out, dict) else out
+            self.log("val_loss", loss)
+            out = self.forward(params, x)
+        else:
+            res = self._step(params, batch, train=False)
+            loss, out = (
+                (res[0], res[1]) if isinstance(res, tuple) else (res, None)
+            )
+            self.log("val_loss", loss)
+            if (
+                out is None
+                and self._step_apply is not None
+                and self._step_fn is None
+            ):
+                # the traced training_step returns only its loss; recompute
+                # the forward for the accuracy metric (XLA CSE merges it
+                # with the identical subgraph inside the traced step)
+                out = self.forward(params, self._split_batch(batch)[0])
         if out is not None and out.ndim >= 2 and jnp.issubdtype(
             jnp.asarray(self._split_batch(batch)[1]).dtype, jnp.integer
         ):
@@ -793,6 +1148,20 @@ class TorchModuleAdapter(LightningModule):
         if unexpected:
             raise RuntimeError(f"unexpected keys on export: {unexpected}")
         return self.torch_module
+
+
+def _user_defined_method(torch_module, name: str) -> bool:
+    """True when ``name`` is defined by USER code — not by a framework
+    base class (pytorch-lightning's ``LightningModule`` ships warn-stub
+    ``training_step``/``validation_step`` methods; tracing those would
+    wrongly refuse an unmodified module that relies on forward+criterion)."""
+    for klass in type(torch_module).__mro__:
+        if name in klass.__dict__:
+            mod = getattr(klass, "__module__", "") or ""
+            return not mod.startswith(
+                ("pytorch_lightning", "lightning", "torch.")
+            )
+    return False
 
 
 def adapt_torch_module(torch_module, **kwargs) -> "TorchModuleAdapter":

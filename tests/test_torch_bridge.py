@@ -305,6 +305,327 @@ def _xy_loader(n=64, batch_size=16, seed=0):
     ]
 
 
+class CustomStepMLP(PlStyleMLP):
+    """A pl-style module whose training_step carries REAL custom
+    semantics: functional loss + an auxiliary activation-norm term —
+    the shape the forward -> criterion substitute would get wrong."""
+
+    aux_weight = 0.01
+
+    def log(self, *args, **kwargs):  # pl provides this; duck-typed here
+        pass
+
+    def training_step(self, batch, batch_idx):
+        import torch.nn.functional as F
+
+        x, y = batch
+        logits = self(x)
+        loss = F.cross_entropy(logits, y) + self.aux_weight * (
+            logits ** 2
+        ).mean()
+        self.log("train_loss", loss)
+        return loss
+
+
+def test_user_training_step_is_traced():
+    """A user-defined training_step compiles to the jax step with ITS
+    semantics (aux term included), matching torch's value bitwise-ish."""
+    tm = CustomStepMLP()
+    adapted = adapt_torch_module(tm)
+    assert adapted._step_apply is not None
+
+    x = np.random.default_rng(0).normal(size=(16, 32)).astype(np.float32)
+    y = np.random.default_rng(1).integers(0, 10, size=(16,))
+    tm.eval()  # dropout off on both sides
+    with torch.no_grad():
+        ref = tm.training_step(
+            (torch.from_numpy(x), torch.from_numpy(y)), 0
+        ).item()
+    loss, _, _ = adapted._step(
+        adapted.init_params(None), (jnp.asarray(x), jnp.asarray(y)),
+        train=False,
+    )
+    assert abs(float(loss) - ref) < 1e-5, (float(loss), ref)
+    # and the default step (criterion only) would NOT match: the aux term
+    # is real semantics, not noise
+    plain = adapt_torch_module(tm, ignore_training_step=True)
+    loss_plain, _, _ = plain._step(
+        plain.init_params(None), (jnp.asarray(x), jnp.asarray(y)),
+        train=False,
+    )
+    assert abs(float(loss_plain) - ref) > 1e-6
+
+
+def test_training_step_dict_return_and_adapt_time_refusals():
+    """pl's documented dict return ({'loss': ..., ...}) reassembles
+    through the pytree out-spec; batch_idx use and non-default loss
+    options refuse at ADAPT time, not train time."""
+    import torch.nn.functional as F
+
+    class DictStep(PlStyleMLP):
+        def training_step(self, batch, batch_idx):
+            x, y = batch
+            logits = self(x)
+            return {"loss": F.cross_entropy(logits, y), "preds": logits}
+
+    adapted = adapt_torch_module(DictStep())
+    x = np.random.default_rng(5).normal(size=(8, 32)).astype(np.float32)
+    y = np.random.default_rng(6).integers(0, 10, size=(8,))
+    loss, _, _ = adapted._step(
+        adapted.init_params(None), (jnp.asarray(x), jnp.asarray(y)),
+        train=False,
+    )
+    assert np.isfinite(float(loss)) and np.ndim(loss) == 0
+
+    class UsesBatchIdx(PlStyleMLP):
+        def training_step(self, batch, batch_idx):
+            x, y = batch
+            return self(x).mean() * (batch_idx + 1)
+
+    with pytest.raises(UnsupportedTorchOp, match="batch_idx"):
+        adapt_torch_module(UsesBatchIdx())
+
+    class SmoothedLoss(PlStyleMLP):
+        def training_step(self, batch, batch_idx):
+            x, y = batch
+            return F.cross_entropy(self(x), y, label_smoothing=0.1)
+
+    with pytest.raises(UnsupportedTorchOp, match="label_smoothing"):
+        adapt_torch_module(SmoothedLoss())
+
+
+def test_criterion_options_and_framework_stub_detection():
+    """Criterion instances with non-default options refuse at adapt time
+    (silently dropping label_smoothing would train different math); a
+    training_step inherited from a FRAMEWORK base class (pl's warn-stub)
+    must NOT trigger tracing."""
+
+    class SmoothCriterion(PlStyleMLP):
+        def __init__(self):
+            super().__init__()
+            self.criterion = nn.CrossEntropyLoss(label_smoothing=0.1)
+
+    with pytest.raises(UnsupportedTorchOp, match="label_smoothing"):
+        adapt_torch_module(SmoothCriterion())
+
+    # simulate pl's LightningModule base: a training_step stub whose
+    # defining class reports a pytorch_lightning module path
+    class FakePlBase(nn.Module):
+        def training_step(self, *args, **kwargs):
+            raise RuntimeError("pl stub")
+
+    FakePlBase.__module__ = "pytorch_lightning.core.module"
+
+    class UserModule(FakePlBase):
+        def __init__(self):
+            super().__init__()
+            self.net = nn.Linear(32, 10)
+            self.criterion = nn.CrossEntropyLoss()
+
+        def forward(self, x):
+            return self.net(x)
+
+        def configure_optimizers(self):
+            return torch.optim.Adam(self.parameters(), lr=1e-3)
+
+    adapted = adapt_torch_module(UserModule())  # must not try the stub
+    assert adapted._step_apply is None
+
+
+def test_user_validation_step_is_traced():
+    """A user validation_step (plain CE, no aux term) drives val_loss even
+    when training_step carries aux terms — monitor semantics match the
+    user's torch run."""
+    import torch.nn.functional as F
+
+    class BothSteps(CustomStepMLP):
+        def validation_step(self, batch, batch_idx):
+            x, y = batch
+            loss = F.cross_entropy(self(x), y)
+            self.log("val_loss", loss)
+            return loss
+
+    tm = BothSteps()
+    adapted = adapt_torch_module(tm)
+    assert adapted._val_apply is not None
+    x = np.random.default_rng(8).normal(size=(8, 32)).astype(np.float32)
+    y = np.random.default_rng(9).integers(0, 10, size=(8,))
+    tm.eval()
+    with torch.no_grad():
+        ref = tm.validation_step(
+            (torch.from_numpy(x), torch.from_numpy(y)), 0
+        ).item()
+    out, _ = adapted._val_apply(
+        adapted.init_params(None), jnp.asarray(x), jnp.asarray(y),
+        train=False,
+    )
+    assert abs(float(out) - ref) < 1e-5
+    # train loss (with aux) and val loss (plain) genuinely differ
+    loss_t, _, _ = adapted._step(
+        adapted.init_params(None), (jnp.asarray(x), jnp.asarray(y)),
+        train=False,
+    )
+    assert abs(float(loss_t) - ref) > 1e-7
+
+    # a validation_step that only logs (returns None) refuses loudly
+    class LogOnlyVal(CustomStepMLP):
+        def validation_step(self, batch, batch_idx):
+            x, y = batch
+            self.log("val_loss", F.cross_entropy(self(x), y))
+
+    with pytest.raises(UnsupportedTorchOp, match="returns no value"):
+        adapt_torch_module(LogOnlyVal())
+    assert adapt_torch_module(
+        LogOnlyVal(), ignore_validation_step=True
+    )._val_apply is None
+
+
+def test_traced_step_keeps_val_accuracy(tmp_root):
+    """Defining a training_step must not silently drop the val_accuracy
+    metric (monitor-based callbacks depend on it)."""
+    adapted = adapt_torch_module(CustomStepMLP(lr=1e-2))
+    trainer = get_trainer(tmp_root, max_epochs=1, checkpoint_callback=False)
+    trainer.fit(
+        adapted,
+        train_dataloaders=_xy_loader(n=64, batch_size=32),
+        val_dataloaders=_xy_loader(n=32, batch_size=32, seed=1),
+    )
+    assert "val_accuracy" in trainer.callback_metrics
+
+
+def test_untraceable_training_step_refuses_loudly():
+    """Manual optimization / data-dependent control flow cannot trace:
+    the adapter must refuse pointing at step_fn=, not silently substitute
+    forward -> criterion semantics."""
+
+    class ManualOpt(PlStyleMLP):
+        def training_step(self, batch, batch_idx):
+            x, y = batch
+            logits = self(x)
+            if logits.sum() > 0:  # data-dependent branch: untraceable
+                return logits.mean()
+            return -logits.mean()
+
+    with pytest.raises(UnsupportedTorchOp, match="step_fn"):
+        adapt_torch_module(ManualOpt())
+    # the escape hatches still work
+    assert adapt_torch_module(
+        ManualOpt(), ignore_training_step=True
+    )._step_apply is None
+
+
+def test_custom_training_step_trains_through_trainer(tmp_root):
+    """End-to-end: the traced training_step drives a real fit."""
+    tm = CustomStepMLP(lr=1e-2)
+    adapted = adapt_torch_module(tm)
+    train = _xy_loader(n=128, batch_size=32)
+    val = _xy_loader(n=32, batch_size=32, seed=1)
+    trainer = get_trainer(tmp_root, max_epochs=2, checkpoint_callback=False)
+    trainer.fit(adapted, train_dataloaders=train, val_dataloaders=val)
+    assert trainer.state.status == "finished"
+    assert np.isfinite(float(trainer.callback_metrics["val_loss"]))
+
+
+def test_criterion_module_inside_training_step():
+    """self.criterion(out, y) as a call_module node inside the traced
+    step (the other common spelling)."""
+
+    class CriterionStep(PlStyleMLP):
+        def training_step(self, batch, batch_idx):
+            x, y = batch
+            return self.criterion(self(x), y)
+
+    tm = CriterionStep()
+    adapted = adapt_torch_module(tm)
+    assert adapted._step_apply is not None
+    x = np.random.default_rng(3).normal(size=(8, 32)).astype(np.float32)
+    y = np.random.default_rng(4).integers(0, 10, size=(8,))
+    tm.eval()
+    with torch.no_grad():
+        ref = tm.training_step((torch.from_numpy(x), torch.from_numpy(y)), 0).item()
+    loss, _, _ = adapted._step(
+        adapted.init_params(None), (jnp.asarray(x), jnp.asarray(y)),
+        train=False,
+    )
+    assert abs(float(loss) - ref) < 1e-5
+
+
+def test_scheduler_translations():
+    """ExponentialLR and OneCycleLR map to optax schedules with the same
+    shape: exponential decays by gamma per step; one-cycle warms up to
+    max_lr then anneals below the initial lr."""
+    from ray_lightning_tpu.interop.torch_bridge import (
+        _torch_scheduler_to_optax,
+    )
+
+    net = nn.Linear(4, 4)
+    opt = torch.optim.SGD(net.parameters(), lr=0.1)
+    exp = torch.optim.lr_scheduler.ExponentialLR(opt, gamma=0.9)
+    s = _torch_scheduler_to_optax(exp, 0.1, total_steps=None)
+    assert abs(float(s(0)) - 0.1) < 1e-6
+    assert abs(float(s(10)) - 0.1 * 0.9 ** 10) < 1e-6
+
+    opt2 = torch.optim.SGD(net.parameters(), lr=0.1)
+    onecycle = torch.optim.lr_scheduler.OneCycleLR(
+        opt2, max_lr=0.4, total_steps=100, pct_start=0.25
+    )
+    s2 = _torch_scheduler_to_optax(onecycle, 0.1, total_steps=100)
+    peak = max(float(s2(i)) for i in range(0, 100, 5))
+    assert abs(peak - 0.4) < 0.02  # reaches max_lr around the warmup end
+    assert float(s2(0)) < 0.4 / 10  # starts well below the peak
+    assert float(s2(99)) < float(s2(50))  # annealing tail
+
+
+@pytest.mark.slow
+def test_bridged_module_through_tune_sweep(tmp_root):
+    """A bridged torch module runs a tune lr sweep (the reference's main
+    tune path, but with the torch-bridge adapter as the trainable model):
+    metrics flow adapter -> TuneReportCallback -> session -> controller."""
+    from ray_lightning_tpu import tune as rlt_tune
+    from ray_lightning_tpu.tune.search import grid_search
+
+    def train_bridged(config):
+        import numpy as np
+
+        import ray_lightning_tpu as rlt
+        from ray_lightning_tpu.interop import adapt_torch_module
+        from ray_lightning_tpu.tune import TuneReportCallback
+
+        from tests.test_torch_bridge import PlStyleMLP, _xy_loader
+
+        adapted = adapt_torch_module(PlStyleMLP(lr=config["lr"]))
+        trainer = rlt.Trainer(
+            max_epochs=2, logger=False, enable_checkpointing=False,
+            callbacks=[
+                TuneReportCallback(
+                    {"loss": "val_loss", "acc": "val_accuracy"},
+                    on="validation_end",
+                )
+            ],
+            default_root_dir=config["root"], seed=0,
+        )
+        trainer.fit(
+            adapted,
+            train_dataloaders=_xy_loader(n=128, batch_size=32),
+            val_dataloaders=_xy_loader(n=32, batch_size=32, seed=1),
+        )
+
+    analysis = rlt_tune.run(
+        train_bridged,
+        config={"lr": grid_search([1e-2, 1e-3]), "root": tmp_root},
+        metric="loss",
+        mode="min",
+        local_dir=tmp_root,
+        name="exp_bridged",
+        trial_env={"JAX_PLATFORMS": "cpu"},
+        verbose=0,
+    )
+    assert len(analysis.trials) == 2
+    assert all(t.status == "TERMINATED" for t in analysis.trials)
+    assert all("loss" in t.last_result for t in analysis.trials)
+    assert analysis.best_config["lr"] in (1e-2, 1e-3)
+
+
 def test_torch_module_trains_through_trainer(tmp_root):
     """The headline: an unmodified torch pl-style module fit on a GSPMD
     dp mesh through the real Trainer; loss decreases; trained weights
