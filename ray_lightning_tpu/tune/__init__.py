@@ -16,6 +16,7 @@ from ray_lightning_tpu.tune.tune import (
     get_tune_resources,
     max_concurrent_for,
     run,
+    with_parameters,
 )
 from ray_lightning_tpu.tune.schedulers import ASHAScheduler, PopulationBasedTraining
 
@@ -30,6 +31,7 @@ __all__ = [
     "randint",
     "uniform",
     "run",
+    "with_parameters",
     "get_tune_resources",
     "PlacementGroupFactory",
     "max_concurrent_for",

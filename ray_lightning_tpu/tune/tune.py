@@ -210,6 +210,51 @@ class ExperimentAnalysis:
         return {t.trial_id: t.results for t in self.trials}
 
 
+def with_parameters(trainable: Callable, **kwargs) -> Callable:
+    """Attach large objects (datasets, pretrained weights, callbacks) to a
+    trainable by shipping them through the shm object store ONCE —
+    reference parity with ``tune.with_parameters``
+    (reference: examples/ray_ddp_example.py:96-104, where the MNIST
+    dataset rides ``ray.put`` instead of being pickled into every trial).
+
+    Without this, ``run`` cloudpickles the trainable closure per trial:
+    N trials x a large captured dataset = N socket copies. Here the
+    wrapped closure captures only :class:`ObjectRef` handles (bytes);
+    every trial actor maps the one shm segment read-only and deserializes
+    locally.
+
+    >>> data = load_big_dataset()
+    >>> tune.run(tune.with_parameters(train_fn, data=data), config=...)
+    ... # train_fn(config, data=...) — data stored once, not per trial
+
+    Host-local by design (shm does not cross hosts): trials scheduled on
+    a remote node fail loudly with FileNotFoundError rather than
+    silently re-shipping. In client mode, call this AFTER
+    ``rt.init(address=...)`` — storing first would lazily boot a local
+    full-resource runtime.
+
+    The segments live until process exit (ObjectStore.shutdown) or an
+    explicit ``wrapped.cleanup()`` — call it when a long-lived driver is
+    done with the experiment, or /dev/shm accumulates one payload per
+    ``with_parameters`` call.
+    """
+    refs = {k: rt.put(v) for k, v in kwargs.items()}
+
+    def _wrapped(config):
+        resolved = {k: rt.get(r) for k, r in refs.items()}
+        return trainable(config, **resolved)
+
+    def cleanup():
+        for ref in refs.values():
+            rt.delete(ref)
+        refs.clear()
+
+    _wrapped.__name__ = getattr(trainable, "__name__", "trainable")
+    _wrapped._rlt_parameter_refs = refs  # introspection/testing surface
+    _wrapped.cleanup = cleanup
+    return _wrapped
+
+
 def run(
     trainable: Callable[[Dict[str, Any]], Any],
     config: Optional[Dict[str, Any]] = None,
@@ -294,7 +339,11 @@ def run(
                 out[key] = max(out.get(key, 0.0), value)
         return out
 
-    queue = rt.make_queue()
+    # trials may land on remote nodes (client mode / multi-host): the shm
+    # ring cannot cross hosts, so pick the socket-backed queue whenever the
+    # runtime has one — same rule as the launcher (ray_launcher.py)
+    cross_host = any(n.get("remote") for n in rt.nodes())
+    queue = rt.make_queue(cross_host=cross_host)
     trainable_bytes = cloudpickle.dumps(trainable)
 
     def start_trial(trial: Trial):

@@ -231,6 +231,78 @@ def test_client_mode_init_requires_authkey():
 
 
 @pytest.mark.slow
+def test_client_mode_tune_sweep(node_agent, tmp_root):
+    """Tune from a REMOTE driver (reference tests/test_client_2.py's role):
+    trial actors land on the remote node and their report queue tunnels
+    back across the client boundary — the interesting seam."""
+    from ray_lightning_tpu import tune as rlt_tune
+    from ray_lightning_tpu.tune.search import grid_search
+
+    def trainable(config):
+        from ray_lightning_tpu.tune.session import get_trial_session
+
+        sess = get_trial_session()
+        for it in range(2):
+            sess.report(loss=config["x"] * (2 - it))
+
+    address, authkey = node_agent
+    rt.shutdown()
+    try:
+        rt.init(address=f"{address[0]}:{address[1]}", authkey=authkey)
+        assert rt.is_connected()
+        analysis = rlt_tune.run(
+            trainable,
+            config={"x": grid_search([1.0, 3.0])},
+            metric="loss",
+            mode="min",
+            local_dir=tmp_root,
+            name="exp_client",
+            trial_env={"JAX_PLATFORMS": "cpu"},
+            verbose=0,
+        )
+        assert len(analysis.trials) == 2
+        assert all(t.status == "TERMINATED" for t in analysis.trials)
+        assert all(len(t.results) == 2 for t in analysis.trials)
+        assert analysis.best_config["x"] == 1.0
+    finally:
+        rt.shutdown()
+
+
+@pytest.mark.slow
+def test_client_mode_sharded_fit(node_agent, tmp_root):
+    """ZeRO-sharded training from a remote driver (reference
+    tests/test_client_3.py's role): RayShardedStrategy workers placed on
+    the remote node, sharded optimizer state, weights recovered on the
+    client driver."""
+    import ray_lightning_tpu as rlt
+    from ray_lightning_tpu.models.mnist import MNISTClassifier, MNISTDataModule
+
+    address, authkey = node_agent
+    rt.shutdown()
+    try:
+        rt.init(address=f"{address[0]}:{address[1]}", authkey=authkey)
+        assert rt.is_connected()
+        model = MNISTClassifier({"lr": 1e-2})
+        dm = MNISTDataModule(batch_size=32)
+        trainer = rlt.Trainer(
+            max_epochs=1,
+            accelerator="_tpu",  # remote driver never touches devices
+            strategy=rlt.RayShardedStrategy(
+                num_workers=1, platform="cpu", devices_per_worker=2,
+                zero_stage=3,
+            ),
+            logger=False,
+            default_root_dir=tmp_root,
+        )
+        trainer.fit(model, datamodule=dm)
+        assert trainer.state.status == "finished"
+        assert model.params is not None
+        assert "ptl/val_loss" in trainer.callback_metrics
+    finally:
+        rt.shutdown()
+
+
+@pytest.mark.slow
 def test_hybrid_dcn_mesh_spans_processes(tmp_root):
     """MeshSpec.dcn_axes on a REAL 2-process run (RayStrategy workers each
     own 2 devices): the mesh must lay the dcn axis ('dp') ACROSS the two

@@ -111,6 +111,56 @@ def test_tune_with_trainer_and_report_callback(tmp_root):
     assert "loss" in trial.last_result and "acc" in trial.last_result
 
 
+def test_with_parameters_ships_large_objects_once(tmp_root):
+    """tune.with_parameters parity (reference examples/ray_ddp_example.py:
+    96-104): a large array is stored ONCE in the shm object store; the
+    per-trial payload carries only the ObjectRef, and every trial resolves
+    the same segment to the same values."""
+    import cloudpickle
+    import numpy as np
+
+    big = np.arange(2_000_000, dtype=np.float64)  # ~16 MB
+
+    def trainable(config, data=None):
+        import hashlib
+        import os
+
+        from ray_lightning_tpu.tune.session import get_trial_session
+
+        digest = hashlib.sha256(data.tobytes()).hexdigest()
+        with open(os.path.join(config["root"], f"seen-{config['i']}"), "w") as f:
+            f.write(f"{digest} {data.shape[0]}")
+        get_trial_session().report(done=1.0)
+
+    wrapped = rlt_tune.with_parameters(trainable, data=big)
+    # the trial payload must NOT embed the 16 MB array — only the ref
+    payload = cloudpickle.dumps(wrapped)
+    assert len(payload) < 100_000, len(payload)
+    (ref,) = wrapped._rlt_parameter_refs.values()
+    assert ref.size > big.nbytes  # one shm segment holds the real data
+
+    analysis = rlt_tune.run(
+        wrapped,
+        config={"root": tmp_root, "i": grid_search([0, 1, 2])},
+        metric="done",
+        mode="max",
+        local_dir=tmp_root,
+        name="exp_withparams",
+        trial_env={"JAX_PLATFORMS": "cpu"},
+        verbose=0,
+    )
+    assert len(analysis.trials) == 3
+    assert all(t.status == "TERMINATED" for t in analysis.trials)
+    import hashlib
+
+    expect = f"{hashlib.sha256(big.tobytes()).hexdigest()} {big.shape[0]}"
+    for i in range(3):
+        with open(os.path.join(tmp_root, f"seen-{i}")) as f:
+            assert f.read() == expect
+    wrapped.cleanup()  # frees the shm segment for long-lived drivers
+    assert not wrapped._rlt_parameter_refs
+
+
 def test_get_tune_resources_bundles():
     """Reference shape (tune.py:49-56): [{CPU:1}] + N x [{CPU:c, TPU:share}],
     strategy PACK."""
