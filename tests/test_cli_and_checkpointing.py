@@ -64,6 +64,7 @@ def test_cli_rejects_unknown_strategy(tmp_root):
         )
 
 
+@pytest.mark.slow
 @pytest.mark.skipif(not ORBAX_AVAILABLE, reason="orbax not installed")
 def test_orbax_checkpoint_and_reshard_restore(tmp_root):
     from ray_lightning_tpu.models.llama import (

@@ -31,6 +31,7 @@ def test_resnet_trains_and_batchstats_update(tmp_root):
     assert float(trainer.callback_metrics["val_acc"]) > 0.3
 
 
+@pytest.mark.slow
 def test_resnet50_builds():
     model = ResNetClassifier(arch="resnet50")
     params = model.init_params(jax.random.key(0))
@@ -38,6 +39,7 @@ def test_resnet50_builds():
     assert n > 2e7  # ~23.5M params
 
 
+@pytest.mark.slow
 def test_bert_finetune(tmp_root):
     cfg = BertConfig.tiny()
     model = BertClassifier(cfg, num_classes=2, lr=1e-3)

@@ -230,6 +230,51 @@ def test_client_mode_init_requires_authkey():
         rt.init(address="127.0.0.1:1")
 
 
+@pytest.mark.skipif(
+    "RLT_CLUSTER_ADDRESS" not in os.environ
+    or "RLT_CLUSTER_AUTHKEY_HEX" not in os.environ,
+    reason="real-cluster test: start `python -m ray_lightning_tpu.runtime."
+    "node --authkey-hex <hex>` on a second host, then set BOTH "
+    "RLT_CLUSTER_ADDRESS=ip:port and RLT_CLUSTER_AUTHKEY_HEX=<hex> "
+    "(reference keeps the same gate behind CLUSTER=1, "
+    "tests/test_ddp_gpu.py:126-137)",
+)
+def test_real_cluster_two_host_fit(tmp_root):
+    """Against REAL second-host hardware (not loopback): the driver
+    connects to a remote NodeAgent, workers span both hosts, and a fit
+    completes with weights recovered on the driver. This is the
+    falsifiability gate for the multi-host claim the loopback tests
+    cannot provide."""
+    import ray_lightning_tpu as rlt
+    from ray_lightning_tpu.models.mnist import MNISTClassifier, MNISTDataModule
+
+    address = os.environ["RLT_CLUSTER_ADDRESS"]
+    authkey = bytes.fromhex(os.environ["RLT_CLUSTER_AUTHKEY_HEX"])
+    rt.shutdown()
+    try:
+        rt.init(address=address, authkey=authkey)
+        assert rt.is_connected()
+        assert any(n["remote"] for n in rt.nodes()), "no remote node joined"
+        model = MNISTClassifier({"lr": 1e-2})
+        dm = MNISTDataModule(batch_size=32)
+        trainer = rlt.Trainer(
+            max_epochs=1,
+            accelerator="_tpu",
+            strategy=rlt.RayStrategy(
+                num_workers=2, num_cpus_per_worker=1,
+                platform=os.environ.get("RLT_CLUSTER_PLATFORM", "cpu"),
+                devices_per_worker=1,
+            ),
+            logger=False,
+            default_root_dir=tmp_root,
+        )
+        trainer.fit(model, datamodule=dm)
+        assert trainer.state.status == "finished"
+        assert model.params is not None
+    finally:
+        rt.shutdown()
+
+
 @pytest.mark.slow
 def test_client_mode_tune_sweep(node_agent, tmp_root):
     """Tune from a REMOTE driver (reference tests/test_client_2.py's role):

@@ -38,6 +38,7 @@ def test_ring_attention_exact():
     assert float(jnp.max(jnp.abs(ref - out))) < 1e-5
 
 
+@pytest.mark.slow
 def test_ring_attention_grad_exact():
     mesh = Mesh(np.array(jax.devices()).reshape(1, 8), ("dp", "sp"))
     b, h, s, d = 2, 2, 128, 32
