@@ -29,8 +29,11 @@ in tcp_recv behind 4 killed sweep children).
 Timeouts via env: RLT_BENCH_PROBE_TIMEOUT (default 600s — a wedged
 tunnel can take minutes to come back, and a short probe forfeits the
 round's only chance at a real number), RLT_BENCH_TIMEOUT (default
-1800s). RLT_BENCH_AUTOTUNE=0 disables the in-child sweep; explicit
-RLT_FLASH_BLOCK_Q/K pins win outright.
+1800s). RLT_BENCH_AUTOTUNE=0 disables the in-child sweeps; explicit
+RLT_FLASH_BLOCK_Q/K pins win outright. The child also sweeps
+remat_policy ("nothing" vs "dots" — the HBM-vs-FLOPs trade) on a short
+train-step window and keeps the winner; RLT_BENCH_REMAT_SWEEP=0
+disables just that sweep.
 
 Persistence: the first successful on-chip measurement is written to
 .bench_tpu_cache.json next to this file. If a later invocation's live
@@ -216,23 +219,70 @@ def _child(args: argparse.Namespace) -> int:
                     autotune_note["fwd_tflops"] / max(matmul_ceiling, 1e-9), 3
                 )
 
-    params = init_params(jax.random.key(0), cfg)
     tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
-    opt_state = tx.init(params)
 
-    def train_step(params, opt_state, tokens):
-        (loss, _), grads = jax.value_and_grad(
-            lambda p: lm_loss(p, tokens, cfg), has_aux=True
-        )(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+    def make_step(step_cfg):
+        def train_step(params, opt_state, tokens):
+            (loss, _), grads = jax.value_and_grad(
+                lambda p: lm_loss(p, tokens, step_cfg), has_aux=True
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
 
-    step = jax.jit(train_step, donate_argnums=(0, 1))
+        return jax.jit(train_step, donate_argnums=(0, 1))
+
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)),
         jnp.int32,
     )
+
+    # remat policy is the other big MFU lever (HBM-vs-FLOPs): time one
+    # short window per policy and keep the winner. Gated independently of
+    # the flash sweep (RLT_FLASH_BLOCK pins must not silently disable
+    # this one); never fatal.
+    remat_note = None
+    step = None
+    if (
+        on_tpu
+        and cfg.remat
+        and os.environ.get("RLT_BENCH_AUTOTUNE", "1") != "0"
+        and os.environ.get("RLT_BENCH_REMAT_SWEEP", "1") != "0"
+    ):
+        timed = {}
+        steps_by_policy = {}
+        for policy in ("nothing", "dots"):
+            p = s = None
+            try:
+                pcfg = replace(cfg, remat_policy=policy)
+                pstep = make_step(pcfg)
+                p = init_params(jax.random.key(0), pcfg)
+                s = tx.init(p)
+                p, s, _ = pstep(p, s, tokens)  # compile + warm
+                jax.block_until_ready(jax.tree_util.tree_leaves(p)[0])
+                t0 = time.perf_counter()
+                for _ in range(3):
+                    p, s, loss_ = pstep(p, s, tokens)
+                float(loss_)
+                timed[policy] = round((time.perf_counter() - t0) / 3 * 1e3, 2)
+                steps_by_policy[policy] = pstep
+            except Exception as exc:  # noqa: BLE001 — e.g. dots OOMs HBM
+                timed[policy] = f"failed: {type(exc).__name__}"
+            finally:
+                # sweep leftovers must not double the params+opt HBM peak
+                # under the real measurement
+                del p, s
+        ok = {k: v for k, v in timed.items() if isinstance(v, float)}
+        if ok:
+            picked = min(ok, key=ok.get)
+            cfg = replace(cfg, remat_policy=picked)
+            step = steps_by_policy[picked]  # reuse the compiled winner
+            remat_note = {"picked": picked, "step_ms_by_policy": timed}
+
+    params = init_params(jax.random.key(0), cfg)
+    opt_state = tx.init(params)
+    if step is None:
+        step = make_step(cfg)
 
     for _ in range(args.warmup):
         params, opt_state, loss = step(params, opt_state, tokens)
@@ -277,6 +327,8 @@ def _child(args: argparse.Namespace) -> int:
         result["detail"]["matmul_ceiling_tflops_measured"] = matmul_ceiling
     if autotune_note:
         result["detail"]["flash_autotune"] = autotune_note
+    if remat_note:
+        result["detail"]["remat_sweep"] = remat_note
     print(json.dumps(result))
     return 0
 

@@ -47,6 +47,14 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # what the per-layer jax.checkpoint SAVES (the classic HBM-vs-FLOPs
+    # trade; the right point is hardware/shape-dependent, so it is a knob):
+    #   "nothing": recompute the whole layer in backward — minimum memory
+    #   "dots":    save matmul outputs without batch dims (qkv/ffn
+    #              projections stay resident; attention and elementwise
+    #              recompute) — jax.checkpoint_policies
+    #              .dots_with_no_batch_dims_saveable
+    remat_policy: str = "nothing"
     attn_impl: Optional[str] = None  # None=auto, "flash", "reference"
     # flash block sizes (0 = env/default). Static ints in the traced step,
     # so a sweep is one process retracing per config — tunnel-friendly.
@@ -71,6 +79,16 @@ class LlamaConfig:
     # microbatch reaches the last stage, bounding resident activations by
     # min(2*pp-1, M) instead of M (use with many microbatches; dp and tp)
     pp_schedule: str = "gpipe"
+
+    def __post_init__(self):
+        # validate at CONSTRUCTION, not trace time deep inside the forward
+        # (and regardless of remat — a typo'd policy must not lie dormant
+        # in checkpoint hparams until remat is flipped on)
+        if self.remat_policy not in ("nothing", "dots"):
+            raise ValueError(
+                f"remat_policy={self.remat_policy!r}: expected 'nothing' "
+                "or 'dots'"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -252,6 +270,19 @@ def shardings_for_mesh(cfg: LlamaConfig, mesh: Mesh) -> Dict[str, Any]:
 # --------------------------------------------------------------------- #
 # forward
 # --------------------------------------------------------------------- #
+def _remat_wrap(fn, cfg: LlamaConfig):
+    """Apply the configured rematerialisation to a scanned layer fn —
+    shared by the dense forward and both pipeline schedules so the knob
+    behaves identically everywhere."""
+    if not cfg.remat:
+        return fn
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    return jax.checkpoint(fn)  # "nothing" (validated in __post_init__)
+
+
 def _act_constraint(x, mesh: Optional[Mesh], *entries):
     if mesh is None:
         return x
@@ -458,7 +489,7 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
                                     input_fn, moe_fn=moe_fn)
             return x, aux
 
-        fn = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
+        fn = _remat_wrap(layer_fn, cfg)
         out, auxs = jax.lax.scan(fn, xb, stage_layers)
         if cfg.n_experts:
             # per-stage aux = mean over this stage's layers; the pipeline
@@ -686,7 +717,7 @@ def forward(
         x = _act_constraint(x, mesh, ("dp", "fsdp"), "sp", None)
         return x, aux
 
-    scanned = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
+    scanned = _remat_wrap(layer_fn, cfg)
     x, aux_losses = jax.lax.scan(scanned, x, params["layers"])
     x = rmsnorm(x, params["final_norm"])
     if return_hidden:

@@ -146,6 +146,42 @@ def test_moe_llama_ep_mesh(tmp_root, no_xla_cache):
     assert "ep" in str(spec)
 
 
+def test_remat_policy_changes_nothing_numerically():
+    """remat_policy trades HBM for FLOPs; it must never change values —
+    loss and grads identical across 'nothing' and 'dots' (and remat off)."""
+    import dataclasses
+
+    from ray_lightning_tpu.models.llama import lm_loss
+
+    base = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    params = init_params(jax.random.key(0), base)
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(0, base.vocab_size, (4, base.max_seq)),
+        jnp.int32,
+    )
+    results = {}
+    for name, cfg in {
+        "off": base,
+        "nothing": dataclasses.replace(base, remat=True),
+        "dots": dataclasses.replace(base, remat=True, remat_policy="dots"),
+    }.items():
+        loss, grads = jax.jit(
+            jax.value_and_grad(lambda p, c=cfg: lm_loss(p, tokens, c)[0])
+        )(params)
+        results[name] = (float(loss), grads)
+    for name in ("nothing", "dots"):
+        assert abs(results[name][0] - results["off"][0]) < 1e-6
+        err = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))),
+            results["off"][1], results[name][1],
+        )
+        assert max(jax.tree_util.tree_leaves(err)) < 1e-5, (name, err)
+
+    # a typo'd policy fails at CONSTRUCTION, not at trace time
+    with pytest.raises(ValueError, match="remat_policy"):
+        dataclasses.replace(base, remat_policy="everything")
+
+
 def test_pp_forward_matches_dense():
     """Pipeline-parallel forward is numerically identical to the plain
     scanned forward (GPipe re-schedules compute, it must not change math)."""
