@@ -186,19 +186,24 @@ def _bwd_dq_kernel(
 
 
 # --------------------------------------------------------------------- #
-# pallas backward: dK, dV — grid (b, h, n_kv, n_q), accumulating over Q
+# pallas backward: dK, dV — grid (b, hkv, n_kv, group * n_q): the
+# innermost dimension walks every (gqa-group member, q block) pair, so
+# the GQA reduction happens IN the accumulator and dk/dv come out
+# [B, Hkv, S, D] directly — group x less output HBM traffic than a
+# per-Q-head output with a host-side reshape-sum
 # --------------------------------------------------------------------- #
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, scale, causal, block_q, block_k, n_q,
+    *, scale, causal, block_q, block_k, n_q, group,
 ):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
-    qi = pl.program_id(3)
+    t = pl.program_id(3)  # (group member, q block) folded
+    qi = t % n_q
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -237,7 +242,7 @@ def _bwd_dkv_kernel(
             ds, qs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(qi == n_q - 1)
+    @pl.when(t == group * n_q - 1)
     def _finalize():
         dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
@@ -291,13 +296,22 @@ def _kv_index_map(group: int, bq: int, bk: int, causal: bool):
     return lambda b_, h, i, j, g=group: (b_, h // g, j, 0)
 
 
-def _q_index_map_for_dkv(bq: int, bk: int, causal: bool):
-    """Q-side BlockSpec index map for the dK/dV grid (b, h, j, i). The
-    inactive leading steps (q blocks fully above the diagonal) clamp UP to
-    the first active q block — same DMA-eliding trick as _kv_index_map."""
-    if causal:
-        return lambda b_, h, j, i: (b_, h, jnp.maximum(i, (j * bk) // bq), 0)
-    return lambda b_, h, j, i: (b_, h, i, 0)
+def _q_index_map_for_dkv(bq: int, bk: int, causal: bool, group: int, n_q: int):
+    """Q-side BlockSpec index map for the dK/dV grid (b, h, j, t) where h
+    is the KV-head GRID INDEX and t folds (gqa group member, q block):
+    the Q head is h * group + t // n_q and the q block t % n_q. Inactive
+    leading
+    steps of each head's segment (q blocks fully above the diagonal)
+    clamp UP to the first active q block — same DMA-eliding trick as
+    _kv_index_map."""
+
+    def q_block(j, t):
+        i = t % n_q
+        return jnp.maximum(i, (j * bk) // bq) if causal else i
+
+    return lambda b_, h, j, t: (
+        b_, h * group + t // n_q, q_block(j, t), 0
+    )
 
 
 def _flash_fwd(q, k, v, causal, scale, interpret, blocks=None):
@@ -377,29 +391,31 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, interpret, blocks=None):
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
-    # dK/dV are computed per Q-head then reduced over the GQA group
-    q_idx = _q_index_map_for_dkv(bq, bk, causal)
+    # dK/dV: grid over KV heads with the GQA group folded into the
+    # innermost dimension — the group reduction happens in the fp32
+    # accumulator, dk/dv land [B, Hkv, S, D] directly
+    q_idx = _q_index_map_for_dkv(bq, bk, causal, group, n_q)
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-            n_q=n_q,
+            n_q=n_q, group=group,
         ),
-        grid=(b, hq, n_kv, n_q),
+        grid=(b, hkv, n_kv, group * n_q),
         in_specs=[
             pl.BlockSpec((None, None, bq, d), q_idx),
-            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, i, g=group: (b_, h // g, j, 0)),
-            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, i, g=group: (b_, h // g, j, 0)),
+            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, t: (b_, h, j, 0)),
+            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, t: (b_, h, j, 0)),
             pl.BlockSpec((None, None, bq, d), q_idx),
             pl.BlockSpec((None, None, bq, 1), q_idx),
             pl.BlockSpec((None, None, bq, 1), q_idx),
         ],
         out_specs=[
-            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, i: (b_, h, j, 0)),
-            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, i: (b_, h, j, 0)),
+            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, t: (b_, h, j, 0)),
+            pl.BlockSpec((None, None, bk, d), lambda b_, h, j, t: (b_, h, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, hq, skv, d), q.dtype),
-            jax.ShapeDtypeStruct((b, hq, skv, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hkv, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hkv, skv, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -407,10 +423,6 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, interpret, blocks=None):
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
-
-    if group > 1:
-        dk = dk.reshape(b, hkv, group, skv, d).sum(axis=2).astype(k.dtype)
-        dv = dv.reshape(b, hkv, group, skv, d).sum(axis=2).astype(v.dtype)
     return dq, dk, dv
 
 

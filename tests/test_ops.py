@@ -33,21 +33,41 @@ def test_flash_gqa():
     assert float(jnp.max(jnp.abs(ref - out))) < 1e-4
 
 
-def test_flash_gradients_match():
-    q, k, v = _qkv(1, 2, 2, 256, 128)
+@pytest.mark.parametrize(
+    "hq,hkv,causal,blocks",
+    [
+        (2, 2, True, None),
+        (8, 2, True, (64, 64)),  # GQA fold crosses q-block boundaries
+        (8, 2, False, (64, 64)),  # non-causal branch of the folded grid
+        (4, 1, True, None),  # maximal group
+    ],
+    ids=["mha", "gqa_multiblock", "gqa_noncausal", "gqa_group4"],
+)
+def test_flash_gradients_match(hq, hkv, causal, blocks):
+    """All grads vs the reference. The dK/dV kernel folds the GQA group
+    reduction into its accumulator (grid over KV heads), so dk/dv must
+    equal the reference's repeat-then-sum across group sizes, causal
+    modes, and block boundaries."""
+    q, k, v = _qkv(1, hq, hkv, 256, 128)
+    bq, bk = blocks or (None, None)
 
     def loss(fn):
         return lambda q, k, v: (fn(q, k, v) ** 2).sum()
 
-    g_ref = jax.grad(loss(lambda q, k, v: reference_attention(q, k, v, causal=True)),
-                     argnums=(0, 1, 2))(q, k, v)
-    g_fl = jax.grad(
-        loss(lambda q, k, v: attention(q, k, v, causal=True, impl="flash", interpret=True)),
+    g_ref = jax.grad(
+        loss(lambda q, k, v: reference_attention(q, k, v, causal=causal)),
         argnums=(0, 1, 2),
     )(q, k, v)
-    for a, b in zip(g_ref, g_fl):
+    g_fl = jax.grad(
+        loss(lambda q, k, v: attention(
+            q, k, v, causal=causal, impl="flash", interpret=True,
+            block_q=bq, block_k=bk,
+        )),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for name, a, b in zip("qkv", g_ref, g_fl):
         rel = float(jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(a)) + 1e-9))
-        assert rel < 1e-4
+        assert rel < 1e-4, name
 
 
 def test_attention_auto_dispatch_untileable_shapes():
