@@ -739,6 +739,32 @@ class Trainer:
         model._params = self._params
         return None
 
+    def _prefetch_shard(self, loader, limit):
+        """Yield ``(idx, host_batch, device_batch)`` with a ONE-slot
+        device prefetch: batch N+1 is sharded (its host->device transfer
+        dispatched — jax transfers are async) while the caller runs step N
+        on the compute stream, hiding input-copy latency behind the step.
+        Costs one extra resident batch on device."""
+        prev = None
+        for batch_idx, batch in enumerate(loader):
+            if limit is not None and batch_idx >= limit:
+                break
+            try:
+                cur = (batch_idx, batch, self.strategy.shard_batch(batch))
+            except Exception:
+                # a bad LOOKAHEAD batch (e.g. a ragged final batch failing
+                # the divisibility check) must not swallow the good batch
+                # already sharded: train it, then surface the error at the
+                # same step the non-prefetching loop would have
+                if prev is not None:
+                    yield prev
+                raise
+            if prev is not None:
+                yield prev
+            prev = cur
+        if prev is not None:
+            yield prev
+
     def _run_train_epoch(self, train_loader, train_step, val_loader, val_step):
         model = self._module
         if hasattr(train_loader, "set_epoch"):
@@ -787,11 +813,9 @@ class Trainer:
                     "interval"
                 )
 
-        for batch_idx, batch in enumerate(train_loader):
-            if limit_train is not None and batch_idx >= limit_train:
-                self._epoch_ended = True
-                break
-            device_batch = self.strategy.shard_batch(batch)
+        for batch_idx, batch, device_batch in self._prefetch_shard(
+            train_loader, limit_train
+        ):
             self._cb("on_train_batch_start", batch, batch_idx)
             self._params, self._opt_state, logs = train_step(
                 self._params,
