@@ -210,6 +210,33 @@ def decode_step(
     return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
 
 
+def _sample_logits(logits, key, temperature, top_k, top_p):
+    """One sampling step over [B, V] logits, jit/scan-safe (static shapes).
+
+    Filter order matches the usual convention: top-k first, then nucleus
+    (top-p) over the surviving mass, then temperature-scaled categorical.
+    """
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None and 0 < top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # whose cumulative probability reaches top_p (the first token is
+        # always kept)
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p  # mass BEFORE this token still < p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
 def generate(
     params: Dict[str, Any],
     prompt: jnp.ndarray,
@@ -218,13 +245,21 @@ def generate(
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
     pad_id: Optional[int] = None,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
 ) -> jnp.ndarray:
     """Generate ``max_new_tokens`` after ``prompt`` [B, P] (dense prompts;
     all rows share length P). Returns [B, P + max_new_tokens].
 
     The prompt is consumed by ONE batched ``prefill`` pass (the training
     layer math filling the cache), then one compiled ``lax.scan`` samples
-    the new tokens. temperature 0 = greedy; > 0 = categorical sampling.
+    the new tokens. temperature 0 = greedy; > 0 = categorical sampling,
+    optionally filtered by ``top_k`` and/or nucleus ``top_p``.
+
+    ``eos_id``: rows that have emitted this token keep emitting it for
+    the remaining positions (the scan stays static-shaped — finished
+    rows are frozen, not exited early).
 
     ``pad_id`` is accepted for backward compatibility with the ragged
     teacher-forcing signature and ignored: dense prompts have no padding.
@@ -248,22 +283,28 @@ def generate(
     table = rope_angles(total, cfg.head_dim, cfg.rope_theta)
 
     def sample(logits, key):
-        if temperature > 0.0:
-            return jax.random.categorical(key, logits / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
+        return _sample_logits(logits, key, temperature, top_k, top_p)
 
     logits0, cache = prefill(params, prompt, cfg, cache, table)
     rng, sub = jax.random.split(rng)
     tok0 = sample(logits0, sub).astype(prompt.dtype)  # token at position P
+    done0 = (
+        tok0 == eos_id if eos_id is not None
+        else jnp.zeros((B,), jnp.bool_)
+    )
 
     def step(carry, t):
-        cache, tok, rng = carry
+        cache, tok, rng, done = carry
         logits, cache = decode_step(params, cache, tok, t, cfg, table)
         rng, sub = jax.random.split(rng)
         nxt = sample(logits, sub).astype(prompt.dtype)
-        return (cache, nxt, rng), nxt
+        if eos_id is not None:
+            # finished rows keep emitting eos (static shapes; no early exit)
+            nxt = jnp.where(done, jnp.asarray(eos_id, prompt.dtype), nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt, rng, done), nxt
 
-    (_, _, _), toks = jax.lax.scan(
-        step, (cache, tok0, rng), jnp.arange(P, total - 1)
+    (_, _, _, _), toks = jax.lax.scan(
+        step, (cache, tok0, rng, done0), jnp.arange(P, total - 1)
     )
     return jnp.concatenate([prompt, tok0[:, None], toks.swapaxes(0, 1)], axis=1)

@@ -911,16 +911,18 @@ class LlamaModule(LightningModule):
         return optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=self.weight_decay)
 
     def generate(self, prompt, max_new_tokens: int, temperature: float = 0.0,
-                 rng=None):
+                 rng=None, top_k=None, top_p=None, eos_id=None):
         """KV-cache autoregressive decoding with the trained params (see
-        models/generation.py for the compiled decode loop)."""
+        models/generation.py for the compiled decode loop; top_k/top_p
+        filtered sampling, eos_id freezes finished rows)."""
         from ray_lightning_tpu.models.generation import generate
 
         if self.params is None:
             raise ValueError("generate requires trained params; fit first "
                              "or set module.params")
         return generate(self.params, prompt, self.config, max_new_tokens,
-                        temperature=temperature, rng=rng)
+                        temperature=temperature, rng=rng, top_k=top_k,
+                        top_p=top_p, eos_id=eos_id)
 
     def flops_per_sample(self) -> float:
         """Advertised to ThroughputMonitor: every llama fit logs train_mfu

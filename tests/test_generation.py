@@ -79,6 +79,46 @@ def test_generate_temperature_sampling_runs():
     assert int(out.min()) >= 0 and int(out.max()) < cfg.vocab_size
 
 
+def test_sampling_filters():
+    """top-k and nucleus top-p restrict sampling to the intended support;
+    greedy ignores both."""
+    from ray_lightning_tpu.models.generation import _sample_logits
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.07, 0.03]]))
+    keys = jax.random.split(jax.random.key(0), 200)
+
+    # top_k=2: only tokens {0, 1} can appear
+    got = {int(_sample_logits(logits, k, 1.0, 2, None)[0]) for k in keys}
+    assert got <= {0, 1} and len(got) == 2, got
+
+    # top_p=0.7: cumulative 0.5 < 0.7 at token 0, 0.75 >= 0.7 at token 1
+    # -> support {0, 1} (first token past the threshold is kept)
+    got = {int(_sample_logits(logits, k, 1.0, None, 0.7)[0]) for k in keys}
+    assert got <= {0, 1} and len(got) == 2, got
+
+    # top_p tiny: only the argmax survives
+    got = {int(_sample_logits(logits, k, 1.0, None, 0.1)[0]) for k in keys}
+    assert got == {0}, got
+
+    # greedy ignores the filters entirely
+    assert int(_sample_logits(logits, keys[0], 0.0, 1, 0.01)[0]) == 0
+
+
+def test_generate_eos_freezes_finished_rows():
+    """Once a row emits eos_id, every later position repeats it — finished
+    rows are frozen inside the static-shaped scan."""
+    cfg = _cfg()
+    params = init_params(jax.random.key(1), cfg)
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    # greedy with eos = whatever the model's first greedy token is: the
+    # whole tail must then be that token
+    first = generate(params, prompt, cfg, max_new_tokens=1)
+    eos = int(first[0, 3])
+    out = generate(params, prompt, cfg, max_new_tokens=6, eos_id=eos)
+    tail = np.asarray(out[0, 3:])
+    assert (tail == eos).all(), tail
+
+
 def test_module_generate_requires_params():
     from ray_lightning_tpu.models.llama import LlamaModule
 
