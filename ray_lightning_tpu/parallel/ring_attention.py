@@ -141,6 +141,68 @@ def _ring_modes(my, t, sp):
     return jnp.where(origin > my, 0, jnp.where(origin == my, 1, 2))
 
 
+# --------------------------------------------------------------------- #
+# zigzag (load-balanced) layout
+#
+# Causal masking makes the contiguous ring imbalanced: device i is active
+# in i+1 of the sp lockstep steps, so every step's wall-clock is gated by
+# the devices still working while early-shard devices idle in the
+# collective. The zigzag layout gives device i the half-chunks
+# (i, 2sp-1-i) of the sequence (2sp half-chunks total): per ring step
+# EVERY device then has exactly 2 active (quarter-sized) sub-blocks —
+# perfectly balanced, ~2x faster at large sp. Rope is applied BEFORE
+# attention, so the relayout is invisible outside this op: q/k/v are
+# transformed in, the output transformed back, and positions/loss/rope
+# never see it.
+# --------------------------------------------------------------------- #
+def _zigzag_layout(x, axis, sp, my):
+    """Contiguous shard [.., Sl, D] (global chunks (2i, 2i+1) on device i)
+    -> zigzag halves (chunk my, chunk 2sp-1-my). Send-side decomposition:
+    each device forwards its even chunk along one permutation and its odd
+    chunk along another; the receive slots are parity-selected."""
+    half = x.shape[2] // 2
+    a, b = x[:, :, :half], x[:, :, half:]
+    perm_even = [
+        (i, 2 * i if 2 * i < sp else 2 * sp - 1 - 2 * i) for i in range(sp)
+    ]
+    perm_odd = [
+        (i, 2 * i + 1 if 2 * i + 1 < sp else 2 * sp - 2 - 2 * i)
+        for i in range(sp)
+    ]
+    r_e = jax.lax.ppermute(a, axis, perm_even)
+    r_o = jax.lax.ppermute(b, axis, perm_odd)
+    even_me = my % 2 == 0
+    slot0 = jnp.where(even_me, r_e, r_o)  # chunk my (parity of my)
+    slot1 = jnp.where(even_me, r_o, r_e)  # chunk 2sp-1-my (opposite parity)
+    return slot0, slot1
+
+
+def _zigzag_unlayout(z0, z1, axis, sp, my):
+    """Inverse of :func:`_zigzag_layout` — receive-side decomposition:
+    device j pulls chunk 2j along one permutation and 2j+1 along the
+    other; each sender parity-selects which half to contribute."""
+    perm_s0 = [  # delivers chunk 2j to device j
+        (2 * j if 2 * j < sp else 2 * sp - 1 - 2 * j, j) for j in range(sp)
+    ]
+    perm_s1 = [  # delivers chunk 2j+1 to device j
+        (2 * j + 1 if 2 * j + 1 < sp else 2 * sp - 2 - 2 * j, j)
+        for j in range(sp)
+    ]
+    even_me = my % 2 == 0
+    payload0 = jnp.where(even_me, z0, z1)  # even chunk of this device
+    payload1 = jnp.where(even_me, z1, z0)  # odd chunk
+    r0 = jax.lax.ppermute(payload0, axis, perm_s0)
+    r1 = jax.lax.ppermute(payload1, axis, perm_s1)
+    return jnp.concatenate([r0, r1], axis=2)
+
+
+def _zig_mode(q_chunk, k_chunk):
+    """0=skip, 1=causal (same half-chunk), 2=full — by half-chunk index."""
+    return jnp.where(
+        q_chunk == k_chunk, 1, jnp.where(q_chunk > k_chunk, 2, 0)
+    )
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _ring_flash_attention(q, k, v, axis, sp, scale, interpret, blocks):
     out, _ = _ring_flash_fwd_pass(q, k, v, axis, sp, scale, interpret, blocks)
@@ -207,6 +269,130 @@ def _ring_flash_vjp_bwd(axis, sp, scale, interpret, blocks, res, g):
 _ring_flash_attention.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
+# --------------------------------------------------------------------- #
+# zigzag flash ring: inputs/outputs in ZIGZAG layout (halves stacked
+# [.., Sl, D] = [chunk my | chunk 2sp-1-my]); per step each device runs
+# its 2 active quarter-sized sub-blocks out of 4 — balanced lockstep
+# --------------------------------------------------------------------- #
+def _zig_chunk_ids(my, t, sp):
+    origin = (my - t) % sp
+    return (my, 2 * sp - 1 - my, origin, 2 * sp - 1 - origin)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash_attention_zigzag(q, k, v, axis, sp, scale, interpret, blocks):
+    out, _ = _ring_zig_fwd_pass(q, k, v, axis, sp, scale, interpret, blocks)
+    return out
+
+
+def _ring_zig_fwd_pass(q, k, v, axis, sp, scale, interpret, blocks):
+    my = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    half = q.shape[2] // 2
+    qa, qb = q[:, :, :half], q[:, :, half:]
+
+    def step(t, carry):
+        oa, la, ob, lb, k1, v1, k2, v2 = carry
+        a_id, b_id, c1, c2 = _zig_chunk_ids(my, t, sp)
+        # the 2x2 sub-pair matrix collapses statically: (qa, c2) is always
+        # skip (a_id < sp <= c2) and (qb, c1) always full (b_id >= sp > c1)
+        # — per step exactly 2 active sub-blocks on every device (3 at
+        # t == 0 where both variable pairs hit their causal diagonal)
+        o_n, l_n = _block_flash_fwd(
+            qa, k1, v1, _zig_mode(a_id, c1), scale, interpret, blocks
+        )
+        oa, la = _merge(oa, la, o_n, l_n)
+        o_n, l_n = _block_flash_fwd(
+            qb, k1, v1, jnp.int32(2), scale, interpret, blocks
+        )
+        ob, lb = _merge(ob, lb, o_n, l_n)
+        o_n, l_n = _block_flash_fwd(
+            qb, k2, v2, _zig_mode(b_id, c2), scale, interpret, blocks
+        )
+        ob, lb = _merge(ob, lb, o_n, l_n)
+        k1 = jax.lax.ppermute(k1, axis, perm)
+        v1 = jax.lax.ppermute(v1, axis, perm)
+        k2 = jax.lax.ppermute(k2, axis, perm)
+        v2 = jax.lax.ppermute(v2, axis, perm)
+        return oa, la, ob, lb, k1, v1, k2, v2
+
+    z_o = jnp.zeros(qa.shape, jnp.float32)
+    z_l = jnp.full((*qa.shape[:-1], 1), -1e30, jnp.float32)
+    oa, la, ob, lb, _, _, _, _ = jax.lax.fori_loop(
+        0, sp, step,
+        (z_o, z_l, z_o, z_l, k[:, :, :half], v[:, :, :half],
+         k[:, :, half:], v[:, :, half:]),
+    )
+    out = jnp.concatenate([oa, ob], axis=2).astype(q.dtype)
+    lse = jnp.concatenate([la, lb], axis=2)
+    return out, lse
+
+
+def _ring_zig_vjp_fwd(q, k, v, axis, sp, scale, interpret, blocks):
+    out, lse = _ring_zig_fwd_pass(q, k, v, axis, sp, scale, interpret, blocks)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_zig_vjp_bwd(axis, sp, scale, interpret, blocks, res, g):
+    q, k, v, out, lse = res
+    my = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    half = q.shape[2] // 2
+    qa, qb = q[:, :, :half], q[:, :, half:]
+    oa, ob = out[:, :, :half], out[:, :, half:]
+    la, lb = lse[:, :, :half], lse[:, :, half:]
+    ga, gb = g[:, :, :half], g[:, :, half:]
+
+    def step(t, carry):
+        dqa, dqb, k1, v1, k2, v2, dk1, dv1, dk2, dv2 = carry
+        a_id, b_id, c1, c2 = _zig_chunk_ids(my, t, sp)
+        # same static collapse as the forward: (qa, c2) skip, (qb, c1) full
+        dq_c, dk_c, dv_c = _block_flash_bwd(
+            qa, k1, v1, oa, la, ga, _zig_mode(a_id, c1), scale,
+            interpret, blocks,
+        )
+        dqa = dqa + dq_c.astype(jnp.float32)
+        dk1 = dk1 + dk_c.astype(jnp.float32)
+        dv1 = dv1 + dv_c.astype(jnp.float32)
+        dq_c, dk_c, dv_c = _block_flash_bwd(
+            qb, k1, v1, ob, lb, gb, jnp.int32(2), scale, interpret, blocks
+        )
+        dqb = dqb + dq_c.astype(jnp.float32)
+        dk1 = dk1 + dk_c.astype(jnp.float32)
+        dv1 = dv1 + dv_c.astype(jnp.float32)
+        dq_c, dk_c, dv_c = _block_flash_bwd(
+            qb, k2, v2, ob, lb, gb, _zig_mode(b_id, c2), scale,
+            interpret, blocks,
+        )
+        dqb = dqb + dq_c.astype(jnp.float32)
+        dk2 = dk2 + dk_c.astype(jnp.float32)
+        dv2 = dv2 + dv_c.astype(jnp.float32)
+        k1 = jax.lax.ppermute(k1, axis, perm)
+        v1 = jax.lax.ppermute(v1, axis, perm)
+        k2 = jax.lax.ppermute(k2, axis, perm)
+        v2 = jax.lax.ppermute(v2, axis, perm)
+        dk1 = jax.lax.ppermute(dk1, axis, perm)
+        dv1 = jax.lax.ppermute(dv1, axis, perm)
+        dk2 = jax.lax.ppermute(dk2, axis, perm)
+        dv2 = jax.lax.ppermute(dv2, axis, perm)
+        return dqa, dqb, k1, v1, k2, v2, dk1, dv1, dk2, dv2
+
+    zq = jnp.zeros(qa.shape, jnp.float32)
+    zk = jnp.zeros((*k.shape[:2], half, k.shape[3]), jnp.float32)
+    dqa, dqb, _, _, _, _, dk1, dv1, dk2, dv2 = jax.lax.fori_loop(
+        0, sp, step,
+        (zq, zq, k[:, :, :half], v[:, :, :half], k[:, :, half:],
+         v[:, :, half:], zk, zk, zk, zk),
+    )
+    dq = jnp.concatenate([dqa, dqb], axis=2).astype(q.dtype)
+    dk = jnp.concatenate([dk1, dk2], axis=2).astype(k.dtype)
+    dv = jnp.concatenate([dv1, dv2], axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+_ring_flash_attention_zigzag.defvjp(_ring_zig_vjp_fwd, _ring_zig_vjp_bwd)
+
+
 def ring_attention_local(
     q_loc: jnp.ndarray,
     k_loc: jnp.ndarray,
@@ -218,6 +404,7 @@ def ring_attention_local(
     interpret: Optional[bool] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    load_balance: bool = False,
 ) -> jnp.ndarray:
     """The ring program on LOCAL sequence shards — for callers already
     inside a ``shard_map`` whose mesh has ``axis`` (e.g. sequence
@@ -229,7 +416,13 @@ def ring_attention_local(
     ops/attention.py::attention). The flash path differentiates through the
     ring-level custom VJP; the einsum path through outer autodiff (ppermute
     transposes to the reverse rotation — a bijection, none of psum's
-    replication pitfalls)."""
+    replication pitfalls).
+
+    ``load_balance``: zigzag layout for the flash path — the shards are
+    re-laid so every device runs equal work per causal ring step (see
+    _zigzag_layout; the transform is internal and the result identical).
+    Ignored on the reference path (a correctness fallback, not a perf
+    path) and at sp == 1."""
     d = q_loc.shape[-1]
     scale = sm_scale if sm_scale is not None else float(1.0 / (d**0.5))
     interp = interpret if interpret is not None else _interpret_default()
@@ -244,20 +437,43 @@ def ring_attention_local(
         )
     if impl == "flash":
         blocks = (block_q, block_k) if (block_q or block_k) else None
+        b_, h_, sl, _ = q_loc.shape
+        zig = (
+            load_balance
+            and sp > 1
+            and sl % 2 == 0
+            # the kernels run on HALF-length shards under zigzag
+            and flash_supported(
+                (b_, h_, sl // 2, d), (b_, k_loc.shape[1], sl // 2, d),
+                block_q, block_k,
+            )
+        )
         d_pad = _lane_pad(d)
         if d_pad != d:
             # zero-pad head dim to the lane width around the kernels
             # (exact — same trick as ops/attention.py::attention); scale is
             # already fixed from the true d
             pad = ((0, 0), (0, 0), (0, 0), (0, d_pad - d))
-            out = _ring_flash_attention(
-                jnp.pad(q_loc, pad), jnp.pad(k_loc, pad), jnp.pad(v_loc, pad),
-                axis, sp, scale, interp, blocks,
+            q_loc, k_loc, v_loc = (
+                jnp.pad(q_loc, pad), jnp.pad(k_loc, pad), jnp.pad(v_loc, pad)
             )
-            return out[..., :d]
-        return _ring_flash_attention(
-            q_loc, k_loc, v_loc, axis, sp, scale, interp, blocks
-        )
+        if zig:
+            my = jax.lax.axis_index(axis)
+            qz = jnp.concatenate(_zigzag_layout(q_loc, axis, sp, my), axis=2)
+            kz = jnp.concatenate(_zigzag_layout(k_loc, axis, sp, my), axis=2)
+            vz = jnp.concatenate(_zigzag_layout(v_loc, axis, sp, my), axis=2)
+            oz = _ring_flash_attention_zigzag(
+                qz, kz, vz, axis, sp, scale, interp, blocks
+            )
+            half = oz.shape[2] // 2
+            out = _zigzag_unlayout(
+                oz[:, :, :half], oz[:, :, half:], axis, sp, my
+            )
+        else:
+            out = _ring_flash_attention(
+                q_loc, k_loc, v_loc, axis, sp, scale, interp, blocks
+            )
+        return out[..., :d] if d_pad != d else out
     hq, hkv = q_loc.shape[1], k_loc.shape[1]
     group = hq // hkv
     my = jax.lax.axis_index(axis)
@@ -298,11 +514,12 @@ def ring_attention(
     interpret: Optional[bool] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    load_balance: bool = False,
 ) -> jnp.ndarray:
     """q/k/v: [B, H, S, D] GLOBAL shapes, sequence sharded over ``axis``
     (and batch over dp/fsdp if present). Returns [B, H, S, D] with the same
-    sharding. impl/block_q/block_k select the in-chip block math (see
-    ``ring_attention_local``).
+    sharding. impl/block_q/block_k/load_balance select the in-chip block
+    math (see ``ring_attention_local``).
     """
     if not causal:
         raise NotImplementedError("ring attention currently implements causal LM")
@@ -325,6 +542,7 @@ def ring_attention(
         return ring_attention_local(
             q_loc, k_loc, v_loc, axis=axis, sp=sp, sm_scale=sm_scale,
             impl=impl, interpret=interpret, block_q=block_q, block_k=block_k,
+            load_balance=load_balance,
         )
 
     return _ring(q, k, v)
