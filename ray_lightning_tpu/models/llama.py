@@ -68,9 +68,10 @@ class LlamaConfig:
     # sequence-chunked LM loss (ops/losses.py): 0/1 = monolithic logits;
     # N>1 = CE computed over N sequence chunks under remat, so peak
     # logits memory is O(B*(S/N)*V) instead of O(B*S*V) — the usual
-    # activation peak at large vocab. Ignored under pp (the 1f1b path
-    # already never materializes global logits) and sp (sequence is
-    # sharded; chunking would reshard).
+    # activation peak at large vocab. Composes with the GPipe pp path
+    # (the pipeline returns hidden states, the head applies per chunk);
+    # ignored under 1f1b (it never materializes global logits) and sp
+    # (sequence sharded; chunking would cross shard boundaries).
     loss_chunks: int = 0
     # zigzag layout for ring attention under 'sp': every device runs equal
     # work per causal ring step (~2x at large sp; numerically identical —
@@ -639,6 +640,7 @@ def _forward_pp(
     tokens: jnp.ndarray,
     cfg: LlamaConfig,
     mesh: Mesh,
+    return_hidden: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Pipeline-parallel forward: the layer stack is split into pp stages
     (GPipe microbatch schedule, parallel/pipeline.py); embed and lm_head run
@@ -668,6 +670,8 @@ def _forward_pp(
     )
     x, aux = res if cfg.n_experts else (res, jnp.float32(0.0))
     x = rmsnorm(x, params["final_norm"])
+    if return_hidden:
+        return x, aux
     return x @ params["lm_head"], aux
 
 
@@ -688,9 +692,7 @@ def forward(
     (GPipe schedule) when the mesh has pipeline stages.
     """
     if mesh is not None and "pp" in mesh.axis_names and mesh.shape["pp"] > 1:
-        if return_hidden:
-            raise ValueError("return_hidden is not supported on the pp path")
-        return _forward_pp(params, tokens, cfg, mesh)
+        return _forward_pp(params, tokens, cfg, mesh, return_hidden)
     B, S = tokens.shape
     hd = cfg.head_dim
     x = params["embed"][tokens]  # gather -> [B, S, D]
@@ -826,11 +828,14 @@ def lm_loss(
         return _lm_loss_pp_1f1b(params, tokens, cfg, mesh)
     targets = jnp.roll(tokens, -1, axis=1)
     mask = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
+    # chunking composes with pp (GPipe returns pipeline hidden states and
+    # the head applies per chunk — without it the gpipe path is the one
+    # place full [B, S, V] logits still materialize) but not with sp (the
+    # sequence is sharded; the chunk reshape would cross shard boundaries)
     chunkable = cfg.loss_chunks > 1 and not (
-        mesh is not None and any(
-            ax in mesh.axis_names and mesh.shape[ax] > 1
-            for ax in ("pp", "sp")
-        )
+        mesh is not None
+        and "sp" in mesh.axis_names
+        and mesh.shape["sp"] > 1
     )
     if chunkable:
         # never materialize [B, S, V]: CE over sequence chunks under

@@ -969,6 +969,30 @@ def test_chunked_loss_matches_monolithic():
         scale = float(jnp.max(jnp.abs(g_mono[name]))) + 1e-12
         assert err < 1e-6 + 1e-4 * scale, (name, err)
 
+    # the GPipe pp path composes with chunking (the pipeline hands back
+    # hidden states; the head applies per chunk — full [B, S, V] logits
+    # never materialize)
+    mesh = build_mesh(MeshSpec(axes={"pp": 2, "dp": 4}))
+    base_pp = dataclasses.replace(base, pp_microbatches=2)
+    chunk_pp = dataclasses.replace(base_pp, loss_chunks=4)
+    tok8 = jnp.asarray(
+        np.random.default_rng(10).integers(0, base.vocab_size, (8, base.max_seq)),
+        jnp.int32,
+    )
+    l_pp = float(jax.jit(lambda p: lm_loss(p, tok8, base_pp, mesh)[0])(params))
+    l_pp_c = float(
+        jax.jit(lambda p: lm_loss(p, tok8, chunk_pp, mesh)[0])(params)
+    )
+    assert abs(l_pp - l_pp_c) < 1e-5, (l_pp, l_pp_c)
+    g_pp = jax.jit(jax.grad(lambda p: lm_loss(p, tok8, base_pp, mesh)[0]))(params)
+    g_pp_c = jax.jit(
+        jax.grad(lambda p: lm_loss(p, tok8, chunk_pp, mesh)[0])
+    )(params)
+    for name in ("lm_head", "embed", "final_norm"):
+        err = float(jnp.max(jnp.abs(g_pp[name] - g_pp_c[name])))
+        scale = float(jnp.max(jnp.abs(g_pp[name]))) + 1e-12
+        assert err < 1e-6 + 1e-4 * scale, (name, err)
+
 
 @pytest.mark.slow
 def test_chunked_loss_trains_on_mesh(tmp_root):
