@@ -6,7 +6,7 @@ layer-norm, flax module + LightningModule fine-tune/MLM heads.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax

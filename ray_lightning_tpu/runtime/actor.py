@@ -32,7 +32,6 @@ import struct
 import threading
 import traceback
 from concurrent.futures import Future
-from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
 import cloudpickle

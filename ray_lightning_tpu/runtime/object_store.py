@@ -18,7 +18,7 @@ import ctypes
 import os
 import pickle
 import secrets
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from multiprocessing import shared_memory
 from typing import Any, Dict
 

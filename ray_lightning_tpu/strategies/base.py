@@ -16,7 +16,7 @@ strategies (launch + multi-host) derive from it and add a launcher.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np

@@ -12,7 +12,6 @@ needed) — the reference hard-requires a Ray strategy.
 """
 from __future__ import annotations
 
-import os
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np

@@ -6,7 +6,7 @@ ray_lightning/launchers/ray_launcher.py:101-103, tune.py:28-29).
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Optional
 
 
 class TrialSession:

@@ -29,7 +29,6 @@ from ray_lightning_tpu.tune.schedulers import (
     EXPLOIT,
     STOP,
     FIFOScheduler,
-    PopulationBasedTraining,
     TrialScheduler,
 )
 from ray_lightning_tpu.tune.search import generate_trial_configs, mutate_config

@@ -11,7 +11,6 @@ import os
 import sys
 import time
 
-import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench

@@ -4,7 +4,6 @@ mesh-resharding restore, and launcher fault tolerance."""
 import os
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

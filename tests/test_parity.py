@@ -5,7 +5,6 @@ import os
 
 import pytest
 
-import ray_lightning_tpu as rlt
 from ray_lightning_tpu.accelerators import (
     DelayedTPUAccelerator,
     ensure_driver_off_accelerator,

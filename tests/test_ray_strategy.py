@@ -10,7 +10,7 @@ from jax.sharding import PartitionSpec as P
 
 import ray_lightning_tpu as rlt
 from ray_lightning_tpu.parallel.mesh import MeshSpec, build_mesh
-from ray_lightning_tpu.parallel.sharding import ShardingPolicy, fsdp_param_shardings
+from ray_lightning_tpu.parallel.sharding import fsdp_param_shardings
 from ray_lightning_tpu.strategies.ray_strategies import (
     HorovodRayStrategy,
     RayShardedStrategy,

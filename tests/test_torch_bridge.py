@@ -17,7 +17,6 @@ from torch import nn  # noqa: E402
 
 import ray_lightning_tpu as rlt  # noqa: E402
 from ray_lightning_tpu.interop import (  # noqa: E402
-    TorchModuleAdapter,
     UnsupportedTorchOp,
     adapt_torch_module,
     torch_optimizer_to_optax,

@@ -17,7 +17,6 @@ from ray_lightning_tpu import (
     DictDataset,
     LightningDataModule,
     LightningModule,
-    ModelCheckpoint,
     RandomDataset,
     Trainer,
 )
