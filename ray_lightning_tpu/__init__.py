@@ -14,6 +14,7 @@ from ray_lightning_tpu.core.data import (
     TensorDataset,
     DictDataset,
     RandomDataset,
+    TokenFileDataset,
     DistributedSampler,
 )
 from ray_lightning_tpu.core.trainer import Trainer
@@ -48,6 +49,7 @@ __all__ = [
     "TensorDataset",
     "DictDataset",
     "RandomDataset",
+    "TokenFileDataset",
     "DistributedSampler",
     "Trainer",
     "Strategy",

@@ -17,6 +17,7 @@ boundary (torch here is CPU-only input tooling, never the compute path).
 from __future__ import annotations
 
 import math
+import os
 from typing import Any, Callable, Iterator, Optional, Sequence
 
 import numpy as np
@@ -56,6 +57,69 @@ class DictDataset(Dataset):
 
     def __getitem__(self, idx):
         return {k: v[idx] for k, v in self.arrays.items()}
+
+
+class TokenFileDataset(Dataset):
+    """LM pretraining over a memory-mapped token file — corpora larger
+    than RAM stream from disk with zero copies until batch assembly.
+
+    ``path``: a flat binary file of token ids (``dtype``, default
+    uint16 — vocabularies to 65k; use uint32 beyond). Sample ``i`` is
+    the window ``tokens[i * stride : i * stride + seq_len]`` as an
+    ``{"input_ids": int32[seq_len]}`` dict (the llama module's batch
+    shape). ``stride`` defaults to ``seq_len`` (disjoint windows);
+    smaller strides overlap windows for more samples per token.
+
+    Works with :class:`DistributedSampler` like any map-style dataset —
+    each worker touches only the file pages its indices hit (the OS page
+    cache is the shuffle-friendly prefetcher), so multi-worker training
+    needs no up-front sharding of the corpus.
+
+    ``np.memmap`` objects don't pickle; the mapping is reopened lazily
+    after a cloudpickle hop to a worker actor.
+    """
+
+    def __init__(self, path: str, seq_len: int, dtype="uint16",
+                 stride: Optional[int] = None):
+        # absolute: the lazy reopen may run in a worker actor whose cwd
+        # differs from the driver's — pin the file that was validated
+        self.path = os.path.abspath(path)
+        self.seq_len = int(seq_len)
+        self.dtype = np.dtype(dtype)
+        self.stride = int(stride) if stride is not None else self.seq_len
+        if self.stride <= 0 or self.seq_len <= 0:
+            raise ValueError("seq_len and stride must be positive")
+        # floor: a trailing partial token (truncated write) is ignored —
+        # the explicit shape below makes this flooring authoritative so
+        # np.memmap never rejects a non-multiple file size at first read
+        self._n_tokens = os.path.getsize(self.path) // self.dtype.itemsize
+        if self._n_tokens < self.seq_len:
+            raise ValueError(
+                f"{path}: {self._n_tokens} tokens < seq_len {self.seq_len}"
+            )
+        self._n = 1 + (self._n_tokens - self.seq_len) // self.stride
+        self._mm = None
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_mm"] = None  # reopen on the other side
+        return state
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, idx):
+        if not 0 <= idx < self._n:
+            # a silent short window would only explode later in collate
+            # (and a missing IndexError makes `for x in ds` loop forever)
+            raise IndexError(f"index {idx} out of range for {self._n} windows")
+        if self._mm is None:
+            self._mm = np.memmap(
+                self.path, dtype=self.dtype, mode="r", shape=(self._n_tokens,)
+            )
+        start = idx * self.stride
+        window = self._mm[start:start + self.seq_len]
+        return {"input_ids": np.asarray(window, dtype=np.int32)}
 
 
 class RandomDataset(Dataset):
