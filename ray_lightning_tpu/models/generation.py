@@ -125,7 +125,7 @@ def prefill(
         "k": jax.lax.dynamic_update_slice(cache["k"], ks.astype(cache["k"].dtype), zeros_idx),
         "v": jax.lax.dynamic_update_slice(cache["v"], vs.astype(cache["v"].dtype), zeros_idx),
     }
-    h = rmsnorm(x[:, -1], params["final_norm"])
+    h = rmsnorm(x[:, -1], params["final_norm"], cfg.norm_eps)
     logits = h @ params["lm_head"]
     return logits.astype(jnp.float32), cache
 
@@ -163,7 +163,7 @@ def decode_step(
         nh = lp["wq"].shape[-1] // hd
         nkv = lp["wk"].shape[-1] // hd
         group = nh // nkv
-        h = rmsnorm(x, lp["attn_norm"])
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
         q = (h @ lp["wq"]).reshape(B, nh, hd)
         k = (h @ lp["wk"]).reshape(B, nkv, hd)
         v = (h @ lp["wv"]).reshape(B, nkv, hd)
@@ -185,7 +185,7 @@ def decode_step(
         att = jnp.einsum("bhgt,bhtd->bhgd", probs, v_cache.astype(jnp.float32))
         att = att.reshape(B, nh * hd).astype(x.dtype)
         x = x + att @ lp["wo"]
-        h2 = rmsnorm(x, lp["mlp_norm"])
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         if cfg.n_experts and "moe" in lp:
             from ray_lightning_tpu.parallel.moe import moe_ffn_lossless
 
@@ -205,7 +205,7 @@ def decode_step(
     x, (k_new, v_new) = jax.lax.scan(
         layer_fn, x, (params["layers"], cache["k"], cache["v"])
     )
-    x = rmsnorm(x, params["final_norm"])
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = x @ params["lm_head"]
     return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
 

@@ -1,0 +1,135 @@
+"""Import HuggingFace Llama checkpoints into the native param pytree.
+
+The flagship family is bit-compatible with the HF Llama architecture
+(half-split "rotate_half" rope, RMSNorm, SwiGLU MLP, GQA), so a weight
+relayout is all an import needs: torch ``[out, in]`` projections
+transpose to our ``[in, out]``, per-layer tensors stack into the
+``[L, ...]`` scanned leaves, and the config fields map one-to-one.
+Logit parity against ``transformers``' own forward is tested
+(tests/test_llama.py::test_hf_llama_import_logit_parity).
+
+This is the "bring your pretrained model" path the reference gets for
+free by wrapping torch modules: fine-tune or serve a real Llama
+checkpoint on any mesh layout (the imported pytree carries the same
+megatron/fsdp PartitionSpecs as a natively-initialized one).
+
+torch is CPU-side import tooling here, never the compute path.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ray_lightning_tpu.models.llama import LlamaConfig
+
+
+def config_from_hf(hf_config, dtype=jnp.bfloat16, **overrides) -> LlamaConfig:
+    """Map a ``transformers.LlamaConfig`` onto :class:`LlamaConfig`."""
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
+        # Llama-3.1+ checkpoints rescale inv_freq ('llama3' rope_type);
+        # importing with plain rope_theta would silently produce different
+        # angles at every position
+        raise NotImplementedError(
+            f"rope_scaling={scaling!r} is not mapped; the native rope is "
+            "unscaled. Import a checkpoint without rope scaling, or extend "
+            "rope_angles first."
+        )
+    if getattr(hf_config, "attention_bias", False) or getattr(
+        hf_config, "mlp_bias", False
+    ):
+        raise NotImplementedError(
+            "attention_bias/mlp_bias checkpoints are not mapped (the native "
+            "layers are bias-free, matching standard Llama)"
+        )
+    fields = dict(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(
+            hf_config, "num_key_value_heads", hf_config.num_attention_heads
+        ),
+        ffn_dim=hf_config.intermediate_size,
+        max_seq=hf_config.max_position_embeddings,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=float(hf_config.rms_norm_eps),
+        dtype=dtype,
+    )
+    fields.update(overrides)
+    return LlamaConfig(**fields)
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().to("cpu").to_dense().float().numpy()
+
+
+def import_hf_llama(
+    model_or_path, dtype=jnp.bfloat16, **config_overrides
+) -> Tuple[Dict[str, Any], LlamaConfig]:
+    """Build ``(params, cfg)`` from a ``transformers`` Llama model.
+
+    ``model_or_path``: a ``LlamaForCausalLM`` instance, or a name/path for
+    ``LlamaForCausalLM.from_pretrained``. Tied word embeddings
+    (``tie_word_embeddings``) materialize as an explicit ``lm_head``.
+    ``config_overrides`` go to :class:`LlamaConfig` (e.g. a shorter
+    ``max_seq`` for fine-tuning, ``remat_policy=...``).
+    """
+    if isinstance(model_or_path, str):
+        from transformers import LlamaForCausalLM
+
+        model_or_path = LlamaForCausalLM.from_pretrained(model_or_path)
+    model = model_or_path
+    cfg = config_from_hf(model.config, dtype=dtype, **config_overrides)
+    hd = cfg.head_dim
+    if cfg.n_heads * hd != cfg.dim:
+        raise ValueError(
+            f"hidden_size {cfg.dim} != num_attention_heads {cfg.n_heads} x "
+            f"head_dim {hd}: non-uniform head dims are not supported"
+        )
+
+    sd = {k: v for k, v in model.state_dict().items()}
+    dt = cfg.dtype
+
+    def take(name, transpose=False):
+        # per-tensor to the TARGET dtype immediately: only one fp32 copy
+        # is ever transient, so an 8B-scale import peaks near
+        # torch-model + imported-pytree instead of 2x more
+        arr = _np(sd[name])
+        return jnp.asarray(arr.T if transpose else arr, dt)
+
+    layers: Dict[str, Any] = {
+        "attn_norm": [], "wq": [], "wk": [], "wv": [], "wo": [],
+        "mlp_norm": [], "w_gate": [], "w_up": [], "w_down": [],
+    }
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        layers["attn_norm"].append(take(p + "input_layernorm.weight"))
+        # torch Linear stores [out, in]; the native layout is [in, out]
+        layers["wq"].append(take(p + "self_attn.q_proj.weight", True))
+        layers["wk"].append(take(p + "self_attn.k_proj.weight", True))
+        layers["wv"].append(take(p + "self_attn.v_proj.weight", True))
+        layers["wo"].append(take(p + "self_attn.o_proj.weight", True))
+        layers["mlp_norm"].append(take(p + "post_attention_layernorm.weight"))
+        layers["w_gate"].append(take(p + "mlp.gate_proj.weight", True))
+        layers["w_up"].append(take(p + "mlp.up_proj.weight", True))
+        layers["w_down"].append(take(p + "mlp.down_proj.weight", True))
+
+    embed = take("model.embed_tokens.weight")  # [V, D]
+    if getattr(model.config, "tie_word_embeddings", False):
+        # tied checkpoints alias lm_head to the embedding; materialize the
+        # native layout explicitly (torch state_dicts often still carry
+        # the aliased lm_head.weight key — the config flag is the truth)
+        lm_head = embed.T
+    else:
+        lm_head = take("lm_head.weight", True)  # [D, V]
+
+    params = {
+        "embed": embed,
+        "layers": {k: jnp.stack(v) for k, v in layers.items()},
+        "final_norm": take("model.norm.weight"),
+        "lm_head": lm_head,
+    }
+    return params, cfg

@@ -319,7 +319,7 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
     hd = cfg.head_dim
     nh = lp["wq"].shape[-1] // hd  # local heads (== cfg.n_heads unless tp-sharded)
     nkv = lp["wk"].shape[-1] // hd
-    h = fin(rmsnorm(x, lp["attn_norm"]))
+    h = fin(rmsnorm(x, lp["attn_norm"], cfg.norm_eps))
     q = (h @ lp["wq"]).reshape(B, S, nh, hd)
     k = (h @ lp["wk"]).reshape(B, S, nkv, hd)
     v = (h @ lp["wv"]).reshape(B, S, nkv, hd)
@@ -335,7 +335,7 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
         # NOT fin-wrapped: the moe impl wraps its own input over (ep, tp)
         # when it needs the f operator (vjp_safe) — a second wrap here
         # would double the input cotangent's tp psum under 1F1B
-        h2 = rmsnorm(x, lp["mlp_norm"])
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         if moe_lossless:  # inference: no-drop routing, no dispatch tensors
             moe_out = moe_ffn_lossless(lp["moe"], h2, top_k=cfg.expert_top_k)
             aux = jnp.float32(0.0)
@@ -351,7 +351,7 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
             )
         x = x + moe_out
     else:
-        h2 = fin(rmsnorm(x, lp["mlp_norm"]))
+        h2 = fin(rmsnorm(x, lp["mlp_norm"], cfg.norm_eps))
         gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
         x = x + red(gated @ lp["w_down"])
         aux = jnp.float32(0.0)
@@ -668,7 +668,7 @@ def _forward_pp(
         param_spec=stage_spec, with_aux=bool(cfg.n_experts),
     )
     x, aux = res if cfg.n_experts else (res, jnp.float32(0.0))
-    x = rmsnorm(x, params["final_norm"])
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     if return_hidden:
         return x, aux
     return x @ params["lm_head"], aux
@@ -726,7 +726,7 @@ def forward(
 
     scanned = _remat_wrap(layer_fn, cfg)
     x, aux_losses = jax.lax.scan(scanned, x, params["layers"])
-    x = rmsnorm(x, params["final_norm"])
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     if return_hidden:
         return x, jnp.mean(aux_losses)
     logits = x @ params["lm_head"]
@@ -771,7 +771,7 @@ def _lm_loss_pp_1f1b(
     # lockstep collectives either way. The per-tick logits are one
     # [mb, S/sp, V] microbatch shard (never the global [B, S, V]).
     def last_fn(last_p, y, tgt):
-        h = rmsnorm(y, last_p["final_norm"])
+        h = rmsnorm(y, last_p["final_norm"], cfg.norm_eps)
         logits = h @ last_p["lm_head"]
         losses = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), tgt
