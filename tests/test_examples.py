@@ -29,3 +29,9 @@ def test_torch_bridge_example_smoke():
     out = _run_example("torch_bridge_example.py", "--smoke-test",
                        "--max-epochs", "1")
     assert "torch-side accuracy" in out
+
+
+@pytest.mark.slow
+def test_hf_finetune_example_smoke():
+    out = _run_example("hf_finetune_example.py", "--smoke-test")
+    assert "fine-tune + generate OK" in out

@@ -226,8 +226,7 @@ def test_hf_llama_import_logit_parity(tmp_root):
 
     # the imported weights fine-tune through the real Trainer on a mesh
     module = LlamaModule(cfg, lr=1e-3)
-    module._params = params
-    module.init_params = lambda rng: params  # resume from the import
+    module.params = params  # warm start from the import
     strategy = rlt.XLAStrategy(
         mesh_spec=MeshSpec(axes={"dp": 2, "fsdp": 2, "tp": 2}),
         sharding_policy=ShardingPolicy(zero_stage=3, data_axes=("dp", "fsdp")),
