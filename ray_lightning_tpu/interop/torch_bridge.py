@@ -134,6 +134,149 @@ def _dropout(x, p, rng):
     return jnp.where(keep, x / (1.0 - p), 0.0)
 
 
+def _multihead_attention(p, prefix, mod, query, key, value, kwargs, rng):
+    """nn.MultiheadAttention: packed in-projection, per-head scaled dot
+    product, out-projection. Returns ``(out, avg_weights)`` like torch's
+    default (``need_weights=True, average_attn_weights=True``).
+
+    Dynamic masks (attn_mask / key_padding_mask tensors) are refused at
+    adapt time by :func:`_check_module`; the static ``is_causal=True``
+    flag is supported. Explicit einsum math (not the flash kernel) so the
+    weights torch callers unpack are real — bridged torch models are
+    small, and XLA fuses this fine."""
+    if kwargs.get("attn_mask") is not None or kwargs.get(
+        "key_padding_mask"
+    ) is not None:
+        raise UnsupportedTorchOp(
+            f"{prefix}: MultiheadAttention with a mask tensor; only "
+            "is_causal=True is mapped"
+        )
+    is_causal = bool(kwargs.get("is_causal", False))
+    if not mod.batch_first:
+        # torch default layout is [S, B, E]
+        query, key, value = (
+            jnp.swapaxes(t, 0, 1) for t in (query, key, value)
+        )
+    e = mod.embed_dim
+    h = mod.num_heads
+    hd = e // h
+    if mod._qkv_same_embed_dim:
+        w = p[f"{prefix}.in_proj_weight"]  # [3E, E]
+        wq, wk, wv = w[:e], w[e:2 * e], w[2 * e:]
+    else:
+        wq = p[f"{prefix}.q_proj_weight"]
+        wk = p[f"{prefix}.k_proj_weight"]
+        wv = p[f"{prefix}.v_proj_weight"]
+    b = p.get(f"{prefix}.in_proj_bias")
+    bq, bk, bv = (
+        (b[:e], b[e:2 * e], b[2 * e:]) if b is not None else (None,) * 3
+    )
+
+    def proj(x, w, bias):
+        y = x @ w.T
+        return y + bias if bias is not None else y
+
+    bsz, sq = query.shape[0], query.shape[1]
+    skv = key.shape[1]
+    q = proj(query, wq, bq).reshape(bsz, sq, h, hd).transpose(0, 2, 1, 3)
+    k = proj(key, wk, bk).reshape(bsz, skv, h, hd).transpose(0, 2, 1, 3)
+    v = proj(value, wv, bv).reshape(bsz, skv, h, hd).transpose(0, 2, 1, 3)
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / np.sqrt(hd)
+    if is_causal:
+        rows = jnp.arange(sq)[:, None]
+        cols = jnp.arange(skv)[None, :]
+        logits = jnp.where(rows >= cols, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if rng is not None and mod.dropout > 0.0:
+        probs = _dropout(probs, mod.dropout, jax.random.fold_in(rng, 1))
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(probs.dtype))
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(bsz, sq, e).astype(query.dtype)
+    out = ctx @ p[f"{prefix}.out_proj.weight"].T
+    ob = p.get(f"{prefix}.out_proj.bias")
+    if ob is not None:
+        out = out + ob
+    weights = jnp.mean(probs, axis=1)  # torch's head-averaged default
+    if not mod.batch_first:
+        out = jnp.swapaxes(out, 0, 1)
+    return out, weights
+
+
+def _gelu(x, approximate="none"):
+    """torch's gelu defaults to the exact erf form; jax.nn.gelu defaults to
+    the tanh approximation — map explicitly so they cannot drift."""
+    return jax.nn.gelu(x, approximate=approximate == "tanh")
+
+
+def _encoder_layer_act(mod):
+    import torch.nn.functional as F
+
+    act = mod.activation
+    if act in (F.relu,) or getattr(act, "__name__", "") == "relu" or isinstance(
+        act, nn.ReLU
+    ):
+        return jax.nn.relu
+    if act in (F.gelu,) or getattr(act, "__name__", "") == "gelu":
+        return _gelu  # F.gelu default: exact erf
+    if isinstance(act, nn.GELU):
+        return lambda x: _gelu(x, act.approximate)
+    raise UnsupportedTorchOp(
+        f"TransformerEncoderLayer activation {act!r}; relu/gelu are mapped"
+    )
+
+
+def _transformer_encoder_layer(p, prefix, mod, x, rng, is_causal=False):
+    """nn.TransformerEncoderLayer (self-attention block): both norm_first
+    orders, relu/gelu activations, internal dropouts keyed off ``rng``."""
+    act = _encoder_layer_act(mod)
+    r = (lambda i: jax.random.fold_in(rng, i)) if rng is not None else (
+        lambda i: None
+    )
+
+    def attn(y):
+        out, _ = _multihead_attention(
+            p, f"{prefix}.self_attn", mod.self_attn, y, y, y,
+            {"is_causal": is_causal}, r(10)
+        )
+        return _dropout(out, mod.dropout1.p, r(11))
+
+    def ff(y):
+        hline = act(y @ p[f"{prefix}.linear1.weight"].T + p[f"{prefix}.linear1.bias"])
+        hline = _dropout(hline, mod.dropout.p, r(12))
+        hline = hline @ p[f"{prefix}.linear2.weight"].T + p[f"{prefix}.linear2.bias"]
+        return _dropout(hline, mod.dropout2.p, r(13))
+
+    def norm(y, which):
+        nm = getattr(mod, which)
+        return _layer_norm(
+            p, f"{prefix}.{which}", y, tuple(nm.normalized_shape), nm.eps,
+            nm.elementwise_affine,
+        )
+
+    if mod.norm_first:
+        x = x + attn(norm(x, "norm1"))
+        x = x + ff(norm(x, "norm2"))
+    else:
+        x = norm(x + attn(x), "norm1")
+        x = norm(x + ff(x), "norm2")
+    return x
+
+
+def _transformer_encoder(p, prefix, mod, x, rng, is_causal=False):
+    for i, layer in enumerate(mod.layers):
+        r = jax.random.fold_in(rng, i) if rng is not None else None
+        x = _transformer_encoder_layer(
+            p, f"{prefix}.layers.{i}", layer, x, r, is_causal=is_causal
+        )
+    if mod.norm is not None:
+        x = _layer_norm(
+            p, f"{prefix}.norm", x, tuple(mod.norm.normalized_shape),
+            mod.norm.eps, mod.norm.elementwise_affine,
+        )
+    return x
+
+
 def _batch_norm(p, prefix, x, mod, train, updates):
     """nn.BatchNorm1d/2d with running-stat threading. Train mode
     normalizes with batch statistics and records the momentum-updated
@@ -355,12 +498,37 @@ def fx_to_jax(
                             f"arguments {sorted(ckw)}; pass step_fn="
                         )
                     env[node.name] = torch_loss_to_jax(mod)(out_v, y_v)
+                elif isinstance(mod, nn.MultiheadAttention):
+                    cargs = look(node.args)
+                    env[node.name] = _multihead_attention(
+                        p, str(node.target), mod, cargs[0], cargs[1],
+                        cargs[2], look(dict(node.kwargs)),
+                        rng if train else None,
+                    )
+                elif isinstance(
+                    mod, (nn.TransformerEncoderLayer, nn.TransformerEncoder)
+                ):
+                    fn = (
+                        _transformer_encoder_layer
+                        if isinstance(mod, nn.TransformerEncoderLayer)
+                        else _transformer_encoder
+                    )
+                    ckw = look(dict(node.kwargs))
+                    env[node.name] = fn(
+                        p, str(node.target), mod, look(node.args[0]),
+                        rng if train else None,
+                        is_causal=bool(ckw.get("is_causal", False)),
+                    )
                 else:
                     x = look(node.args[0])
                     env[node.name] = _call_module(
                         p, str(node.target), mod, x, rng, train, updates
                     )
-                if isinstance(mod, nn.Dropout) and rng is not None:
+                if rng is not None and isinstance(
+                    mod,
+                    (nn.Dropout, nn.MultiheadAttention,
+                     nn.TransformerEncoderLayer, nn.TransformerEncoder),
+                ):
                     rng, _ = jax.random.split(rng)
             elif node.op == "call_function":
                 env[node.name] = _call_function(
@@ -399,7 +567,7 @@ def fx_to_jax(
     # failure beats a train-time one
     for node in gm.graph.nodes:
         if node.op == "call_module":
-            _check_module(modules[node.target], node.target)
+            _check_module(modules[node.target], node.target, node)
         elif node.op == "call_function":
             _check_function(node.target, node)
         elif node.op == "call_method":
@@ -415,17 +583,68 @@ def _loss_module_types():
     )
 
 
-def _check_module(mod, name):
+def _check_module(mod, name, node=None):
     supported = (
         nn.Linear, nn.ReLU, nn.GELU, nn.Tanh, nn.Sigmoid, nn.SiLU, nn.ELU,
         nn.LeakyReLU, nn.Softplus, nn.LayerNorm, nn.Embedding, nn.Dropout,
         nn.Flatten, nn.Identity, nn.Conv2d, nn.MaxPool2d, nn.AvgPool2d,
         nn.Softmax, nn.LogSoftmax, nn.BatchNorm1d, nn.BatchNorm2d,
+        nn.MultiheadAttention, nn.TransformerEncoderLayer,
+        nn.TransformerEncoder,
     ) + _loss_module_types()
     if isinstance(mod, _loss_module_types()):
         # criterion options (label_smoothing, weight, reduction) change
         # the math the jax mapping reproduces — refuse at adapt time
         _validate_loss_module_options(mod, type(mod).__name__)
+        return
+    if isinstance(
+        mod,
+        (nn.MultiheadAttention, nn.TransformerEncoderLayer,
+         nn.TransformerEncoder),
+    ):
+        attn = mod if isinstance(mod, nn.MultiheadAttention) else None
+        if isinstance(mod, nn.TransformerEncoderLayer):
+            attn = mod.self_attn
+        elif isinstance(mod, nn.TransformerEncoder):
+            attn = mod.layers[0].self_attn
+        if attn.bias_k is not None or attn.add_zero_attn:
+            raise UnsupportedTorchOp(
+                f"layer {name!r}: add_bias_kv/add_zero_attn are not mapped"
+            )
+        if node is not None:
+            # dynamic mask tensors change the math; refuse at ADAPT time
+            # (the static is_causal=True literal is supported). Masks can
+            # also arrive POSITIONALLY (MHA arg 4+, encoder arg 2+).
+            max_pos = 3 if isinstance(mod, nn.MultiheadAttention) else 1
+            if any(a is not None for a in node.args[max_pos:]):
+                raise UnsupportedTorchOp(
+                    f"layer {name!r}: positional mask arguments are not "
+                    "mapped; only is_causal=True is supported"
+                )
+            for k in ("attn_mask", "key_padding_mask", "mask",
+                      "src_key_padding_mask", "src_mask"):
+                if node.kwargs.get(k) is not None:
+                    raise UnsupportedTorchOp(
+                        f"layer {name!r}: mask argument {k!r} is not "
+                        "mapped; only is_causal=True is supported"
+                    )
+            if node.kwargs.get("average_attn_weights") is False:
+                raise UnsupportedTorchOp(
+                    f"layer {name!r}: average_attn_weights=False (per-head "
+                    "weights) is not mapped"
+                )
+        if isinstance(mod, nn.TransformerEncoder) and mod.norm is not None:
+            if not isinstance(mod.norm, nn.LayerNorm):
+                raise UnsupportedTorchOp(
+                    f"layer {name!r}: encoder norm "
+                    f"{type(mod.norm).__name__} is not mapped (LayerNorm "
+                    "only)"
+                )
+        if isinstance(mod, nn.TransformerEncoderLayer):
+            _encoder_layer_act(mod)  # refuse exotic activations now
+        if isinstance(mod, nn.TransformerEncoder):
+            for sub in mod.layers:
+                _encoder_layer_act(sub)
         return
     if not isinstance(mod, supported):
         raise UnsupportedTorchOp(
@@ -474,8 +693,10 @@ def _call_module(p, prefix, mod, x, rng, train, updates):
         return jax.nn.softmax(x, axis=-1 if mod.dim is None else mod.dim)
     if isinstance(mod, nn.LogSoftmax):
         return jax.nn.log_softmax(x, axis=-1 if mod.dim is None else mod.dim)
+    if isinstance(mod, nn.GELU):
+        return _gelu(x, mod.approximate)
     act = {
-        nn.ReLU: jax.nn.relu, nn.GELU: jax.nn.gelu, nn.Tanh: jnp.tanh,
+        nn.ReLU: jax.nn.relu, nn.Tanh: jnp.tanh,
         nn.Sigmoid: jax.nn.sigmoid, nn.SiLU: jax.nn.silu, nn.ELU: jax.nn.elu,
         nn.LeakyReLU: jax.nn.leaky_relu, nn.Softplus: jax.nn.softplus,
     }.get(type(mod))
@@ -505,7 +726,9 @@ def _build_function_map():
         torch.unsqueeze: jnp.expand_dims, torch.transpose: _torch_transpose,
         torch.permute: lambda x, dims: jnp.transpose(x, dims),
         torch.softmax: _torch_softmax,
-        F.relu: jax.nn.relu, F.gelu: jax.nn.gelu, F.silu: jax.nn.silu,
+        F.relu: jax.nn.relu,
+        F.gelu: lambda x, approximate="none": _gelu(x, approximate),
+        F.silu: jax.nn.silu,
         F.elu: jax.nn.elu, F.leaky_relu: jax.nn.leaky_relu,
         F.tanh: jnp.tanh, F.sigmoid: jax.nn.sigmoid,
         F.softmax: _torch_softmax, F.log_softmax: _torch_log_softmax,

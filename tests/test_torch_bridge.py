@@ -625,6 +625,115 @@ def test_bridged_module_through_tune_sweep(tmp_root):
     assert analysis.best_config["lr"] in (1e-2, 1e-3)
 
 
+def test_transformer_encoder_parity_and_refusals():
+    """nn.MultiheadAttention / nn.TransformerEncoder(Layer) map as
+    composites (fx treats nn.* as leaves): logits match torch at eval
+    across batch_first, norm_first, is_causal and activation variants;
+    dynamic mask tensors refuse at adapt time."""
+
+    class EncoderClassifier(nn.Module):
+        def __init__(self, batch_first=True, norm_first=False,
+                     activation="relu", causal=False):
+            super().__init__()
+            self.causal = causal
+            layer = nn.TransformerEncoderLayer(
+                d_model=32, nhead=4, dim_feedforward=64, dropout=0.1,
+                batch_first=batch_first, norm_first=norm_first,
+                activation=activation,
+            )
+            self.encoder = nn.TransformerEncoder(layer, num_layers=2)
+            self.head = nn.Linear(32, 10)
+            self.criterion = nn.CrossEntropyLoss()
+
+        def forward(self, x):
+            y = self.encoder(x)
+            return self.head(y.mean(dim=1 if self.encoder.layers[0].self_attn.batch_first else 0))
+
+        def configure_optimizers(self):
+            return torch.optim.Adam(self.parameters(), lr=1e-3)
+
+    for batch_first, norm_first, act in (
+        (True, False, "relu"), (True, True, "gelu"), (False, False, "relu"),
+    ):
+        tm = EncoderClassifier(batch_first, norm_first, act).eval()
+        adapted = adapt_torch_module(tm)
+        params = adapted.init_params(None)
+        shape = (4, 6, 32) if batch_first else (6, 4, 32)
+        x = np.random.default_rng(0).normal(size=shape).astype(np.float32)
+        with torch.no_grad():
+            ref = tm(torch.from_numpy(x)).numpy()
+        out = np.asarray(adapted.forward(params, jnp.asarray(x)))
+        assert np.max(np.abs(ref - out)) < 1e-4, (batch_first, norm_first, act)
+
+    # bare MultiheadAttention incl. causal flag and the (out, weights) tuple
+    class MHAOnly(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.attn = nn.MultiheadAttention(32, 4, batch_first=True)
+            self.criterion = nn.MSELoss()
+
+        def forward(self, x):
+            out, w = self.attn(x, x, x, is_causal=True,
+                               attn_mask=None)
+            return out + w.sum() * 0.0
+
+        def configure_optimizers(self):
+            return torch.optim.Adam(self.parameters(), lr=1e-3)
+
+    tm = MHAOnly().eval()
+    adapted = adapt_torch_module(tm)
+    x = np.random.default_rng(1).normal(size=(2, 5, 32)).astype(np.float32)
+    with torch.no_grad():
+        # torch needs the explicit mask for is_causal to take effect here
+        m = torch.nn.Transformer.generate_square_subsequent_mask(5)
+        ref = tm.attn(torch.from_numpy(x), torch.from_numpy(x),
+                      torch.from_numpy(x), attn_mask=m)[0].numpy()
+    out = np.asarray(
+        adapted.forward(adapted.init_params(None), jnp.asarray(x))
+    )
+    assert np.max(np.abs(ref - out)) < 1e-4
+
+    # dynamic masks refuse at ADAPT time
+    class MaskedMHA(MHAOnly):
+        def forward(self, x):
+            mask = torch.zeros(5, 5)  # static size: traces into the graph
+            return self.attn(x, x, x, attn_mask=mask)[0]
+
+    with pytest.raises(UnsupportedTorchOp, match="mask"):
+        adapt_torch_module(MaskedMHA())
+
+
+def test_transformer_encoder_trains_through_trainer(tmp_root):
+    """A torch transformer-encoder classifier fine-tunes end to end on a
+    GSPMD mesh through the bridge (dropout active in train)."""
+
+    class TinyEncoder(nn.Module):
+        def __init__(self):
+            super().__init__()
+            layer = nn.TransformerEncoderLayer(
+                d_model=32, nhead=4, dim_feedforward=64, dropout=0.1,
+                batch_first=True,
+            )
+            self.encoder = nn.TransformerEncoder(layer, num_layers=1)
+            self.head = nn.Linear(32, 10)
+            self.criterion = nn.CrossEntropyLoss()
+
+        def forward(self, x):
+            return self.head(self.encoder(x).mean(dim=1))
+
+        def configure_optimizers(self):
+            return torch.optim.Adam(self.parameters(), lr=1e-3)
+
+    adapted = adapt_torch_module(TinyEncoder())
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(64, 6, 32)).astype(np.float32)
+    ys = rng.integers(0, 10, size=(64,)).astype(np.int32)
+    train = [(xs[i:i + 16], ys[i:i + 16]) for i in range(0, 64, 16)]
+    trainer = get_trainer(tmp_root, max_epochs=1, checkpoint_callback=False)
+    trainer.fit(adapted, train_dataloaders=train)
+    assert trainer.state.status == "finished"
+
+
 def test_torch_module_trains_through_trainer(tmp_root):
     """The headline: an unmodified torch pl-style module fit on a GSPMD
     dp mesh through the real Trainer; loss decreases; trained weights
