@@ -105,6 +105,7 @@ class Trial:
     results: List[Dict[str, Any]] = field(default_factory=list)
     checkpoints: List[Dict[str, Any]] = field(default_factory=list)
     error: Optional[str] = None
+    num_failures: int = 0
     last_iteration: int = 0
     _actor: Any = None
     _future: Any = None
@@ -269,6 +270,7 @@ def run(
     seed: int = 0,
     poll_interval: float = 0.05,
     verbose: int = 1,
+    max_failures: int = 0,
 ) -> ExperimentAnalysis:
     if not rt.is_initialized():
         rt.init()
@@ -467,7 +469,37 @@ def run(
                     if trial._actor is not None:
                         rt.kill(trial._actor, timeout=2.0)
                         trial._actor = None
-                    scheduler.on_complete(trial.trial_id)
+                    retrying = (
+                        trial.status == "ERROR"
+                        and trial.num_failures < max_failures
+                    )
+                    if not retrying:
+                        # a retried trial keeps its scheduler state (ASHA
+                        # rung entries must not double-count on resume)
+                        scheduler.on_complete(trial.trial_id)
+                    else:
+                        # ray.tune's per-trial max_failures: retry from the
+                        # trial's latest checkpoint when one exists (the
+                        # same restore contract PBT exploit uses). Organic
+                        # errors only — a scheduler-STOPped trial is final
+                        # by the scheduler's decision even if it errored.
+                        # Drain first: a checkpoint written just before
+                        # the crash may still sit in the queue.
+                        drain_messages()
+                        trial.num_failures += 1
+                        trial._future = None
+                        trial.error = None
+                        if trial.checkpoints:
+                            trial.config = dict(
+                                trial.config,
+                                __checkpoint_path__=trial.checkpoints[-1]["path"],
+                            )
+                        if verbose:
+                            print(
+                                f"[tune] {trial.trial_id} errored; retry "
+                                f"{trial.num_failures}/{max_failures}"
+                            )
+                        trial.status = "PENDING"
 
             if all(t.status in ("TERMINATED", "STOPPED", "ERROR") for t in trials):
                 # a trial's last reports may have landed in the queue after

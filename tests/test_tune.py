@@ -161,6 +161,73 @@ def test_with_parameters_ships_large_objects_once(tmp_root):
     assert not wrapped._rlt_parameter_refs
 
 
+@pytest.mark.slow
+def test_tune_max_failures_retries_errored_trials(tmp_root):
+    """ray.tune parity: a trial that errors retries up to max_failures,
+    resuming from its latest checkpoint when one exists; without the knob
+    the error is final."""
+
+    def flaky(config):
+        import os
+
+        from ray_lightning_tpu.tune.session import get_trial_session
+
+        sess = get_trial_session()
+        marker = os.path.join(config["root"], "crashed_once")
+        start = 0
+        ckpt = config.get("__checkpoint_path__")
+        if ckpt:
+            with open(ckpt, "rb") as f:
+                start = int(f.read().decode())
+        for it in range(start, 3):
+            sess.checkpoint(str(it + 1).encode(), "progress.txt")
+            sess.report(loss=float(3 - it), iter_seen=float(it))
+            if it == 1 and not os.path.exists(marker):
+                open(marker, "w").close()
+                raise RuntimeError("flaky crash")
+
+    analysis = rlt_tune.run(
+        flaky,
+        config={"root": tmp_root},
+        num_samples=1,
+        metric="loss",
+        mode="min",
+        local_dir=tmp_root,
+        name="exp_flaky",
+        trial_env={"JAX_PLATFORMS": "cpu"},
+        verbose=0,
+        max_failures=1,
+    )
+    (trial,) = analysis.trials
+    assert trial.status == "TERMINATED"
+    assert trial.num_failures == 1
+    assert trial.error is None  # the successful retry cleared the traceback
+    # the retry resumed from the checkpoint (written just before the
+    # crash, so iteration 2) — no iteration re-ran and none were skipped
+    iters = [r["iter_seen"] for r in trial.results]
+    assert iters == [0.0, 1.0, 2.0], iters
+
+    # without the knob the error is final
+    import shutil
+
+    shutil.rmtree(os.path.join(tmp_root, "exp_flaky"), ignore_errors=True)
+    os.remove(os.path.join(tmp_root, "crashed_once"))
+    analysis2 = rlt_tune.run(
+        flaky,
+        config={"root": tmp_root},
+        num_samples=1,
+        metric="loss",
+        mode="min",
+        local_dir=tmp_root,
+        name="exp_flaky2",
+        trial_env={"JAX_PLATFORMS": "cpu"},
+        verbose=0,
+    )
+    (trial2,) = analysis2.trials
+    assert trial2.status == "ERROR"
+    assert "flaky crash" in trial2.error
+
+
 def test_get_tune_resources_bundles():
     """Reference shape (tune.py:49-56): [{CPU:1}] + N x [{CPU:c, TPU:share}],
     strategy PACK."""
