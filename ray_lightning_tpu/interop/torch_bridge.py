@@ -222,7 +222,8 @@ def _encoder_layer_act(mod):
     if isinstance(act, nn.GELU):
         return lambda x: _gelu(x, act.approximate)
     raise UnsupportedTorchOp(
-        f"TransformerEncoderLayer activation {act!r}; relu/gelu are mapped"
+        f"transformer encoder/decoder layer activation {act!r}; relu/gelu "
+        "are mapped"
     )
 
 
@@ -263,18 +264,80 @@ def _transformer_encoder_layer(p, prefix, mod, x, rng, is_causal=False):
     return x
 
 
+def _transformer_decoder_layer(p, prefix, mod, tgt, memory, rng,
+                               tgt_is_causal=False):
+    """nn.TransformerDecoderLayer: causal-capable self-attention, cross
+    attention over ``memory``, feed-forward — both norm_first orders."""
+    act = _encoder_layer_act(mod)
+    r = (lambda i: jax.random.fold_in(rng, i)) if rng is not None else (
+        lambda i: None
+    )
+
+    def self_attn(y):
+        out, _ = _multihead_attention(
+            p, f"{prefix}.self_attn", mod.self_attn, y, y, y,
+            {"is_causal": tgt_is_causal}, r(20)
+        )
+        return _dropout(out, mod.dropout1.p, r(21))
+
+    def cross_attn(y):
+        out, _ = _multihead_attention(
+            p, f"{prefix}.multihead_attn", mod.multihead_attn, y, memory,
+            memory, {}, r(22)
+        )
+        return _dropout(out, mod.dropout2.p, r(23))
+
+    def ff(y):
+        hline = act(y @ p[f"{prefix}.linear1.weight"].T + p[f"{prefix}.linear1.bias"])
+        hline = _dropout(hline, mod.dropout.p, r(24))
+        hline = hline @ p[f"{prefix}.linear2.weight"].T + p[f"{prefix}.linear2.bias"]
+        return _dropout(hline, mod.dropout3.p, r(25))
+
+    def norm(y, which):
+        nm = getattr(mod, which)
+        return _layer_norm(
+            p, f"{prefix}.{which}", y, tuple(nm.normalized_shape), nm.eps,
+            nm.elementwise_affine,
+        )
+
+    if mod.norm_first:
+        tgt = tgt + self_attn(norm(tgt, "norm1"))
+        tgt = tgt + cross_attn(norm(tgt, "norm2"))
+        tgt = tgt + ff(norm(tgt, "norm3"))
+    else:
+        tgt = norm(tgt + self_attn(tgt), "norm1")
+        tgt = norm(tgt + cross_attn(tgt), "norm2")
+        tgt = norm(tgt + ff(tgt), "norm3")
+    return tgt
+
+
+def _stack_final_norm(p, prefix, mod, x):
+    if mod.norm is None:
+        return x
+    return _layer_norm(
+        p, f"{prefix}.norm", x, tuple(mod.norm.normalized_shape),
+        mod.norm.eps, mod.norm.elementwise_affine,
+    )
+
+
+def _transformer_decoder(p, prefix, mod, tgt, memory, rng,
+                         tgt_is_causal=False):
+    for i, layer in enumerate(mod.layers):
+        r = jax.random.fold_in(rng, i) if rng is not None else None
+        tgt = _transformer_decoder_layer(
+            p, f"{prefix}.layers.{i}", layer, tgt, memory, r,
+            tgt_is_causal=tgt_is_causal,
+        )
+    return _stack_final_norm(p, prefix, mod, tgt)
+
+
 def _transformer_encoder(p, prefix, mod, x, rng, is_causal=False):
     for i, layer in enumerate(mod.layers):
         r = jax.random.fold_in(rng, i) if rng is not None else None
         x = _transformer_encoder_layer(
             p, f"{prefix}.layers.{i}", layer, x, r, is_causal=is_causal
         )
-    if mod.norm is not None:
-        x = _layer_norm(
-            p, f"{prefix}.norm", x, tuple(mod.norm.normalized_shape),
-            mod.norm.eps, mod.norm.elementwise_affine,
-        )
-    return x
+    return _stack_final_norm(p, prefix, mod, x)
 
 
 def _batch_norm(p, prefix, x, mod, train, updates):
@@ -519,6 +582,30 @@ def fx_to_jax(
                         rng if train else None,
                         is_causal=bool(ckw.get("is_causal", False)),
                     )
+                elif isinstance(
+                    mod, (nn.TransformerDecoderLayer, nn.TransformerDecoder)
+                ):
+                    fn = (
+                        _transformer_decoder_layer
+                        if isinstance(mod, nn.TransformerDecoderLayer)
+                        else _transformer_decoder
+                    )
+                    cargs = look(node.args)
+                    ckw = look(dict(node.kwargs))
+                    tgt_in = cargs[0] if cargs else ckw.get("tgt")
+                    memory = (
+                        cargs[1] if len(cargs) > 1 else ckw.get("memory")
+                    )
+                    if tgt_in is None or memory is None:
+                        raise UnsupportedTorchOp(
+                            f"{node.target}: decoder call needs (tgt, "
+                            "memory) positionally or by keyword"
+                        )
+                    env[node.name] = fn(
+                        p, str(node.target), mod, tgt_in, memory,
+                        rng if train else None,
+                        tgt_is_causal=bool(ckw.get("tgt_is_causal", False)),
+                    )
                 else:
                     x = look(node.args[0])
                     env[node.name] = _call_module(
@@ -527,7 +614,8 @@ def fx_to_jax(
                 if rng is not None and isinstance(
                     mod,
                     (nn.Dropout, nn.MultiheadAttention,
-                     nn.TransformerEncoderLayer, nn.TransformerEncoder),
+                     nn.TransformerEncoderLayer, nn.TransformerEncoder,
+                     nn.TransformerDecoderLayer, nn.TransformerDecoder),
                 ):
                     rng, _ = jax.random.split(rng)
             elif node.op == "call_function":
@@ -590,59 +678,85 @@ def _check_module(mod, name, node=None):
         nn.Flatten, nn.Identity, nn.Conv2d, nn.MaxPool2d, nn.AvgPool2d,
         nn.Softmax, nn.LogSoftmax, nn.BatchNorm1d, nn.BatchNorm2d,
         nn.MultiheadAttention, nn.TransformerEncoderLayer,
-        nn.TransformerEncoder,
+        nn.TransformerEncoder, nn.TransformerDecoderLayer,
+        nn.TransformerDecoder,
     ) + _loss_module_types()
     if isinstance(mod, _loss_module_types()):
         # criterion options (label_smoothing, weight, reduction) change
         # the math the jax mapping reproduces — refuse at adapt time
         _validate_loss_module_options(mod, type(mod).__name__)
         return
-    if isinstance(
-        mod,
-        (nn.MultiheadAttention, nn.TransformerEncoderLayer,
-         nn.TransformerEncoder),
-    ):
-        attn = mod if isinstance(mod, nn.MultiheadAttention) else None
-        if isinstance(mod, nn.TransformerEncoderLayer):
-            attn = mod.self_attn
-        elif isinstance(mod, nn.TransformerEncoder):
-            attn = mod.layers[0].self_attn
-        if attn.bias_k is not None or attn.add_zero_attn:
-            raise UnsupportedTorchOp(
-                f"layer {name!r}: add_bias_kv/add_zero_attn are not mapped"
-            )
+    attention_kinds = (
+        nn.MultiheadAttention, nn.TransformerEncoderLayer,
+        nn.TransformerEncoder, nn.TransformerDecoderLayer,
+        nn.TransformerDecoder,
+    )
+    if isinstance(mod, attention_kinds):
+        if isinstance(mod, nn.MultiheadAttention):
+            attns = [mod]
+        elif isinstance(mod, nn.TransformerEncoderLayer):
+            attns = [mod.self_attn]
+        elif isinstance(mod, nn.TransformerDecoderLayer):
+            attns = [mod.self_attn, mod.multihead_attn]
+        elif isinstance(mod, nn.TransformerDecoder):
+            attns = [mod.layers[0].self_attn, mod.layers[0].multihead_attn]
+        else:
+            attns = [mod.layers[0].self_attn]
+        for attn in attns:
+            if attn.bias_k is not None or attn.add_zero_attn:
+                raise UnsupportedTorchOp(
+                    f"layer {name!r}: add_bias_kv/add_zero_attn are not "
+                    "mapped"
+                )
         if node is not None:
             # dynamic mask tensors change the math; refuse at ADAPT time
-            # (the static is_causal=True literal is supported). Masks can
-            # also arrive POSITIONALLY (MHA arg 4+, encoder arg 2+).
-            max_pos = 3 if isinstance(mod, nn.MultiheadAttention) else 1
+            # (the static is_causal/tgt_is_causal literal is supported).
+            # Masks can also arrive POSITIONALLY (MHA arg 4+, encoder arg
+            # 2+, decoder arg 3+).
+            max_pos = (
+                3 if isinstance(mod, nn.MultiheadAttention)
+                else 2 if isinstance(
+                    mod, (nn.TransformerDecoderLayer, nn.TransformerDecoder)
+                )
+                else 1
+            )
             if any(a is not None for a in node.args[max_pos:]):
                 raise UnsupportedTorchOp(
                     f"layer {name!r}: positional mask arguments are not "
-                    "mapped; only is_causal=True is supported"
+                    "mapped; only is_causal=True / tgt_is_causal=True are "
+                    "supported"
                 )
             for k in ("attn_mask", "key_padding_mask", "mask",
-                      "src_key_padding_mask", "src_mask"):
+                      "src_key_padding_mask", "src_mask", "tgt_mask",
+                      "memory_mask", "tgt_key_padding_mask",
+                      "memory_key_padding_mask"):
                 if node.kwargs.get(k) is not None:
                     raise UnsupportedTorchOp(
                         f"layer {name!r}: mask argument {k!r} is not "
-                        "mapped; only is_causal=True is supported"
+                        "mapped; only is_causal=True / tgt_is_causal=True "
+                        "are supported"
                     )
             if node.kwargs.get("average_attn_weights") is False:
                 raise UnsupportedTorchOp(
                     f"layer {name!r}: average_attn_weights=False (per-head "
                     "weights) is not mapped"
                 )
-        if isinstance(mod, nn.TransformerEncoder) and mod.norm is not None:
-            if not isinstance(mod.norm, nn.LayerNorm):
+            if node.kwargs.get("memory_is_causal"):
                 raise UnsupportedTorchOp(
-                    f"layer {name!r}: encoder norm "
-                    f"{type(mod.norm).__name__} is not mapped (LayerNorm "
-                    "only)"
+                    f"layer {name!r}: memory_is_causal=True is not mapped"
                 )
-        if isinstance(mod, nn.TransformerEncoderLayer):
+        if isinstance(
+            mod, (nn.TransformerEncoder, nn.TransformerDecoder)
+        ) and mod.norm is not None and not isinstance(mod.norm, nn.LayerNorm):
+            raise UnsupportedTorchOp(
+                f"layer {name!r}: stack norm {type(mod.norm).__name__} is "
+                "not mapped (LayerNorm only)"
+            )
+        if isinstance(
+            mod, (nn.TransformerEncoderLayer, nn.TransformerDecoderLayer)
+        ):
             _encoder_layer_act(mod)  # refuse exotic activations now
-        if isinstance(mod, nn.TransformerEncoder):
+        if isinstance(mod, (nn.TransformerEncoder, nn.TransformerDecoder)):
             for sub in mod.layers:
                 _encoder_layer_act(sub)
         return

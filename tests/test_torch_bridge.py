@@ -703,6 +703,89 @@ def test_transformer_encoder_parity_and_refusals():
         adapt_torch_module(MaskedMHA())
 
 
+def test_transformer_decoder_parity():
+    """nn.TransformerDecoder: causal self-attention + cross attention
+    over encoder memory — seq2seq torch models bridge with logit parity."""
+
+    class Seq2Seq(nn.Module):
+        def __init__(self):
+            super().__init__()
+            enc = nn.TransformerEncoderLayer(
+                d_model=32, nhead=4, dim_feedforward=64, dropout=0.0,
+                batch_first=True,
+            )
+            dec = nn.TransformerDecoderLayer(
+                d_model=32, nhead=4, dim_feedforward=64, dropout=0.0,
+                batch_first=True,
+            )
+            self.encoder = nn.TransformerEncoder(enc, num_layers=1)
+            self.decoder = nn.TransformerDecoder(dec, num_layers=2)
+            self.head = nn.Linear(32, 11)
+            self.criterion = nn.CrossEntropyLoss()
+
+        def forward(self, src, tgt):
+            memory = self.encoder(src)
+            y = self.decoder(tgt, memory, tgt_is_causal=True)
+            return self.head(y)
+
+        def configure_optimizers(self):
+            return torch.optim.Adam(self.parameters(), lr=1e-3)
+
+    tm = Seq2Seq().eval()
+    adapted = adapt_torch_module(tm)
+    rng = np.random.default_rng(2)
+    src = rng.normal(size=(2, 7, 32)).astype(np.float32)
+    tgt = rng.normal(size=(2, 5, 32)).astype(np.float32)
+    with torch.no_grad():
+        m = torch.nn.Transformer.generate_square_subsequent_mask(5)
+        mem = tm.encoder(torch.from_numpy(src))
+        y = tm.decoder(torch.from_numpy(tgt), mem, tgt_mask=m)
+        ref = tm.head(y).numpy()
+    out = np.asarray(
+        adapted.forward(
+            adapted.init_params(None), jnp.asarray(src), jnp.asarray(tgt)
+        )
+    )
+    assert np.max(np.abs(ref - out)) < 1e-4
+
+    # decoder mask tensors refuse at adapt time
+    class MaskedDecoder(Seq2Seq):
+        def forward(self, src, tgt):
+            memory = self.encoder(src)
+            mask = torch.zeros(5, 5)
+            return self.head(self.decoder(tgt, memory, tgt_mask=mask))
+
+    with pytest.raises(UnsupportedTorchOp, match="tgt_mask"):
+        adapt_torch_module(MaskedDecoder())
+
+    # train mode threads dropout rng through both attentions: active
+    # dropout makes the output differ from eval, deterministically per key
+    class Seq2SeqDrop(Seq2Seq):
+        def __init__(self):
+            super().__init__()
+            dec = nn.TransformerDecoderLayer(
+                d_model=32, nhead=4, dim_feedforward=64, dropout=0.2,
+                batch_first=True,
+            )
+            self.decoder = nn.TransformerDecoder(dec, num_layers=1)
+
+    adapted2 = adapt_torch_module(Seq2SeqDrop())
+    params2 = adapted2.init_params(None)
+    key = jax.random.key(0)
+    train_out = adapted2.forward(
+        params2, jnp.asarray(src), jnp.asarray(tgt), dropout_rng=key,
+        train=True,
+    )
+    eval_out = adapted2.forward(params2, jnp.asarray(src), jnp.asarray(tgt))
+    assert np.isfinite(np.asarray(train_out)).all()
+    assert float(jnp.max(jnp.abs(train_out - eval_out))) > 0.0
+    again = adapted2.forward(
+        params2, jnp.asarray(src), jnp.asarray(tgt), dropout_rng=key,
+        train=True,
+    )
+    assert np.allclose(np.asarray(train_out), np.asarray(again))
+
+
 def test_transformer_encoder_trains_through_trainer(tmp_root):
     """A torch transformer-encoder classifier fine-tunes end to end on a
     GSPMD mesh through the bridge (dropout active in train)."""
