@@ -786,6 +786,46 @@ def test_transformer_decoder_parity():
     assert np.allclose(np.asarray(train_out), np.asarray(again))
 
 
+def test_transformer_encoder_export_round_trip(tmp_root):
+    """Trained encoder weights write back into the torch module
+    losslessly (packed in_proj/out_proj state_dict keys included) and
+    torch agrees on the logits afterwards."""
+
+    class Enc(nn.Module):
+        def __init__(self):
+            super().__init__()
+            layer = nn.TransformerEncoderLayer(
+                d_model=32, nhead=4, dim_feedforward=64, dropout=0.0,
+                batch_first=True,
+            )
+            self.encoder = nn.TransformerEncoder(layer, num_layers=1)
+            self.head = nn.Linear(32, 10)
+            self.criterion = nn.CrossEntropyLoss()
+
+        def forward(self, x):
+            return self.head(self.encoder(x).mean(dim=1))
+
+        def configure_optimizers(self):
+            return torch.optim.Adam(self.parameters(), lr=1e-2)
+
+    adapted = adapt_torch_module(Enc())
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(32, 6, 32)).astype(np.float32)
+    ys = rng.integers(0, 10, size=(32,)).astype(np.int32)
+    trainer = get_trainer(tmp_root, max_epochs=1, checkpoint_callback=False)
+    trainer.fit(adapted, train_dataloaders=[(xs, ys)])
+
+    trained = adapted.export_to_torch().eval()
+    probe = rng.normal(size=(4, 6, 32)).astype(np.float32)
+    with torch.no_grad():
+        ref = trained(torch.from_numpy(probe)).numpy()
+    out = np.asarray(adapted.forward(adapted.params, jnp.asarray(probe)))
+    # 1e-4 like every attention+layernorm comparison in this file (softmax
+    # accumulation order differs between frameworks); a silently-dropped
+    # in_proj on export would miss by far more after the Adam step
+    assert np.max(np.abs(ref - out)) < 1e-4
+
+
 def test_transformer_encoder_trains_through_trainer(tmp_root):
     """A torch transformer-encoder classifier fine-tunes end to end on a
     GSPMD mesh through the bridge (dropout active in train)."""
