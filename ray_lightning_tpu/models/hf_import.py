@@ -66,6 +66,54 @@ def _np(t) -> np.ndarray:
     return t.detach().to("cpu").to_dense().float().numpy()
 
 
+def _make_take(sd, dt):
+    def take(name, transpose=False, target_dtype=None):
+        # per-tensor to the TARGET dtype immediately: only one fp32 copy
+        # is ever transient, so an 8B-scale import peaks near
+        # torch-model + imported-pytree instead of 2x more
+        arr = _np(sd[name])
+        return jnp.asarray(arr.T if transpose else arr, target_dtype or dt)
+
+    return take
+
+
+def _check_uniform_heads(cfg: LlamaConfig) -> None:
+    if cfg.n_heads * cfg.head_dim != cfg.dim:
+        raise ValueError(
+            f"hidden_size {cfg.dim} != num_attention_heads {cfg.n_heads} x "
+            f"head_dim {cfg.head_dim}: non-uniform head dims are not "
+            "supported"
+        )
+
+
+def _attn_layer_leaves(take, p, layers) -> None:
+    """The attention + norm leaves shared by every family member.
+    torch Linear stores [out, in]; the native layout is [in, out]."""
+    layers["attn_norm"].append(take(p + "input_layernorm.weight"))
+    layers["wq"].append(take(p + "self_attn.q_proj.weight", True))
+    layers["wk"].append(take(p + "self_attn.k_proj.weight", True))
+    layers["wv"].append(take(p + "self_attn.v_proj.weight", True))
+    layers["wo"].append(take(p + "self_attn.o_proj.weight", True))
+    layers["mlp_norm"].append(take(p + "post_attention_layernorm.weight"))
+
+
+def _assemble(take, hf_config, layer_tree) -> Dict[str, Any]:
+    embed = take("model.embed_tokens.weight")  # [V, D]
+    if getattr(hf_config, "tie_word_embeddings", False):
+        # tied checkpoints alias lm_head to the embedding; materialize the
+        # native layout explicitly (torch state_dicts often still carry
+        # the aliased lm_head.weight key — the config flag is the truth)
+        lm_head = embed.T
+    else:
+        lm_head = take("lm_head.weight", True)  # [D, V]
+    return {
+        "embed": embed,
+        "layers": layer_tree,
+        "final_norm": take("model.norm.weight"),
+        "lm_head": lm_head,
+    }
+
+
 def import_hf_llama(
     model_or_path, dtype=jnp.bfloat16, **config_overrides
 ) -> Tuple[Dict[str, Any], LlamaConfig]:
@@ -83,53 +131,97 @@ def import_hf_llama(
         model_or_path = LlamaForCausalLM.from_pretrained(model_or_path)
     model = model_or_path
     cfg = config_from_hf(model.config, dtype=dtype, **config_overrides)
-    hd = cfg.head_dim
-    if cfg.n_heads * hd != cfg.dim:
-        raise ValueError(
-            f"hidden_size {cfg.dim} != num_attention_heads {cfg.n_heads} x "
-            f"head_dim {hd}: non-uniform head dims are not supported"
-        )
+    _check_uniform_heads(cfg)
 
-    sd = {k: v for k, v in model.state_dict().items()}
-    dt = cfg.dtype
-
-    def take(name, transpose=False):
-        # per-tensor to the TARGET dtype immediately: only one fp32 copy
-        # is ever transient, so an 8B-scale import peaks near
-        # torch-model + imported-pytree instead of 2x more
-        arr = _np(sd[name])
-        return jnp.asarray(arr.T if transpose else arr, dt)
-
+    take = _make_take(dict(model.state_dict()), cfg.dtype)
     layers: Dict[str, Any] = {
         "attn_norm": [], "wq": [], "wk": [], "wv": [], "wo": [],
         "mlp_norm": [], "w_gate": [], "w_up": [], "w_down": [],
     }
     for i in range(cfg.n_layers):
         p = f"model.layers.{i}."
-        layers["attn_norm"].append(take(p + "input_layernorm.weight"))
-        # torch Linear stores [out, in]; the native layout is [in, out]
-        layers["wq"].append(take(p + "self_attn.q_proj.weight", True))
-        layers["wk"].append(take(p + "self_attn.k_proj.weight", True))
-        layers["wv"].append(take(p + "self_attn.v_proj.weight", True))
-        layers["wo"].append(take(p + "self_attn.o_proj.weight", True))
-        layers["mlp_norm"].append(take(p + "post_attention_layernorm.weight"))
+        _attn_layer_leaves(take, p, layers)
         layers["w_gate"].append(take(p + "mlp.gate_proj.weight", True))
         layers["w_up"].append(take(p + "mlp.up_proj.weight", True))
         layers["w_down"].append(take(p + "mlp.down_proj.weight", True))
 
-    embed = take("model.embed_tokens.weight")  # [V, D]
-    if getattr(model.config, "tie_word_embeddings", False):
-        # tied checkpoints alias lm_head to the embedding; materialize the
-        # native layout explicitly (torch state_dicts often still carry
-        # the aliased lm_head.weight key — the config flag is the truth)
-        lm_head = embed.T
-    else:
-        lm_head = take("lm_head.weight", True)  # [D, V]
+    layer_tree = {k: jnp.stack(v) for k, v in layers.items()}
+    return _assemble(take, model.config, layer_tree), cfg
 
-    params = {
-        "embed": embed,
-        "layers": {k: jnp.stack(v) for k, v in layers.items()},
-        "final_norm": take("model.norm.weight"),
-        "lm_head": lm_head,
+
+def import_hf_mixtral(
+    model_or_path, dtype=jnp.bfloat16, **config_overrides
+) -> Tuple[Dict[str, Any], LlamaConfig]:
+    """Build ``(params, cfg)`` from a ``transformers`` Mixtral model — the
+    MoE member of the family. The expert layout maps onto the native MoE
+    leaves (gate/up/down stacks over an expert dim, router in fp32), and
+    the routing math is algebraically identical: Mixtral's
+    softmax-over-top-k-logits equals our softmax-over-all followed by
+    top-k renormalization (e^l_i / sum_topk e^l_j either way).
+
+    Semantics notes:
+    - Mixtral routes without expert capacity (token choice). The imported
+      config sets ``capacity_factor`` to cover the worst case so training
+      matches; generation already routes losslessly.
+    - Sequences must stay within ``sliding_window`` when the checkpoint
+      sets one (windowed attention is not mapped); cap ``max_seq`` via
+      the overrides for long-window checkpoints.
+    """
+    if isinstance(model_or_path, str):
+        from transformers import MixtralForCausalLM
+
+        model_or_path = MixtralForCausalLM.from_pretrained(model_or_path)
+    model = model_or_path
+    hf_cfg = model.config
+    overrides = dict(
+        n_experts=hf_cfg.num_local_experts,
+        expert_top_k=hf_cfg.num_experts_per_tok,
+        # no-capacity (token-choice) routing: capacity = cf * top_k * T/E,
+        # worst-case per-expert load is T, so cf = E/top_k never binds
+        # without over-allocating the [T, E, C] dispatch tensors
+        capacity_factor=(
+            float(hf_cfg.num_local_experts) / hf_cfg.num_experts_per_tok
+        ),
+        moe_aux_weight=float(
+            getattr(hf_cfg, "router_aux_loss_coef", 0.001)
+        ),
+    )
+    overrides.update(config_overrides)
+    cfg = config_from_hf(hf_cfg, dtype=dtype, **overrides)
+    _check_uniform_heads(cfg)
+    window = getattr(hf_cfg, "sliding_window", None)
+    if window is not None and cfg.max_seq > window:
+        raise NotImplementedError(
+            f"sliding_window={window} < max_seq={cfg.max_seq}: windowed "
+            "attention is not mapped; pass max_seq<=window in the overrides"
+        )
+
+    take = _make_take(dict(model.state_dict()), cfg.dtype)
+    layers: Dict[str, Any] = {
+        "attn_norm": [], "wq": [], "wk": [], "wv": [], "wo": [],
+        "mlp_norm": [],
     }
-    return params, cfg
+    moe: Dict[str, Any] = {
+        "router": [], "w_gate": [], "w_up": [], "w_down": [],
+    }
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        _attn_layer_leaves(take, p, layers)
+        # the native router runs in fp32 (routing decisions are precision
+        # sensitive); experts: w1 = gate, w3 = up, w2 = down, torch
+        # [out, in] transposed to [in, out]
+        moe["router"].append(
+            take(p + "block_sparse_moe.gate.weight", True,
+                 target_dtype=jnp.float32)
+        )
+        for leaf, key in (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2")):
+            moe[leaf].append(
+                jnp.stack([
+                    take(p + f"block_sparse_moe.experts.{e}.{key}.weight", True)
+                    for e in range(cfg.n_experts)
+                ])
+            )
+
+    layer_tree = {k: jnp.stack(v) for k, v in layers.items()}
+    layer_tree["moe"] = {k: jnp.stack(v) for k, v in moe.items()}
+    return _assemble(take, hf_cfg, layer_tree), cfg

@@ -238,6 +238,65 @@ def test_hf_llama_import_logit_parity(tmp_root):
     assert trainer.state.status == "finished"
 
 
+def test_hf_mixtral_import_logit_parity(tmp_root):
+    """A transformers Mixtral (MoE) checkpoint imports with logit parity
+    — its softmax-over-top-k routing is algebraically our
+    softmax-then-renormalize — and fine-tunes on an ep mesh."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from ray_lightning_tpu.models.hf_import import import_hf_mixtral
+    from ray_lightning_tpu.models.llama import forward as rlt_forward
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=32, rms_norm_eps=1e-6, rope_theta=10000.0,
+        attention_dropout=0.0, sliding_window=None,
+    )
+    torch.manual_seed(0)
+    hf = transformers.MixtralForCausalLM(hf_cfg).eval()
+    params, cfg = import_hf_mixtral(hf, dtype=jnp.float32)
+    assert cfg.n_experts == 4 and cfg.expert_top_k == 2
+    assert cfg.moe_aux_weight == float(hf_cfg.router_aux_loss_coef)
+    assert cfg.capacity_factor == 2.0  # E/top_k: never binds, minimal
+    tokens = np.random.default_rng(0).integers(0, 64, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.numpy()
+    ours, _ = rlt_forward(params, jnp.asarray(tokens, jnp.int32), cfg)
+    assert np.max(np.abs(ref - np.asarray(ours, np.float32))) < 1e-4
+
+    # windowed attention refuses rather than silently diverging
+    hf_cfg_win = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=2, num_experts_per_tok=1,
+        max_position_embeddings=64, sliding_window=16,
+    )
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        import_hf_mixtral(transformers.MixtralForCausalLM(hf_cfg_win))
+    # capping max_seq within the window is the documented escape hatch
+    _, cfg_w = import_hf_mixtral(
+        transformers.MixtralForCausalLM(hf_cfg_win), max_seq=16
+    )
+    assert cfg_w.max_seq == 16
+
+    # imported MoE weights fine-tune with expert parallelism
+    module = LlamaModule(cfg, lr=1e-3)
+    module.params = params
+    strategy = rlt.XLAStrategy(
+        mesh_spec=MeshSpec(axes={"dp": 2, "ep": 4}),
+        sharding_policy=ShardingPolicy(data_axes=("dp",)),
+    )
+    dm = SyntheticLMDataModule(cfg, batch_size=8, n_train=16)
+    trainer = get_trainer(tmp_root, max_epochs=1, strategy=strategy,
+                          limit_train_batches=2, checkpoint_callback=False)
+    trainer.fit(module, datamodule=dm)
+    assert trainer.state.status == "finished"
+    assert "val_moe_aux" in trainer.callback_metrics
+
+
 def test_token_file_dataset_trains_llama(tmp_root):
     """LM pretraining from a memory-mapped token FILE (corpora beyond
     RAM): windows come out int32 [seq_len], survive the pickle hop to a
