@@ -90,7 +90,8 @@ def prefill(
     hd = cfg.head_dim
     max_len = cache["k"].shape[3]
     if rope_table is None:
-        rope_table = rope_angles(max_len, hd, cfg.rope_theta)
+        rope_table = rope_angles(max_len, hd, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
     cos, sin = rope_table[0][:P], rope_table[1][:P]
     x = params["embed"][prompt]  # [B, P, D]
 
@@ -150,7 +151,8 @@ def decode_step(
     hd = cfg.head_dim
     max_len = cache["k"].shape[3]
     if rope_table is None:
-        rope_table = rope_angles(max_len, hd, cfg.rope_theta)
+        rope_table = rope_angles(max_len, hd, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
     c, s = _rope_at(rope_table, pos)
     x = params["embed"][token]  # [B, D]
 
@@ -280,7 +282,8 @@ def generate(
     B, P = prompt.shape
     total = P + max_new_tokens
     cache = init_kv_cache(cfg, B, total)
-    table = rope_angles(total, cfg.head_dim, cfg.rope_theta)
+    table = rope_angles(total, cfg.head_dim, cfg.rope_theta,
+                        scaling=cfg.rope_scaling)
 
     def sample(logits, key):
         return _sample_logits(logits, key, temperature, top_k, top_p)

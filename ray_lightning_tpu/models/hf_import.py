@@ -27,16 +27,11 @@ from ray_lightning_tpu.models.llama import LlamaConfig
 
 def config_from_hf(hf_config, dtype=jnp.bfloat16, **overrides) -> LlamaConfig:
     """Map a ``transformers.LlamaConfig`` onto :class:`LlamaConfig`."""
-    scaling = getattr(hf_config, "rope_scaling", None)
-    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
-        # Llama-3.1+ checkpoints rescale inv_freq ('llama3' rope_type);
-        # importing with plain rope_theta would silently produce different
-        # angles at every position
-        raise NotImplementedError(
-            f"rope_scaling={scaling!r} is not mapped; the native rope is "
-            "unscaled. Import a checkpoint without rope scaling, or extend "
-            "rope_angles first."
-        )
+    from ray_lightning_tpu.ops.rope import normalize_rope_scaling
+
+    # refuses unsupported kinds (importing with plain rope_theta would
+    # silently change every position's angles)
+    scaling = normalize_rope_scaling(getattr(hf_config, "rope_scaling", None))
     if getattr(hf_config, "attention_bias", False) or getattr(
         hf_config, "mlp_bias", False
     ):
@@ -55,6 +50,7 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16, **overrides) -> LlamaConfig:
         ffn_dim=hf_config.intermediate_size,
         max_seq=hf_config.max_position_embeddings,
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        rope_scaling=scaling,
         norm_eps=float(hf_config.rms_norm_eps),
         dtype=dtype,
     )

@@ -43,6 +43,11 @@ class LlamaConfig:
     ffn_dim: int = 5632
     max_seq: int = 2048
     rope_theta: float = 500000.0
+    # HF-style rope_scaling ('llama3' for Llama-3.1+, 'linear'); None =
+    # plain rope. Accepts a dict; stored as a sorted (key, value) tuple so
+    # the frozen config stays HASHABLE. Validated in
+    # ops/rope.py::normalize_rope_scaling.
+    rope_scaling: Optional[Any] = None
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
@@ -92,6 +97,15 @@ class LlamaConfig:
             raise ValueError(
                 f"remat_policy={self.remat_policy!r}: expected 'nothing' "
                 "or 'dots'"
+            )
+        if self.rope_scaling is not None and not isinstance(
+            self.rope_scaling, tuple
+        ):
+            # dict/list input -> hashable canonical form (frozen dataclass
+            # hashing must keep working; from_dict round-trips lists)
+            object.__setattr__(
+                self, "rope_scaling",
+                tuple(sorted(dict(self.rope_scaling).items())),
             )
 
     @property
@@ -410,7 +424,8 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
         # closing over traced values under shard_map); with sp the stage
         # sees a local sequence shard, so slice the GLOBAL-position tables
         # to this shard's offset
-        cos, sin = rope_angles(seq_len, hd, cfg.rope_theta)
+        cos, sin = rope_angles(seq_len, hd, cfg.rope_theta,
+                               scaling=cfg.rope_scaling)
         if sp > 1:
             sl = seq_len // sp
             start = jax.lax.axis_index("sp") * sl
@@ -696,7 +711,7 @@ def forward(
     hd = cfg.head_dim
     x = params["embed"][tokens]  # gather -> [B, S, D]
     x = _act_constraint(x, mesh, ("dp", "fsdp"), "sp", None)
-    cos, sin = rope_angles(S, hd, cfg.rope_theta)
+    cos, sin = rope_angles(S, hd, cfg.rope_theta, scaling=cfg.rope_scaling)
 
     use_ring = (
         mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1

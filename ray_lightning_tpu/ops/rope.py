@@ -3,14 +3,67 @@ family). Precomputed angle tables; applied in fp32 then cast back, which XLA
 fuses into the surrounding matmuls."""
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
 
 
-def rope_angles(seq_len: int, head_dim: int, theta: float = 500000.0, offset: int = 0):
-    """Return (cos, sin) tables of shape [seq_len, head_dim//2]."""
+def _llama3_scale(inv_freq: jnp.ndarray, scaling: dict) -> jnp.ndarray:
+    """Llama-3.1's frequency rescaling ('rope_type': 'llama3'): low
+    frequencies divide by ``factor``, high frequencies stay, the band in
+    between interpolates smoothly — matching transformers'
+    ``_compute_llama3_parameters`` so imported checkpoints agree."""
+    factor = float(scaling["factor"])
+    low = float(scaling.get("low_freq_factor", 1.0))
+    high = float(scaling.get("high_freq_factor", 4.0))
+    orig = float(
+        scaling.get("original_max_position_embeddings", 8192)
+    )
+    low_wavelen = orig / low
+    high_wavelen = orig / high
+    wavelen = 2.0 * jnp.pi / inv_freq
+    scaled = jnp.where(wavelen > low_wavelen, inv_freq / factor, inv_freq)
+    smooth = (orig / wavelen - low) / (high - low)
+    smoothed = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    mid = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+    return jnp.where(mid, smoothed, scaled)
+
+
+def normalize_rope_scaling(scaling) -> Optional[dict]:
+    """The ONE validation point for HF-style ``rope_scaling``: accepts a
+    dict or a (key, value)-pair tuple (LlamaConfig's hashable storage),
+    returns a plain dict or None for default/absent, refuses unsupported
+    kinds. hf_import delegates here so a newly supported kind is
+    immediately importable."""
+    if not scaling:
+        return None
+    d = dict(scaling)
+    kind = d.get("rope_type", d.get("type", "default"))
+    if kind == "default":
+        return None
+    if kind not in ("llama3", "linear"):
+        raise NotImplementedError(
+            f"rope_scaling type {kind!r}; 'llama3'/'linear' are mapped"
+        )
+    return d
+
+
+def rope_angles(seq_len: int, head_dim: int, theta: float = 500000.0,
+                offset: int = 0, scaling=None):
+    """Return (cos, sin) tables of shape [seq_len, head_dim//2].
+
+    ``scaling``: an optional HF-style ``rope_scaling`` dict (or pair
+    tuple); 'llama3' (Llama-3.1+) and 'linear' types are supported."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    scaling = normalize_rope_scaling(scaling)
+    if scaling:
+        kind = scaling.get("rope_type", scaling.get("type"))
+        if kind == "llama3":
+            inv_freq = _llama3_scale(inv_freq, scaling)
+        else:  # "linear" (normalize_rope_scaling admits no other kind)
+            inv_freq = inv_freq / float(scaling["factor"])
     positions = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
     angles = positions[:, None] * inv_freq[None, :]
     return jnp.cos(angles), jnp.sin(angles)

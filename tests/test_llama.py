@@ -224,6 +224,34 @@ def test_hf_llama_import_logit_parity(tmp_root):
     ours_t, _ = rlt_forward(params_t, jnp.asarray(tokens, jnp.int32), cfg_t)
     assert np.max(np.abs(ref_t - np.asarray(ours_t, np.float32))) < 1e-4
 
+    # Llama-3.1-style rope scaling ('llama3' rope_type) maps too — the
+    # rescaled inv_freq matches transformers' _compute_llama3_parameters
+    hf_cfg_31 = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-6, rope_theta=500000.0,
+        tie_word_embeddings=False, attention_dropout=0.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 64},
+    )
+    torch.manual_seed(2)
+    hf_31 = transformers.LlamaForCausalLM(hf_cfg_31).eval()
+    params_31, cfg_31 = import_hf_llama(hf_31, dtype=jnp.float32)
+    tok48 = np.random.default_rng(4).integers(0, 128, (2, 48))
+    with torch.no_grad():
+        ref_31 = hf_31(torch.from_numpy(tok48)).logits.numpy()
+    ours_31, _ = rlt_forward(params_31, jnp.asarray(tok48, jnp.int32), cfg_31)
+    assert np.max(np.abs(ref_31 - np.asarray(ours_31, np.float32))) < 1e-4
+    # unknown scaling types still refuse rather than silently diverging
+    hf_cfg_yarn = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0},
+    )
+    with pytest.raises(NotImplementedError, match="yarn"):
+        import_hf_llama(transformers.LlamaForCausalLM(hf_cfg_yarn))
+
     # the imported weights fine-tune through the real Trainer on a mesh
     module = LlamaModule(cfg, lr=1e-3)
     module.params = params  # warm start from the import
