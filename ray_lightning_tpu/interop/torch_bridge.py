@@ -406,12 +406,14 @@ def _trace_step_method(module, method: str = "training_step"):
             if not (n.op == "call_function" and n.target in skip)
         ]
 
-    cls = type(module)
+    # patch on the INSTANCE (instance attrs shadow class methods for the
+    # tracer's `self.log(...)` lookups) — patching the class would no-op
+    # `log` on every other live instance of the class for the duration
     sentinel = object()
     saved = {}
     for name in ("log", "log_dict"):
-        saved[name] = cls.__dict__.get(name, sentinel)
-        setattr(cls, name, lambda self, *a, **k: None)
+        saved[name] = module.__dict__.get(name, sentinel)
+        object.__setattr__(module, name, lambda *a, **k: None)
     try:
         tracer = _StepTracer()
         graph = tracer.trace(
@@ -435,9 +437,9 @@ def _trace_step_method(module, method: str = "training_step"):
     finally:
         for name, orig in saved.items():
             if orig is sentinel:
-                delattr(cls, name)
+                object.__delattr__(module, name)
             else:
-                setattr(cls, name, orig)
+                object.__setattr__(module, name, orig)
 
 
 def fx_to_jax(
@@ -1495,8 +1497,13 @@ def _user_defined_method(torch_module, name: str) -> bool:
     for klass in type(torch_module).__mro__:
         if name in klass.__dict__:
             mod = getattr(klass, "__module__", "") or ""
-            return not mod.startswith(
-                ("pytorch_lightning", "lightning", "torch.")
+            # match the framework PACKAGES exactly (name or "name." prefix)
+            # — a bare "lightning" prefix would also swallow user packages
+            # like "lightning_models" and silently drop their custom step
+            framework = ("pytorch_lightning", "lightning", "torch")
+            return not (
+                mod in framework
+                or mod.startswith(tuple(p + "." for p in framework))
             )
     return False
 

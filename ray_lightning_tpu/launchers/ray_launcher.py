@@ -189,6 +189,31 @@ def _collect_rank_zero_results(trainer, results) -> WorkerOutput:
     )
 
 
+def _orbax_step_committed(step_dir: str) -> bool:
+    """True when ``step_dir`` is a finalized orbax step. Delegates to
+    ``ocp.utils.is_checkpoint_finalized`` (knows both atomicity schemes:
+    tmp-suffix rename and commit_success.txt markers); if that API is
+    unavailable, fall back to treating the dir as committed — the old
+    behavior — rather than refusing every resume."""
+    try:
+        import orbax.checkpoint as ocp
+
+        check = ocp.utils.is_checkpoint_finalized
+    except (ImportError, AttributeError):  # pragma: no cover - API drift
+        return True
+    try:
+        return bool(check(step_dir))
+    except Exception as exc:
+        # an error FROM the check (transient I/O, permissions) must not
+        # promote a torso to "committed" — skip this step, older
+        # candidates or from-scratch relaunch remain available
+        rank_zero_info(
+            "could not verify orbax step %s is committed (%s); skipping it "
+            "as a relaunch-resume candidate", step_dir, exc
+        )
+        return False
+
+
 class RayLauncher:
     is_interactive_compatible = True  # actors boot via subprocess, not fork
 
@@ -307,15 +332,25 @@ class RayLauncher:
             for name in os.listdir(d):
                 if not name.isdigit():
                     continue
+                path = os.path.join(d, name)
                 try:
-                    mtime = os.path.getmtime(os.path.join(d, name))
+                    mtime = os.path.getmtime(path)
                 except OSError:
                     continue
-                if mtime >= not_before:
-                    fresh.append((mtime, int(name)))
-            if fresh:
-                mtime, step = max(fresh)
-                candidates.append((mtime, f"orbax@{step}:{d}"))
+                if mtime < not_before:
+                    continue
+                fresh.append((mtime, int(name), path))
+            # a digit-named dir is not necessarily a COMMITTED step: on
+            # filesystems without atomic rename (object stores) orbax
+            # writes into the final name and appends a commit marker last,
+            # so a crash mid-async-save leaves a torso that would pin the
+            # relaunch to an unrestorable step. Probe newest-first and
+            # stop at the first committed step — the check can cost a
+            # remote round-trip per dir on object stores
+            for mtime, step, path in sorted(fresh, reverse=True):
+                if _orbax_step_committed(path):
+                    candidates.append((mtime, f"orbax@{step}:{d}"))
+                    break
         if candidates:
             return max(candidates)[1]
         if weights_only:

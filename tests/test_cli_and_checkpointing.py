@@ -207,6 +207,46 @@ def test_resume_from_mid_epoch_checkpoint_reruns_partial_epoch(tmp_root):
     assert epochs_log == [0, 1], epochs_log
 
 
+@pytest.mark.skipif(not ORBAX_AVAILABLE, reason="orbax not installed")
+def test_relaunch_skips_uncommitted_orbax_step(tmp_root, monkeypatch):
+    """A crash mid-async-save can leave a digit-named step dir without a
+    commit marker (object-store scheme: no atomic rename). The relaunch
+    finder must fall back to the previous COMMITTED step instead of
+    pinning the torso and failing the restore."""
+    from ray_lightning_tpu.launchers import ray_launcher
+
+    d = os.path.join(tmp_root, "orbax")
+    for step in ("2", "5"):
+        os.makedirs(os.path.join(d, step))
+
+    # local fs uses the rename scheme: plain dirs are committed
+    assert ray_launcher._orbax_step_committed(os.path.join(d, "2"))
+
+    cb = OrbaxModelCheckpoint(dirpath=d)
+
+    class FakeTrainer:
+        checkpoint_callbacks = ()
+        callbacks = (cb,)
+
+    # simulate the commit-marker scheme: step 5 is an uncommitted torso
+    monkeypatch.setattr(
+        ray_launcher, "_orbax_step_committed",
+        lambda path: not path.endswith(os.sep + "5"),
+    )
+    spec = ray_launcher.RayLauncher._find_relaunch_checkpoint(
+        FakeTrainer(), not_before=0.0
+    )
+    assert spec == f"orbax@2:{d}", spec
+
+    # nothing committed at all -> no resume, start from scratch
+    monkeypatch.setattr(
+        ray_launcher, "_orbax_step_committed", lambda path: False
+    )
+    assert ray_launcher.RayLauncher._find_relaunch_checkpoint(
+        FakeTrainer(), not_before=0.0
+    ) is None
+
+
 @pytest.mark.slow
 @pytest.mark.skipif(not ORBAX_AVAILABLE, reason="orbax not installed")
 def test_relaunch_resumes_from_orbax_checkpoint(tmp_root):

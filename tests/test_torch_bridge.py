@@ -431,6 +431,81 @@ def test_criterion_options_and_framework_stub_detection():
     assert adapted._step_apply is None
 
 
+def test_user_package_named_like_framework_is_traced():
+    """A user module living in a package whose NAME merely starts with a
+    framework name (e.g. 'lightning_models') is user code — its custom
+    training_step must be traced, not silently swapped for
+    forward->criterion. Only 'lightning'/'pytorch_lightning'/'torch'
+    themselves (or their dotted subpackages) are framework."""
+
+    class ShadowPkgStep(PlStyleMLP):
+        def log(self, *args, **kwargs):
+            pass
+
+        def training_step(self, batch, batch_idx):
+            x, y = batch
+            loss = self.criterion(self(x), y) + 0.01 * (self(x) ** 2).mean()
+            self.log("train_loss", loss)
+            return loss
+
+    # the DEFINING class (the one the MRO walk finds training_step on)
+    # must carry the user-package module path for the matcher to see it
+    ShadowPkgStep.__module__ = "lightning_models.nets"
+    adapted = adapt_torch_module(ShadowPkgStep())
+    assert adapted._step_apply is not None  # traced, not ignored
+
+    # the real framework paths still mean "stub, don't trace"
+    class PlBase(nn.Module):
+        def training_step(self, *a, **k):
+            raise RuntimeError("stub")
+
+    PlBase.__module__ = "lightning.pytorch.core.module"
+
+    class NewApiUser(PlBase):
+        def __init__(self):
+            super().__init__()
+            self.net = nn.Linear(32, 10)
+            self.criterion = nn.CrossEntropyLoss()
+
+        def forward(self, x):
+            return self.net(x)
+
+        def configure_optimizers(self):
+            return torch.optim.Adam(self.parameters(), lr=1e-3)
+
+    assert adapt_torch_module(NewApiUser())._step_apply is None
+
+
+def test_log_patch_is_instance_scoped():
+    """Tracing one instance's training_step must not blank `log` on the
+    CLASS — another live instance (or a concurrent adapt) calling
+    self.log during the window would silently no-op. The traced step
+    records the class attribute as seen mid-trace."""
+    seen_class_log = []
+
+    class LoggingStep(PlStyleMLP):
+        def log(self, *args, **kwargs):
+            pass
+
+        def training_step(self, batch, batch_idx):
+            # non-proxy side effect: executes for real during fx trace
+            seen_class_log.append(type(self).__dict__.get("log"))
+            self.log("train_loss", 0.0)
+            x, y = batch
+            return self.criterion(self(x), y)
+
+    original = LoggingStep.__dict__["log"]
+    module = LoggingStep()
+    adapted = adapt_torch_module(module)
+    assert adapted._step_apply is not None
+    assert seen_class_log, "trace never ran"
+    assert all(f is original for f in seen_class_log), (
+        "class-level log was monkeypatched during the trace window"
+    )
+    # the instance-level shim is removed after tracing
+    assert "log" not in module.__dict__
+
+
 def test_user_validation_step_is_traced():
     """A user validation_step (plain CE, no aux term) drives val_loss even
     when training_step carries aux terms — monitor semantics match the
