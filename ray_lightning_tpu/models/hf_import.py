@@ -31,7 +31,20 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16, **overrides) -> LlamaConfig:
 
     # refuses unsupported kinds (importing with plain rope_theta would
     # silently change every position's angles)
-    scaling = normalize_rope_scaling(getattr(hf_config, "rope_scaling", None))
+    raw_scaling = getattr(hf_config, "rope_scaling", None)
+    if raw_scaling:
+        raw_scaling = dict(raw_scaling)
+        kind = raw_scaling.get("rope_type", raw_scaling.get("type"))
+        if (
+            kind == "yarn"
+            and not raw_scaling.get("original_max_position_embeddings")
+        ):
+            # HF semantics: absent/None means the config's
+            # max_position_embeddings (transformers' _compute_yarn_parameters)
+            raw_scaling["original_max_position_embeddings"] = int(
+                hf_config.max_position_embeddings
+            )
+    scaling = normalize_rope_scaling(raw_scaling)
     if getattr(hf_config, "attention_bias", False) or getattr(
         hf_config, "mlp_bias", False
     ):

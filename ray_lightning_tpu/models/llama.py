@@ -43,7 +43,8 @@ class LlamaConfig:
     ffn_dim: int = 5632
     max_seq: int = 2048
     rope_theta: float = 500000.0
-    # HF-style rope_scaling ('llama3' for Llama-3.1+, 'linear'); None =
+    # HF-style rope_scaling ('llama3' for Llama-3.1+, 'linear', 'yarn'
+    # for Qwen2/DeepSeek-family long-context checkpoints); None =
     # plain rope. Accepts a dict; stored as a sorted (key, value) tuple so
     # the frozen config stays HASHABLE. Validated in
     # ops/rope.py::normalize_rope_scaling.

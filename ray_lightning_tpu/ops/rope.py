@@ -3,6 +3,7 @@ family). Precomputed angle tables; applied in fp32 then cast back, which XLA
 fuses into the surrounding matmuls."""
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import jax.numpy as jnp
@@ -29,6 +30,64 @@ def _llama3_scale(inv_freq: jnp.ndarray, scaling: dict) -> jnp.ndarray:
     return jnp.where(mid, smoothed, scaled)
 
 
+def _yarn_scale(inv_freq: jnp.ndarray, scaling: dict, head_dim: int,
+                theta: float):
+    """YaRN ('rope_type': 'yarn', the Qwen2/DeepSeek-family long-context
+    scaling, arXiv:2309.00071): per-dimension blend of interpolated
+    (inv_freq/factor) and extrapolated (unchanged) frequencies over a
+    linear ramp between the beta_fast/beta_slow correction dims, plus a
+    cos/sin magnitude correction (``attention_factor``). Matches
+    transformers' ``_compute_yarn_parameters`` so imported checkpoints
+    agree (logit-parity-tested in tests/test_llama.py).
+
+    Returns (inv_freq, attention_factor)."""
+    factor = float(scaling["factor"])
+    attention_factor = scaling.get("attention_factor")
+    mscale = scaling.get("mscale")
+    mscale_all_dim = scaling.get("mscale_all_dim")
+    orig = float(scaling["original_max_position_embeddings"])
+
+    def get_mscale(scale, ms=1.0):
+        if scale <= 1:
+            return 1.0
+        return 0.1 * ms * math.log(scale) + 1.0
+
+    if attention_factor is None:
+        if mscale and mscale_all_dim:
+            attention_factor = float(
+                get_mscale(factor, mscale) / get_mscale(factor, mscale_all_dim)
+            )
+        else:
+            attention_factor = get_mscale(factor)
+
+    beta_fast = float(scaling.get("beta_fast") or 32)
+    beta_slow = float(scaling.get("beta_slow") or 1)
+
+    def correction_dim(num_rotations):
+        return (
+            head_dim * math.log(orig / (num_rotations * 2 * math.pi))
+        ) / (2 * math.log(theta))
+
+    low = correction_dim(beta_fast)
+    high = correction_dim(beta_slow)
+    if scaling.get("truncate", True):
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, head_dim - 1)
+    if low == high:
+        high += 0.001  # prevent singularity in the ramp
+
+    ramp = jnp.clip(
+        (jnp.arange(head_dim // 2, dtype=jnp.float32) - low) / (high - low),
+        0.0, 1.0,
+    )
+    extrapolation_factor = 1.0 - ramp
+    blended = (
+        inv_freq / factor * (1.0 - extrapolation_factor)
+        + inv_freq * extrapolation_factor
+    )
+    return blended, float(attention_factor)
+
+
 def normalize_rope_scaling(scaling) -> Optional[dict]:
     """The ONE validation point for HF-style ``rope_scaling``: accepts a
     dict or a (key, value)-pair tuple (LlamaConfig's hashable storage),
@@ -41,9 +100,18 @@ def normalize_rope_scaling(scaling) -> Optional[dict]:
     kind = d.get("rope_type", d.get("type", "default"))
     if kind == "default":
         return None
-    if kind not in ("llama3", "linear"):
+    if kind not in ("llama3", "linear", "yarn"):
         raise NotImplementedError(
-            f"rope_scaling type {kind!r}; 'llama3'/'linear' are mapped"
+            f"rope_scaling type {kind!r}; 'llama3'/'linear'/'yarn' are mapped"
+        )
+    if kind == "yarn" and not d.get("original_max_position_embeddings"):
+        # yarn's correction range needs the PRETRAIN context length; HF
+        # configs that omit it mean max_position_embeddings (hf_import
+        # injects that) — a hand-built config must say it explicitly
+        raise ValueError(
+            "yarn rope_scaling requires 'original_max_position_embeddings' "
+            "(the pretrain context length the correction range is "
+            "computed against)"
         )
     return d
 
@@ -53,20 +121,32 @@ def rope_angles(seq_len: int, head_dim: int, theta: float = 500000.0,
     """Return (cos, sin) tables of shape [seq_len, head_dim//2].
 
     ``scaling``: an optional HF-style ``rope_scaling`` dict (or pair
-    tuple); 'llama3' (Llama-3.1+) and 'linear' types are supported."""
+    tuple); 'llama3' (Llama-3.1+), 'linear', and 'yarn' (Qwen2/DeepSeek
+    long-context; its cos/sin magnitude correction is baked into the
+    returned tables) types are supported."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    attention_factor = 1.0
     scaling = normalize_rope_scaling(scaling)
     if scaling:
         kind = scaling.get("rope_type", scaling.get("type"))
         if kind == "llama3":
             inv_freq = _llama3_scale(inv_freq, scaling)
+        elif kind == "yarn":
+            inv_freq, attention_factor = _yarn_scale(
+                inv_freq, scaling, head_dim, theta
+            )
         else:  # "linear" (normalize_rope_scaling admits no other kind)
             inv_freq = inv_freq / float(scaling["factor"])
     positions = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
     angles = positions[:, None] * inv_freq[None, :]
-    return jnp.cos(angles), jnp.sin(angles)
+    # yarn's magnitude correction rides the tables (both q and k pick it
+    # up, matching transformers' cos/sin * attention_scaling)
+    return (
+        jnp.cos(angles) * attention_factor,
+        jnp.sin(angles) * attention_factor,
+    )
 
 
 def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:

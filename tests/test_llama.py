@@ -243,14 +243,33 @@ def test_hf_llama_import_logit_parity(tmp_root):
         ref_31 = hf_31(torch.from_numpy(tok48)).logits.numpy()
     ours_31, _ = rlt_forward(params_31, jnp.asarray(tok48, jnp.int32), cfg_31)
     assert np.max(np.abs(ref_31 - np.asarray(ours_31, np.float32))) < 1e-4
-    # unknown scaling types still refuse rather than silently diverging
+    # yarn scaling (Qwen2/DeepSeek-family long-context checkpoints) maps:
+    # the blended inv_freq AND the cos/sin magnitude correction match
+    # transformers' _compute_yarn_parameters
     hf_cfg_yarn = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-6, rope_theta=10000.0,
+        tie_word_embeddings=False, attention_dropout=0.0,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 32},
+    )
+    torch.manual_seed(3)
+    hf_yarn = transformers.LlamaForCausalLM(hf_cfg_yarn).eval()
+    params_y, cfg_y = import_hf_llama(hf_yarn, dtype=jnp.float32)
+    with torch.no_grad():
+        ref_y = hf_yarn(torch.from_numpy(tok48)).logits.numpy()
+    ours_y, _ = rlt_forward(params_y, jnp.asarray(tok48, jnp.int32), cfg_y)
+    assert np.max(np.abs(ref_y - np.asarray(ours_y, np.float32))) < 1e-4
+    # unknown scaling types still refuse rather than silently diverging
+    hf_cfg_unknown = transformers.LlamaConfig(
         vocab_size=64, hidden_size=32, intermediate_size=64,
         num_hidden_layers=1, num_attention_heads=4,
-        rope_scaling={"rope_type": "yarn", "factor": 4.0},
+        rope_scaling={"rope_type": "longrope", "factor": 4.0,
+                      "long_factor": [1.0] * 4, "short_factor": [1.0] * 4},
     )
-    with pytest.raises(NotImplementedError, match="yarn"):
-        import_hf_llama(transformers.LlamaForCausalLM(hf_cfg_yarn))
+    with pytest.raises(NotImplementedError, match="longrope"):
+        import_hf_llama(transformers.LlamaForCausalLM(hf_cfg_unknown))
 
     # the imported weights fine-tune through the real Trainer on a mesh
     module = LlamaModule(cfg, lr=1e-3)

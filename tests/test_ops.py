@@ -142,6 +142,59 @@ def test_rope_rotation_preserves_norm():
     assert float(jnp.max(jnp.abs(norm_in - norm_out))) < 1e-4
 
 
+def test_yarn_rope_matches_transformers():
+    """The yarn inv_freq blend AND the inferred attention_factor match
+    transformers' _compute_yarn_parameters across its branches (explicit
+    attention_factor, inferred-from-factor, mscale/mscale_all_dim)."""
+    pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    import numpy as np
+    from types import SimpleNamespace
+
+    from transformers.modeling_rope_utils import _compute_yarn_parameters
+
+    from ray_lightning_tpu.ops.rope import _yarn_scale, rope_angles
+
+    head_dim, theta = 64, 10000.0
+    base_inv = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    cases = [
+        {"rope_type": "yarn", "factor": 4.0,
+         "original_max_position_embeddings": 2048},
+        {"rope_type": "yarn", "factor": 8.0, "beta_fast": 64,
+         "beta_slow": 2, "original_max_position_embeddings": 4096},
+        {"rope_type": "yarn", "factor": 4.0, "attention_factor": 1.3,
+         "original_max_position_embeddings": 2048},
+        # the DeepSeek-style mscale pair
+        {"rope_type": "yarn", "factor": 40.0, "mscale": 1.0,
+         "mscale_all_dim": 0.8, "original_max_position_embeddings": 4096},
+    ]
+    for scaling in cases:
+        cfg = SimpleNamespace(
+            rope_theta=theta, hidden_size=head_dim * 4,
+            num_attention_heads=4, head_dim=head_dim,
+            max_position_embeddings=scaling["original_max_position_embeddings"]
+            * int(scaling["factor"]),
+            rope_scaling=dict(scaling),
+        )
+        ref_inv, ref_att = _compute_yarn_parameters(cfg, device="cpu")
+        ours_inv, ours_att = _yarn_scale(base_inv, scaling, head_dim, theta)
+        assert np.allclose(ref_inv.numpy(), np.asarray(ours_inv),
+                           rtol=1e-6), scaling
+        assert abs(ref_att - ours_att) < 1e-6, scaling
+        # and the tables carry the magnitude correction
+        cos, _ = rope_angles(4, head_dim, theta, scaling=scaling)
+        assert abs(float(cos[0, 0]) - ours_att) < 1e-6  # cos(0)*factor
+
+
+def test_yarn_requires_original_max_positions():
+    from ray_lightning_tpu.ops.rope import normalize_rope_scaling
+
+    with pytest.raises(ValueError, match="original_max_position"):
+        normalize_rope_scaling({"rope_type": "yarn", "factor": 4.0})
+
+
 def test_flash_multiblock_grid(monkeypatch):
     """Force small blocks so the grid really iterates (4 q-blocks x 4
     kv-blocks): exercises the scratch-accumulator handoff across grid steps
