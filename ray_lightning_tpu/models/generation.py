@@ -107,7 +107,8 @@ def prefill(
             impl = None
         return attention(q, k, v, causal=True, impl=impl,
                          block_q=cfg.flash_block_q or None,
-                         block_k=cfg.flash_block_k or None)
+                         block_k=cfg.flash_block_k or None,
+                         window=cfg.sliding_window or None)
 
     # MoE prompts route losslessly too: generation's semantic is uniformly
     # no-drop — prefill and stepwise decode must produce identical caches,
@@ -156,8 +157,14 @@ def decode_step(
     c, s = _rope_at(rope_table, pos)
     x = params["embed"][token]  # [B, D]
 
-    # causal-by-position mask over the static cache length
-    valid = (jnp.arange(max_len) <= pos)[None, None, :]  # [1, 1, max_len]
+    # causal-by-position mask over the static cache length; under a
+    # sliding window only the last W cache slots stay visible (matches
+    # the training band: i attends [i-W+1, i])
+    positions = jnp.arange(max_len)
+    keep = positions <= pos
+    if cfg.sliding_window:
+        keep &= positions > pos - cfg.sliding_window
+    valid = keep[None, None, :]  # [1, 1, max_len]
 
     def layer_fn(x, inputs):
         lp, k_cache, v_cache = inputs  # k/v: [B, Hkv, max_len, hd]

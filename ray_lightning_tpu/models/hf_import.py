@@ -52,6 +52,34 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16, **overrides) -> LlamaConfig:
             "attention_bias/mlp_bias checkpoints are not mapped (the native "
             "layers are bias-free, matching standard Llama)"
         )
+    # Mistral/Mixtral-style windowed attention maps onto the native band
+    # kernels; Qwen2-style configs gate it behind use_sliding_window.
+    # The native band is UNIFORM across layers, so per-layer gating
+    # (Qwen2's max_window_layers, newer configs' mixed layer_types)
+    # refuses rather than silently applying the band everywhere
+    window = getattr(hf_config, "sliding_window", None)
+    if window and not getattr(hf_config, "use_sliding_window", True):
+        window = None
+    if window:
+        n_layers = hf_config.num_hidden_layers
+        layer_types = getattr(hf_config, "layer_types", None)
+        if layer_types and len(set(layer_types)) > 1:
+            raise NotImplementedError(
+                f"mixed per-layer attention types {sorted(set(layer_types))}"
+                ": the native sliding window is uniform across layers"
+            )
+        if layer_types and set(layer_types) == {"full_attention"}:
+            window = None
+        # Qwen2 semantics: layers with idx >= max_window_layers slide,
+        # earlier ones are dense
+        mwl = getattr(hf_config, "max_window_layers", None)
+        if window and mwl is not None and 0 < mwl < n_layers:
+            raise NotImplementedError(
+                f"max_window_layers={mwl} of {n_layers}: mixed dense/"
+                "windowed layers; the native sliding window is uniform"
+            )
+        if window and mwl is not None and mwl >= n_layers:
+            window = None  # no layer actually slides
     fields = dict(
         vocab_size=hf_config.vocab_size,
         dim=hf_config.hidden_size,
@@ -65,6 +93,7 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16, **overrides) -> LlamaConfig:
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
         rope_scaling=scaling,
         norm_eps=float(hf_config.rms_norm_eps),
+        sliding_window=int(window or 0),
         dtype=dtype,
     )
     fields.update(overrides)
@@ -172,9 +201,9 @@ def import_hf_mixtral(
     - Mixtral routes without expert capacity (token choice). The imported
       config sets ``capacity_factor`` to cover the worst case so training
       matches; generation already routes losslessly.
-    - Sequences must stay within ``sliding_window`` when the checkpoint
-      sets one (windowed attention is not mapped); cap ``max_seq`` via
-      the overrides for long-window checkpoints.
+    - ``sliding_window`` checkpoints map onto the native band kernels
+      (cfg.sliding_window; ops/attention.py ``window=``), so sequences
+      longer than the window import and run with HF-matching masks.
     """
     if isinstance(model_or_path, str):
         from transformers import MixtralForCausalLM
@@ -198,12 +227,6 @@ def import_hf_mixtral(
     overrides.update(config_overrides)
     cfg = config_from_hf(hf_cfg, dtype=dtype, **overrides)
     _check_uniform_heads(cfg)
-    window = getattr(hf_cfg, "sliding_window", None)
-    if window is not None and cfg.max_seq > window:
-        raise NotImplementedError(
-            f"sliding_window={window} < max_seq={cfg.max_seq}: windowed "
-            "attention is not mapped; pass max_seq<=window in the overrides"
-        )
 
     take = _make_take(dict(model.state_dict()), cfg.dtype)
     layers: Dict[str, Any] = {

@@ -61,6 +61,12 @@ class LlamaConfig:
     #              .dots_with_no_batch_dims_saveable
     remat_policy: str = "nothing"
     attn_impl: Optional[str] = None  # None=auto, "flash", "reference"
+    # sliding-window attention (0 = dense causal): position i attends
+    # [i-W+1, i] — HF Mistral semantics. Composes with the flash kernels'
+    # block skipping (O(S*W) work) and the dense einsum fallback; NOT
+    # with the 'sp' ring path (refused at forward: the band would have to
+    # be re-derived per ring step).
+    sliding_window: int = 0
     # flash block sizes (0 = env/default). Static ints in the traced step,
     # so a sweep is one process retracing per config — tunnel-friendly.
     flash_block_q: int = 0
@@ -98,6 +104,11 @@ class LlamaConfig:
             raise ValueError(
                 f"remat_policy={self.remat_policy!r}: expected 'nothing' "
                 "or 'dots'"
+            )
+        if self.sliding_window < 0:
+            raise ValueError(
+                f"sliding_window={self.sliding_window}: must be >= 0 "
+                "(0 = dense causal)"
             )
         if self.rope_scaling is not None and not isinstance(
             self.rope_scaling, tuple
@@ -458,6 +469,12 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
                 reduce_fn = lambda y: jax.lax.psum(y, "tp")
 
         if sp > 1:
+            if cfg.sliding_window:
+                raise NotImplementedError(
+                    "sliding_window does not compose with 'sp' ring "
+                    "attention (the band would cross ring-step shard "
+                    "boundaries); drop the sp axis or sliding_window"
+                )
             from ray_lightning_tpu.parallel.ring_attention import (
                 ring_attention_local,
             )
@@ -475,6 +492,7 @@ def _pp_stage_setup(params: Dict[str, Any], cfg: LlamaConfig, mesh: Mesh,
                     q, k, v, causal=True, impl=cfg.attn_impl,
                     block_q=cfg.flash_block_q or None,
                     block_k=cfg.flash_block_k or None,
+                    window=cfg.sliding_window or None,
                 )
 
         moe_fn = None
@@ -731,6 +749,13 @@ def forward(
     if use_ring:
         from ray_lightning_tpu.parallel.ring_attention import ring_attention
 
+    if use_ring and cfg.sliding_window:
+        raise NotImplementedError(
+            "sliding_window does not compose with 'sp' ring attention "
+            "(the band would cross ring-step shard boundaries); drop the "
+            "sp axis or sliding_window"
+        )
+
     def attn_fn(q, k, v):
         if use_ring:
             return ring_attention(
@@ -744,6 +769,7 @@ def forward(
             q, k, v, causal=True, impl=cfg.attn_impl,
             block_q=cfg.flash_block_q or None,
             block_k=cfg.flash_block_k or None,
+            window=cfg.sliding_window or None,
         )
 
     def layer_fn(x, lp):

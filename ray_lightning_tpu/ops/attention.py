@@ -54,8 +54,12 @@ def reference_attention(
     v: jnp.ndarray,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
-    """q: [B, Hq, S, D]; k, v: [B, Hkv, S, D] with Hq % Hkv == 0."""
+    """q: [B, Hq, S, D]; k, v: [B, Hkv, S, D] with Hq % Hkv == 0.
+
+    ``window``: sliding-window size W (requires ``causal``): position i
+    attends positions [i-W+1, i] — HF Mistral semantics (i - j < W)."""
     b, hq, sq, d = q.shape
     hkv = k.shape[1]
     if hq != hkv:
@@ -69,7 +73,10 @@ def reference_attention(
         skv = k.shape[2]
         qi = jnp.arange(sq)[:, None] + (skv - sq)
         ki = jnp.arange(skv)[None, :]
-        logits = jnp.where(qi >= ki, logits, -jnp.inf)
+        keep = qi >= ki
+        if window:
+            keep &= (qi - ki) < window
+        logits = jnp.where(keep, logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v).astype(q.dtype)
 
@@ -78,9 +85,35 @@ def reference_attention(
 # pallas forward: grid (b, h, n_q, n_kv), KV innermost; acc/m/l live in
 # fp32 VMEM scratch carried across the KV steps of one q block
 # --------------------------------------------------------------------- #
+def _mask_scores(s, qi, kj, block_q, block_k, causal, window):
+    """Apply the causal (and optional sliding-window band) mask to one
+    [BQ, BK] score block at grid position (qi, kj). Shared by all three
+    kernels so the mask cannot drift between forward and backward.
+
+    Windowed masking uses a large FINITE negative instead of -inf: an
+    active block can contain rows whose band lies entirely outside it
+    (the block-level activity test is per-block, not per-row), and a
+    fully -inf row would drive the online softmax through exp(inf-inf)
+    = nan. With a finite mask value such a row's bogus uniform
+    contribution is annihilated by the alpha = exp(m_prev - m_new)
+    rescale as soon as its first real (diagonal-containing) block
+    arrives — which always exists under causal+window. Pure causal keeps
+    -inf: each row's first visited block always contains column 0."""
+    if not causal:
+        return s
+    rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    keep = rows >= cols
+    neg = -jnp.inf
+    if window:
+        keep &= (rows - cols) < window
+        neg = jnp.float32(-1e30)
+    return jnp.where(keep, s, neg)
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, l_ref, acc_scr, m_scr, l_scr,
-    *, scale, causal, block_q, block_k, n_kv,
+    *, scale, causal, window, block_q, block_k, n_kv,
 ):
     from jax.experimental import pallas as pl
 
@@ -94,7 +127,8 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
 
     # causal: skip blocks whose first kv index exceeds the last q index
-    active = _block_active(qi, kj, block_q, block_k, causal)
+    # (and, under a window, blocks entirely below the band)
+    active = _block_active(qi, kj, block_q, block_k, causal, window)
 
     @pl.when(active)
     def _update():
@@ -107,10 +141,7 @@ def _fwd_kernel(
             )
             * scale
         )  # [BQ, BK] fp32
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, -jnp.inf)
+        s = _mask_scores(s, qi, kj, block_q, block_k, causal, window)
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -140,7 +171,7 @@ def _fwd_kernel(
 # --------------------------------------------------------------------- #
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, scale, causal, block_q, block_k, n_kv,
+    *, scale, causal, window, block_q, block_k, n_kv,
 ):
     from jax.experimental import pallas as pl
 
@@ -151,7 +182,7 @@ def _bwd_dq_kernel(
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    active = _block_active(qi, kj, block_q, block_k, causal)
+    active = _block_active(qi, kj, block_q, block_k, causal, window)
 
     @pl.when(active)
     def _update():
@@ -167,10 +198,7 @@ def _bwd_dq_kernel(
             )
             * scale
         )
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, -jnp.inf)
+        s = _mask_scores(s, qi, kj, block_q, block_k, causal, window)
         p = jnp.exp(s - lse)  # [BQ, BK]
         dp = jax.lax.dot_general(
             do, vs, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -195,7 +223,7 @@ def _bwd_dq_kernel(
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, scale, causal, block_q, block_k, n_q, group,
+    *, scale, causal, window, block_q, block_k, n_q, group,
 ):
     from jax.experimental import pallas as pl
 
@@ -209,7 +237,8 @@ def _bwd_dkv_kernel(
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     # causal: a q block entirely above the diagonal contributes nothing
-    active = _block_active(qi, ki, block_q, block_k, causal)
+    # (under a window, neither does one entirely below the band)
+    active = _block_active(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(active)
     def _update():
@@ -225,10 +254,7 @@ def _bwd_dkv_kernel(
             )
             * scale
         )
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, -jnp.inf)
+        s = _mask_scores(s, qi, ki, block_q, block_k, causal, window)
         p = jnp.exp(s - lse)  # [BQ, BK]
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -275,46 +301,72 @@ def _pick_blocks(s: int, block_q: Optional[int] = None, block_k: Optional[int] =
     return bq, bk
 
 
-def _block_active(row_blk, col_blk, block_q: int, block_k: int, causal: bool):
+def _block_active(row_blk, col_blk, block_q: int, block_k: int, causal: bool,
+                  window: Optional[int] = None):
     """Does q block `row_blk` intersect kv block `col_blk` under the causal
-    mask? (Trivially-true traced predicate when not causal, so pl.when
-    always receives a tracer.) Shared by all three kernels."""
+    (and optional sliding-window band) mask? (Trivially-true traced
+    predicate when not causal, so pl.when always receives a tracer.)
+    Shared by all three kernels."""
     if causal:
-        return col_blk * block_k <= (row_blk + 1) * block_q - 1
+        active = col_blk * block_k <= (row_blk + 1) * block_q - 1
+        if window:
+            # the block's last kv index must reach the band's lower edge
+            # for the block's FIRST q row: col_end >= row_start - (W - 1)
+            active &= (
+                (col_blk + 1) * block_k - 1
+                >= row_blk * block_q - (window - 1)
+            )
+        return active
     return col_blk >= 0
 
 
-def _kv_index_map(group: int, bq: int, bk: int, causal: bool):
+def _kv_index_map(group: int, bq: int, bk: int, causal: bool,
+                  window: Optional[int] = None):
     """KV BlockSpec index map for grids (b, h, i, j). Under the causal mask,
-    masked steps CLAMP their kv index to the last active block: revisiting
-    the already-resident block elides the DMA, so skipped steps cost
-    neither compute (pl.when in the kernel) nor HBM bandwidth."""
+    masked steps CLAMP their kv index to the last active block (and, under
+    a sliding window, below-band steps clamp UP to the first active
+    block): revisiting the already-resident block elides the DMA, so
+    skipped steps cost neither compute (pl.when in the kernel) nor HBM
+    bandwidth."""
     if causal:
-        return lambda b_, h, i, j, g=group: (
-            b_, h // g, jnp.minimum(j, ((i + 1) * bq - 1) // bk), 0
-        )
+        def kv_idx(b_, h, i, j, g=group):
+            hi = ((i + 1) * bq - 1) // bk
+            j = jnp.minimum(j, hi)
+            if window:
+                lo = jnp.maximum(i * bq - (window - 1), 0) // bk
+                j = jnp.maximum(j, lo)
+            return b_, h // g, j, 0
+
+        return kv_idx
     return lambda b_, h, i, j, g=group: (b_, h // g, j, 0)
 
 
-def _q_index_map_for_dkv(bq: int, bk: int, causal: bool, group: int, n_q: int):
+def _q_index_map_for_dkv(bq: int, bk: int, causal: bool, group: int,
+                         n_q: int, window: Optional[int] = None):
     """Q-side BlockSpec index map for the dK/dV grid (b, h, j, t) where h
     is the KV-head GRID INDEX and t folds (gqa group member, q block):
     the Q head is h * group + t // n_q and the q block t % n_q. Inactive
-    leading
-    steps of each head's segment (q blocks fully above the diagonal)
-    clamp UP to the first active q block — same DMA-eliding trick as
-    _kv_index_map."""
+    leading steps of each head's segment (q blocks fully above the
+    diagonal) clamp UP to the first active q block — and, under a
+    sliding window, trailing steps (q blocks beyond the band) clamp DOWN
+    to the last active one. Same DMA-eliding trick as _kv_index_map."""
 
     def q_block(j, t):
         i = t % n_q
-        return jnp.maximum(i, (j * bk) // bq) if causal else i
+        if not causal:
+            return i
+        i = jnp.maximum(i, (j * bk) // bq)
+        if window:
+            hi = ((j + 1) * bk - 1 + (window - 1)) // bq
+            i = jnp.minimum(i, hi)
+        return i
 
     return lambda b_, h, j, t: (
         b_, h * group + t // n_q, q_block(j, t), 0
     )
 
 
-def _flash_fwd(q, k, v, causal, scale, interpret, blocks=None):
+def _flash_fwd(q, k, v, causal, scale, interpret, blocks=None, window=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -326,10 +378,10 @@ def _flash_fwd(q, k, v, causal, scale, interpret, blocks=None):
     n_kv = skv // bk
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        n_kv=n_kv,
+        _fwd_kernel, scale=scale, causal=causal, window=window, block_q=bq,
+        block_k=bk, n_kv=n_kv,
     )
-    kv_idx = _kv_index_map(group, bq, bk, causal)
+    kv_idx = _kv_index_map(group, bq, bk, causal, window)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, hq, sq // bq, n_kv),
@@ -356,7 +408,8 @@ def _flash_fwd(q, k, v, causal, scale, interpret, blocks=None):
     return out, lse
 
 
-def _flash_bwd(q, k, v, out, lse, do, causal, scale, interpret, blocks=None):
+def _flash_bwd(q, k, v, out, lse, do, causal, scale, interpret, blocks=None,
+               window=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -370,11 +423,11 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, interpret, blocks=None):
 
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1, keepdims=True)
 
-    kv_idx = _kv_index_map(group, bq, bk, causal)
+    kv_idx = _kv_index_map(group, bq, bk, causal, window)
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-            n_kv=n_kv,
+            _bwd_dq_kernel, scale=scale, causal=causal, window=window,
+            block_q=bq, block_k=bk, n_kv=n_kv,
         ),
         grid=(b, hq, n_q, n_kv),
         in_specs=[
@@ -394,11 +447,11 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, interpret, blocks=None):
     # dK/dV: grid over KV heads with the GQA group folded into the
     # innermost dimension — the group reduction happens in the fp32
     # accumulator, dk/dv land [B, Hkv, S, D] directly
-    q_idx = _q_index_map_for_dkv(bq, bk, causal, group, n_q)
+    q_idx = _q_index_map_for_dkv(bq, bk, causal, group, n_q, window)
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-            n_q=n_q, group=group,
+            _bwd_dkv_kernel, scale=scale, causal=causal, window=window,
+            block_q=bq, block_k=bk, n_q=n_q, group=group,
         ),
         grid=(b, hkv, n_kv, group * n_q),
         in_specs=[
@@ -429,20 +482,21 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, interpret, blocks=None):
 # --------------------------------------------------------------------- #
 # public op with custom VJP
 # --------------------------------------------------------------------- #
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention(q, k, v, causal, scale, interpret, blocks):
-    out, _ = _flash_fwd(q, k, v, causal, scale, interpret, blocks)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, scale, interpret, blocks, window=None):
+    out, _ = _flash_fwd(q, k, v, causal, scale, interpret, blocks, window)
     return out
 
 
-def _flash_attention_fwd(q, k, v, causal, scale, interpret, blocks):
-    out, lse = _flash_fwd(q, k, v, causal, scale, interpret, blocks)
+def _flash_attention_fwd(q, k, v, causal, scale, interpret, blocks, window=None):
+    out, lse = _flash_fwd(q, k, v, causal, scale, interpret, blocks, window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_attention_bwd(causal, scale, interpret, blocks, residuals, g):
+def _flash_attention_bwd(causal, scale, interpret, blocks, window, residuals, g):
     q, k, v, out, lse = residuals
-    return _flash_bwd(q, k, v, out, lse, g, causal, scale, interpret, blocks)
+    return _flash_bwd(q, k, v, out, lse, g, causal, scale, interpret, blocks,
+                      window)
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
@@ -481,6 +535,7 @@ def attention(
     interpret: Optional[bool] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Dispatching attention op. q: [B, Hq, S, D]; k/v: [B, Hkv, S, D].
 
@@ -488,8 +543,28 @@ def attention(
     TPU-tileable, reference otherwise). block_q/block_k: explicit flash
     block sizes (static ints, so distinct values retrace — sweepable in
     one process); default env/512.
+
+    window: sliding-window size W (static; requires causal): position i
+    attends positions [i-W+1, i] — HF Mistral semantics. In the flash
+    path the band composes with the causal block skip (out-of-band
+    blocks cost neither compute nor DMA), so long-sequence work scales
+    O(S*W) instead of O(S^2). W >= S is a no-op and drops to plain
+    causal.
     """
     sq, d = q.shape[2], q.shape[3]
+    if window is not None:
+        if not causal:
+            raise NotImplementedError(
+                "sliding-window attention is causal-only (decoder bands)"
+            )
+        if window < 1:
+            raise ValueError(f"window={window}: must be >= 1")
+        if window >= k.shape[2]:
+            # band covers every kv position: plain causal. Keyed to the KV
+            # length — with skv > sq (reference-path cached decoding) a
+            # window smaller than skv still masks old positions even when
+            # it exceeds the query count
+            window = None
     scale = sm_scale if sm_scale is not None else float(1.0 / np.sqrt(d))
     flash_ok = flash_supported(q.shape, k.shape, block_q, block_k)
     if impl is None:
@@ -508,7 +583,9 @@ def attention(
             "Use impl='reference' for these shapes."
         )
     if impl == "reference":
-        return reference_attention(q, k, v, causal=causal, sm_scale=scale)
+        return reference_attention(
+            q, k, v, causal=causal, sm_scale=scale, window=window
+        )
     if interpret is None:
         interpret = _interpret_default()
     blocks = (block_q, block_k) if (block_q or block_k) else None
@@ -518,7 +595,7 @@ def attention(
         pad = ((0, 0), (0, 0), (0, 0), (0, d_pad - d))
         out = _flash_attention(
             jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
-            causal, scale, interpret, blocks,
+            causal, scale, interpret, blocks, window,
         )
         return out[..., :d]
-    return _flash_attention(q, k, v, causal, scale, interpret, blocks)
+    return _flash_attention(q, k, v, causal, scale, interpret, blocks, window)

@@ -285,6 +285,79 @@ def test_hf_llama_import_logit_parity(tmp_root):
     assert trainer.state.status == "finished"
 
 
+def test_hf_mistral_sliding_window_import_parity():
+    """A Mistral-class checkpoint (sliding_window < max_seq) imports onto
+    the native band kernels: logit parity at seq >> window, AND greedy
+    generation is token-identical (prefill band + decode cache band both
+    match HF's mask). The sp ring path refuses the window loudly."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from ray_lightning_tpu.models.generation import generate
+    from ray_lightning_tpu.models.hf_import import import_hf_llama
+    from ray_lightning_tpu.models.llama import forward as rlt_forward
+
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6, rope_theta=10000.0,
+        sliding_window=8, tie_word_embeddings=False, attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    hf = transformers.MistralForCausalLM(hf_cfg).eval()
+    params, cfg = import_hf_llama(hf, dtype=jnp.float32)
+    assert cfg.sliding_window == 8
+
+    tokens = np.random.default_rng(0).integers(0, 128, (2, 32))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.numpy()
+    ours, _ = rlt_forward(params, jnp.asarray(tokens, jnp.int32), cfg)
+    assert np.max(np.abs(ref - np.asarray(ours, np.float32))) < 1e-4
+
+    # decode steps beyond the window must keep masking old cache slots:
+    # generate enough tokens that the band slides past the prompt
+    prompt = jnp.asarray(tokens[:, :12], jnp.int32)
+    out = generate(params, prompt, cfg, max_new_tokens=10)
+    with torch.no_grad():
+        ref_gen = hf.generate(
+            torch.from_numpy(np.asarray(prompt)), max_new_tokens=10,
+            do_sample=False,
+        ).numpy()
+    assert np.array_equal(np.asarray(out), ref_gen)
+
+    # the sp ring path cannot express the band — loud refusal, not drift
+    mesh = build_mesh(MeshSpec(axes={"sp": 2, "dp": 4}))
+    tok_sp = jnp.asarray(
+        np.random.default_rng(1).integers(0, 128, (4, 32)), jnp.int32
+    )
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        rlt_forward(params, tok_sp, cfg, mesh)
+
+    # Qwen2-style PER-LAYER window gating (max_window_layers / mixed
+    # layer_types) refuses: the native band is uniform across layers and
+    # applying it everywhere would silently diverge from HF
+    from ray_lightning_tpu.models.hf_import import config_from_hf
+
+    qwen_mixed = transformers.Qwen2Config(
+        num_hidden_layers=6, sliding_window=64, use_sliding_window=True,
+        max_window_layers=3, max_position_embeddings=256,
+    )
+    with pytest.raises(NotImplementedError, match="layer"):
+        config_from_hf(qwen_mixed)
+    # uniform gating maps: all layers slide...
+    qwen_slide = transformers.Qwen2Config(
+        num_hidden_layers=4, sliding_window=64, use_sliding_window=True,
+        max_window_layers=0, max_position_embeddings=256,
+    )
+    assert config_from_hf(qwen_slide).sliding_window == 64
+    # ...or none does (use_sliding_window off -> dense)
+    qwen_dense = transformers.Qwen2Config(
+        num_hidden_layers=4, sliding_window=64, use_sliding_window=False,
+        max_position_embeddings=256,
+    )
+    assert config_from_hf(qwen_dense).sliding_window == 0
+
+
 def test_hf_mixtral_import_logit_parity(tmp_root):
     """A transformers Mixtral (MoE) checkpoint imports with logit parity
     — its softmax-over-top-k routing is algebraically our
@@ -314,20 +387,24 @@ def test_hf_mixtral_import_logit_parity(tmp_root):
     ours, _ = rlt_forward(params, jnp.asarray(tokens, jnp.int32), cfg)
     assert np.max(np.abs(ref - np.asarray(ours, np.float32))) < 1e-4
 
-    # windowed attention refuses rather than silently diverging
+    # windowed Mixtral checkpoints map onto the native band kernels with
+    # logit parity at seq > window
     hf_cfg_win = transformers.MixtralConfig(
         vocab_size=64, hidden_size=32, intermediate_size=64,
         num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
         num_local_experts=2, num_experts_per_tok=1,
-        max_position_embeddings=64, sliding_window=16,
+        max_position_embeddings=64, rms_norm_eps=1e-6, rope_theta=10000.0,
+        attention_dropout=0.0, sliding_window=8,
     )
-    with pytest.raises(NotImplementedError, match="sliding_window"):
-        import_hf_mixtral(transformers.MixtralForCausalLM(hf_cfg_win))
-    # capping max_seq within the window is the documented escape hatch
-    _, cfg_w = import_hf_mixtral(
-        transformers.MixtralForCausalLM(hf_cfg_win), max_seq=16
-    )
-    assert cfg_w.max_seq == 16
+    torch.manual_seed(1)
+    hf_win = transformers.MixtralForCausalLM(hf_cfg_win).eval()
+    params_w, cfg_w = import_hf_mixtral(hf_win, dtype=jnp.float32)
+    assert cfg_w.sliding_window == 8
+    tok32 = np.random.default_rng(2).integers(0, 64, (2, 32))
+    with torch.no_grad():
+        ref_w = hf_win(torch.from_numpy(tok32)).logits.numpy()
+    ours_w, _ = rlt_forward(params_w, jnp.asarray(tok32, jnp.int32), cfg_w)
+    assert np.max(np.abs(ref_w - np.asarray(ours_w, np.float32))) < 1e-4
 
     # imported MoE weights fine-tune with expert parallelism
     module = LlamaModule(cfg, lr=1e-3)

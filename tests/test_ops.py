@@ -142,6 +142,69 @@ def test_rope_rotation_preserves_norm():
     assert float(jnp.max(jnp.abs(norm_in - norm_out))) < 1e-4
 
 
+def test_sliding_window_flash_parity():
+    """The banded (sliding-window) flash path matches a masked einsum
+    reference — forward and all three grads — including windows that do
+    not align with block boundaries and GQA grouping."""
+    for (s, w, bq, bk) in [(256, 64, 64, 64), (256, 100, 64, 64),
+                           (256, 7, 64, 64), (512, 128, 128, 128)]:
+        q, k, v = _qkv(1, 4, 2, s, 64)
+        ref = reference_attention(q, k, v, causal=True, window=w)
+        out = attention(q, k, v, causal=True, window=w, impl="flash",
+                        interpret=True, block_q=bq, block_k=bk)
+        assert float(jnp.max(jnp.abs(ref - out))) < 1e-4, (s, w)
+
+        def loss(fn):
+            return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+        gr = jax.grad(
+            loss(lambda q, k, v: reference_attention(
+                q, k, v, causal=True, window=w)),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gf = jax.grad(
+            loss(lambda q, k, v: attention(
+                q, k, v, causal=True, window=w, impl="flash",
+                interpret=True, block_q=bq, block_k=bk)),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(gr, gf):
+            assert float(jnp.max(jnp.abs(a - b))) < 1e-3, (s, w)
+
+
+def test_sliding_window_edge_semantics():
+    """W >= S degrades to plain causal; W=1 is attend-self-only;
+    non-causal banding and W < 1 refuse loudly."""
+    q, k, v = _qkv(1, 2, 2, 64, 64)
+    dense = attention(q, k, v, causal=True, interpret=True)
+    wide = attention(q, k, v, causal=True, window=64, interpret=True)
+    assert float(jnp.max(jnp.abs(dense - wide))) < 1e-6
+
+    self_only = attention(q, k, v, causal=True, window=1, impl="flash",
+                          interpret=True, block_q=32, block_k=32)
+    ref = reference_attention(q, k, v, causal=True, window=1)
+    assert float(jnp.max(jnp.abs(self_only - ref))) < 1e-4
+
+    with pytest.raises(NotImplementedError, match="causal"):
+        attention(q, k, v, causal=False, window=8, interpret=True)
+    with pytest.raises(ValueError, match="window"):
+        attention(q, k, v, causal=True, window=0, interpret=True)
+
+    # the W>=S no-op shortcut keys on the KV length: with cached-decode
+    # shapes (skv > sq) a window larger than sq but smaller than skv must
+    # still mask old positions, not silently go dense
+    kq, kk, kv2 = jax.random.split(jax.random.key(7), 3)
+    qs = jax.random.normal(kq, (1, 2, 4, 64), jnp.float32)
+    ks = jax.random.normal(kk, (1, 2, 100, 64), jnp.float32)
+    vs = jax.random.normal(kv2, (1, 2, 100, 64), jnp.float32)
+    banded = attention(qs, ks, vs, causal=True, window=8,
+                       impl="reference", interpret=True)
+    ref_banded = reference_attention(qs, ks, vs, causal=True, window=8)
+    dense2 = reference_attention(qs, ks, vs, causal=True)
+    assert float(jnp.max(jnp.abs(banded - ref_banded))) < 1e-6
+    assert float(jnp.max(jnp.abs(banded - dense2))) > 1e-3
+
+
 def test_yarn_rope_matches_transformers():
     """The yarn inv_freq blend AND the inferred attention_factor match
     transformers' _compute_yarn_parameters across its branches (explicit
