@@ -6,6 +6,7 @@ from ray_lightning_tpu.interop.torch_bridge import (
     TorchModuleAdapter,
     UnsupportedTorchOp,
     adapt_torch_module,
+    fx_to_jax,
     torch_loss_to_jax,
     torch_optimizer_to_optax,
 )
@@ -15,6 +16,7 @@ __all__ = [
     "TorchModuleAdapter",
     "UnsupportedTorchOp",
     "adapt_torch_module",
+    "fx_to_jax",
     "torch_loss_to_jax",
     "torch_optimizer_to_optax",
 ]

@@ -16,9 +16,10 @@ the native JAX path:
   module).
 - ``configure_optimizers()`` is CALLED and the returned
   ``torch.optim.*`` object is translated to the optax equivalent
-  (:func:`torch_optimizer_to_optax`): Adam/AdamW/SGD/RMSprop with
-  lr/betas/eps/weight-decay/momentum/nesterov; StepLR and
-  CosineAnnealingLR schedules.
+  (:func:`torch_optimizer_to_optax`): Adam/AdamW/SGD/RMSprop/Adagrad with
+  lr/betas/eps/weight-decay/momentum/nesterov; StepLR,
+  CosineAnnealingLR, ExponentialLR, OneCycleLR, LinearLR, ConstantLR and
+  SequentialLR warmup chains.
 - the module's criterion (``self.criterion`` / ``self.loss_fn`` / an
   explicit ``loss_fn=``) maps to the jax loss
   (:func:`torch_loss_to_jax`).
@@ -1195,6 +1196,21 @@ def torch_optimizer_to_optax(
             schedule, decay=g.get("alpha", 0.99), eps=g["eps"],
             momentum=g.get("momentum", 0.0),
         )
+    if kind == "Adagrad":
+        if g.get("lr_decay", 0.0):
+            raise UnsupportedTorchOp(
+                "Adagrad lr_decay is not mapped (optax.adagrad has no "
+                "per-accumulation lr decay); use an lr scheduler instead"
+            )
+        chain = []
+        if g.get("weight_decay", 0.0):
+            chain.append(optax.add_decayed_weights(g["weight_decay"]))
+        chain.append(optax.adagrad(
+            schedule,
+            initial_accumulator_value=g.get("initial_accumulator_value", 0.0),
+            eps=g.get("eps", 1e-10),
+        ))
+        return optax.chain(*chain)
     raise UnsupportedTorchOp(
         f"optimizer {kind!r}; override configure_optimizers on the adapter"
     )
@@ -1244,6 +1260,48 @@ def _torch_scheduler_to_optax(sched, lr, total_steps):
             init_value=init, peak_value=max_lr, warmup_steps=warm,
             decay_steps=steps, end_value=final,
         )
+    if kind == "LinearLR":
+        # the common fine-tune warmup: lr * start_factor -> lr *
+        # end_factor over total_iters, then constant at end_factor
+        total = int(sched.total_iters)
+        start, end = lr * sched.start_factor, lr * sched.end_factor
+        return optax.join_schedules(
+            [optax.linear_schedule(start, end, total),
+             optax.constant_schedule(end)],
+            boundaries=[total],
+        )
+    if kind == "ConstantLR":
+        total = int(sched.total_iters)
+        return optax.join_schedules(
+            [optax.constant_schedule(lr * sched.factor),
+             optax.constant_schedule(lr)],
+            boundaries=[total],
+        )
+    if kind == "SequentialLR":
+        # warmup chains (SequentialLR([LinearLR, CosineAnnealingLR], ...)):
+        # translate each child against ITS segment length — join_schedules
+        # hands every child a segment-local step count, matching torch's
+        # each-child-starts-from-zero semantics
+        children = sched._schedulers
+        miles = [int(m) for m in sched._milestones]
+        budgets, prev = [], 0
+        for i in range(len(children)):
+            if i < len(miles):
+                budgets.append(miles[i] - prev)
+                prev = miles[i]
+            else:
+                budgets.append(
+                    (total_steps - prev)
+                    if total_steps and total_steps > prev else None
+                )
+        parts = [
+            _torch_scheduler_to_optax(c, lr, b)
+            for c, b in zip(children, budgets)
+        ]
+        parts = [
+            p if callable(p) else optax.constant_schedule(p) for p in parts
+        ]
+        return optax.join_schedules(parts, boundaries=miles)
     warnings.warn(
         f"lr scheduler {kind!r} is not translated; using constant lr={lr}"
     )

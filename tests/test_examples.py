@@ -35,3 +35,11 @@ def test_torch_bridge_example_smoke():
 def test_hf_finetune_example_smoke():
     out = _run_example("hf_finetune_example.py", "--smoke-test")
     assert "fine-tune + generate OK" in out
+
+
+@pytest.mark.slow
+def test_torch_manual_opt_example_smoke():
+    out = _run_example("torch_manual_opt_example.py", "--smoke-test",
+                       "--max-epochs", "1")
+    assert "adapt refused as designed" in out
+    assert "torch-side generated mean" in out
