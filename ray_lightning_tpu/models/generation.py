@@ -173,9 +173,14 @@ def decode_step(
         nkv = lp["wk"].shape[-1] // hd
         group = nh // nkv
         h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(B, nh, hd)
-        k = (h @ lp["wk"]).reshape(B, nkv, hd)
-        v = (h @ lp["wv"]).reshape(B, nkv, hd)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if "bq" in lp:  # Qwen2-family qkv bias
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(B, nh, hd)
+        k = k.reshape(B, nkv, hd)
+        v = v.reshape(B, nkv, hd)
         q = _apply_rope_one(q, c, s)
         k = _apply_rope_one(k, c, s)
         k_cache = jax.lax.dynamic_update_slice(

@@ -45,12 +45,21 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16, **overrides) -> LlamaConfig:
                 hf_config.max_position_embeddings
             )
     scaling = normalize_rope_scaling(raw_scaling)
-    if getattr(hf_config, "attention_bias", False) or getattr(
-        hf_config, "mlp_bias", False
-    ):
+    if getattr(hf_config, "mlp_bias", False):
         raise NotImplementedError(
-            "attention_bias/mlp_bias checkpoints are not mapped (the native "
-            "layers are bias-free, matching standard Llama)"
+            "mlp_bias checkpoints are not mapped (the native MLP is "
+            "bias-free, matching the whole Llama/Mistral/Qwen2 family)"
+        )
+    if getattr(hf_config, "attention_bias", False):
+        # HF's attention_bias puts a bias on o_proj TOO, which the native
+        # family cannot represent — mapping only qkv would silently
+        # diverge. Qwen2-style qkv-only bias has no config attr; it is
+        # detected from the state_dict by import_hf_llama (attn_bias
+        # override).
+        raise NotImplementedError(
+            "attention_bias checkpoints carry an o_proj bias the native "
+            "attention does not have; only qkv-only bias (Qwen2 family) "
+            "is mapped"
         )
     # Mistral/Mixtral-style windowed attention maps onto the native band
     # kernels; Qwen2-style configs gate it behind use_sliding_window.
@@ -124,7 +133,7 @@ def _check_uniform_heads(cfg: LlamaConfig) -> None:
         )
 
 
-def _attn_layer_leaves(take, p, layers) -> None:
+def _attn_layer_leaves(take, p, layers, attn_bias: bool = False) -> None:
     """The attention + norm leaves shared by every family member.
     torch Linear stores [out, in]; the native layout is [in, out]."""
     layers["attn_norm"].append(take(p + "input_layernorm.weight"))
@@ -133,6 +142,10 @@ def _attn_layer_leaves(take, p, layers) -> None:
     layers["wv"].append(take(p + "self_attn.v_proj.weight", True))
     layers["wo"].append(take(p + "self_attn.o_proj.weight", True))
     layers["mlp_norm"].append(take(p + "post_attention_layernorm.weight"))
+    if attn_bias:  # Qwen2-family qkv bias (o_proj stays bias-free)
+        layers["bq"].append(take(p + "self_attn.q_proj.bias"))
+        layers["bk"].append(take(p + "self_attn.k_proj.bias"))
+        layers["bv"].append(take(p + "self_attn.v_proj.bias"))
 
 
 def _assemble(take, hf_config, layer_tree) -> Dict[str, Any]:
@@ -155,10 +168,12 @@ def _assemble(take, hf_config, layer_tree) -> Dict[str, Any]:
 def import_hf_llama(
     model_or_path, dtype=jnp.bfloat16, **config_overrides
 ) -> Tuple[Dict[str, Any], LlamaConfig]:
-    """Build ``(params, cfg)`` from a ``transformers`` Llama model.
+    """Build ``(params, cfg)`` from a ``transformers`` Llama-family model.
 
-    ``model_or_path``: a ``LlamaForCausalLM`` instance, or a name/path for
-    ``LlamaForCausalLM.from_pretrained``. Tied word embeddings
+    ``model_or_path``: a ``LlamaForCausalLM``-shaped instance (Llama,
+    Mistral incl. ``sliding_window``, Qwen2 incl. qkv bias — anything
+    with the ``model.layers.N.self_attn/mlp`` state_dict layout), or a
+    name/path for ``from_pretrained``. Tied word embeddings
     (``tie_word_embeddings``) materialize as an explicit ``lm_head``.
     ``config_overrides`` go to :class:`LlamaConfig` (e.g. a shorter
     ``max_seq`` for fine-tuning, ``remat_policy=...``).
@@ -168,17 +183,32 @@ def import_hf_llama(
 
         model_or_path = LlamaForCausalLM.from_pretrained(model_or_path)
     model = model_or_path
+    sd = dict(model.state_dict())
+    # the state_dict is the ground truth on biases: Qwen2's qkv bias is
+    # architectural (its config has no attention_bias attr)
+    has_qkv_bias = "model.layers.0.self_attn.q_proj.bias" in sd
+    if "model.layers.0.self_attn.o_proj.bias" in sd:
+        raise NotImplementedError(
+            "o_proj bias is not mapped (no family member ships one; the "
+            "native out-projection is bias-free)"
+        )
+    if "model.layers.0.mlp.gate_proj.bias" in sd:
+        raise NotImplementedError(
+            "mlp bias is not mapped (the native MLP is bias-free)"
+        )
+    config_overrides.setdefault("attn_bias", has_qkv_bias)
     cfg = config_from_hf(model.config, dtype=dtype, **config_overrides)
     _check_uniform_heads(cfg)
 
-    take = _make_take(dict(model.state_dict()), cfg.dtype)
+    take = _make_take(sd, cfg.dtype)
     layers: Dict[str, Any] = {
         "attn_norm": [], "wq": [], "wk": [], "wv": [], "wo": [],
         "mlp_norm": [], "w_gate": [], "w_up": [], "w_down": [],
+        **({"bq": [], "bk": [], "bv": []} if cfg.attn_bias else {}),
     }
     for i in range(cfg.n_layers):
         p = f"model.layers.{i}."
-        _attn_layer_leaves(take, p, layers)
+        _attn_layer_leaves(take, p, layers, attn_bias=cfg.attn_bias)
         layers["w_gate"].append(take(p + "mlp.gate_proj.weight", True))
         layers["w_up"].append(take(p + "mlp.up_proj.weight", True))
         layers["w_down"].append(take(p + "mlp.down_proj.weight", True))
@@ -227,6 +257,14 @@ def import_hf_mixtral(
     overrides.update(config_overrides)
     cfg = config_from_hf(hf_cfg, dtype=dtype, **overrides)
     _check_uniform_heads(cfg)
+    if cfg.attn_bias:
+        # no Mixtral checkpoint ships qkv biases; accepting the override
+        # here would produce params with no bias leaves while the config
+        # (and param_specs) claim them
+        raise NotImplementedError(
+            "attn_bias is not supported on the Mixtral import (the family "
+            "ships no qkv bias)"
+        )
 
     take = _make_take(dict(model.state_dict()), cfg.dtype)
     layers: Dict[str, Any] = {

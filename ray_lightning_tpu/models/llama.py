@@ -67,6 +67,9 @@ class LlamaConfig:
     # with the 'sp' ring path (refused at forward: the band would have to
     # be re-derived per ring step).
     sliding_window: int = 0
+    # qkv projection bias (Qwen2-family checkpoints); biases shard with
+    # the column-parallel output dim under tp, so they stay local
+    attn_bias: bool = False
     # flash block sizes (0 = env/default). Static ints in the traced step,
     # so a sweep is one process retracing per config — tunnel-friendly.
     flash_block_q: int = 0
@@ -221,6 +224,12 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
         "wo": dense(lk[3], (L, cfg.n_heads * hd, d), cfg.n_heads * hd),
         "mlp_norm": jnp.ones((L, d), dt),
     }
+    if cfg.attn_bias:
+        layers.update(
+            bq=jnp.zeros((L, cfg.n_heads * hd), dt),
+            bk=jnp.zeros((L, cfg.n_kv_heads * hd), dt),
+            bv=jnp.zeros((L, cfg.n_kv_heads * hd), dt),
+        )
     if cfg.n_experts:
         from ray_lightning_tpu.parallel.moe import init_moe_params
 
@@ -257,6 +266,12 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
         "wo": P("pp", "tp", "fsdp"),
         "mlp_norm": P("pp", None),
     }
+    if cfg.attn_bias:
+        # biases follow their projection's column-parallel OUTPUT dim, so
+        # the per-device add needs no collective under tp
+        layer_specs.update(
+            bq=P("pp", "tp"), bk=P("pp", "tp"), bv=P("pp", "tp")
+        )
     if cfg.n_experts:
         from ray_lightning_tpu.parallel.moe import moe_param_specs
 
@@ -357,9 +372,14 @@ def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, attn_fn, reduce_fn=None,
     nh = lp["wq"].shape[-1] // hd  # local heads (== cfg.n_heads unless tp-sharded)
     nkv = lp["wk"].shape[-1] // hd
     h = fin(rmsnorm(x, lp["attn_norm"], cfg.norm_eps))
-    q = (h @ lp["wq"]).reshape(B, S, nh, hd)
-    k = (h @ lp["wk"]).reshape(B, S, nkv, hd)
-    v = (h @ lp["wv"]).reshape(B, S, nkv, hd)
+    q = h @ lp["wq"]
+    k = h @ lp["wk"]
+    v = h @ lp["wv"]
+    if "bq" in lp:  # Qwen2-family qkv bias (local: sharded with out dim)
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(B, S, nh, hd)
+    k = k.reshape(B, S, nkv, hd)
+    v = v.reshape(B, S, nkv, hd)
     q = apply_rope(q, cos, sin).swapaxes(1, 2)  # [B, H, S, hd]
     k = apply_rope(k, cos, sin).swapaxes(1, 2)
     v = v.swapaxes(1, 2)

@@ -331,3 +331,60 @@ def test_zero3_train_step_memory_is_sharded():
     # over ~1/3 means some family (params/grads/adam moments) went
     # replicated again
     assert ratio < 0.30, (stats, ratio)
+
+
+def _scan_lengths(fn, *args):
+    """Static trip counts of every scan in ``fn``'s jaxpr (fori_loop with
+    static bounds lowers to scan) — the schedule-span evidence that needs
+    no wall clock. Traverses jaxpr-valued params including those nested
+    in tuples/lists (e.g. lax.cond's ``branches``)."""
+    out = []
+
+    def visit_param(v):
+        if isinstance(v, (tuple, list)):
+            for item in v:
+                visit_param(item)
+            return
+        inner = getattr(v, "jaxpr", None)
+        if inner is not None:
+            walk(inner)
+        elif hasattr(v, "eqns"):
+            walk(v)
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "scan":
+                out.append(int(eqn.params["length"]))
+            for v in eqn.params.values():
+                visit_param(v)
+
+    walk(jax.make_jaxpr(fn)(*args).jaxpr)
+    return out
+
+
+def test_pipeline_schedule_tick_counts():
+    """The lockstep-SPMD span model behind the interleaved-1F1B rejection
+    (docs/parallelism.md): GPipe traces as TWO M+P-1-tick scans (the
+    forward loop and its autodiff transpose — per-tick cost t_f then
+    t_b); 1F1B as ONE 2P+M-2-tick scan whose body runs both phases
+    (per-tick cost t_f+t_b). Total tick-cost: GPipe (M+P-1)(t_f+t_b) vs
+    1F1B (2P+M-2)(t_f+t_b) — 1F1B pays exactly P-1 extra tick-
+    equivalents; its win is the activation-residency bound, not time."""
+    P = 2
+    mesh = build_mesh(MeshSpec(axes={"pp": P, "dp": 4}))
+    tokens = jnp.zeros((32, LlamaConfig.tiny().max_seq), jnp.int32)
+    for M in (4, 8):
+        spans = {}
+        for schedule in ("gpipe", "1f1b"):
+            cfg = dataclasses.replace(
+                LlamaConfig.tiny(), dtype=jnp.float32, n_layers=2,
+                pp_microbatches=M, pp_schedule=schedule,
+            )
+            params = init_params(jax.random.key(0), cfg)
+            lens = _scan_lengths(
+                jax.grad(lambda p: lm_loss(p, tokens, cfg, mesh)[0]), params
+            )
+            # drop the per-stage layer scans (length n_layers/pp == 1)
+            spans[schedule] = sorted(l for l in lens if l > 1)
+        assert spans["gpipe"] == [M + P - 1, M + P - 1], spans
+        assert spans["1f1b"] == [2 * P + M - 2], spans

@@ -358,6 +358,76 @@ def test_hf_mistral_sliding_window_import_parity():
     assert config_from_hf(qwen_dense).sliding_window == 0
 
 
+@pytest.mark.slow
+def test_hf_qwen2_import_bias_parity():
+    """A Qwen2-family checkpoint (qkv bias + sliding window) imports onto
+    the native family: the state_dict is the ground truth for the bias
+    (Qwen2's config has no attention_bias attr), logits match HF at
+    seq > window, greedy generation is token-identical, and the bias adds
+    stay collective-free under tp (bias sharded with the column-parallel
+    output dim)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from ray_lightning_tpu.models.generation import generate
+    from ray_lightning_tpu.models.hf_import import import_hf_llama
+    from ray_lightning_tpu.models.llama import forward as rlt_forward
+
+    cfg_hf = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-6, rope_theta=10000.0,
+        sliding_window=8, use_sliding_window=True, max_window_layers=0,
+        tie_word_embeddings=False, attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    hf = transformers.Qwen2ForCausalLM(cfg_hf).eval()
+    with torch.no_grad():  # fresh models zero the bias; parity must SEE it
+        for layer in hf.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(0, 0.5)
+    params, cfg = import_hf_llama(hf, dtype=jnp.float32)
+    assert cfg.attn_bias and cfg.sliding_window == 8
+
+    tokens = np.random.default_rng(0).integers(0, 128, (2, 32))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.numpy()
+    ours, _ = rlt_forward(params, jnp.asarray(tokens, jnp.int32), cfg)
+    assert np.max(np.abs(ref - np.asarray(ours, np.float32))) < 1e-4
+
+    prompt = jnp.asarray(tokens[:, :12], jnp.int32)
+    out = generate(params, prompt, cfg, max_new_tokens=8)
+    with torch.no_grad():
+        ref_gen = hf.generate(
+            torch.from_numpy(np.ascontiguousarray(prompt)),
+            max_new_tokens=8, do_sample=False,
+        ).numpy()
+    assert np.array_equal(np.asarray(out), ref_gen)
+
+    # tp-sharded forward matches (the bias shards with the projection's
+    # output dim, so the add needs no collective — test_hlo's tp budget
+    # stays at two all-reduces per layer)
+    mesh = build_mesh(MeshSpec(axes={"tp": 2, "dp": 4}))
+    tok8 = jnp.asarray(
+        np.random.default_rng(1).integers(0, 128, (8, 32)), jnp.int32
+    )
+    dense, _ = rlt_forward(params, tok8, cfg)
+    sharded, _ = rlt_forward(params, tok8, cfg, mesh)
+    assert np.max(np.abs(np.asarray(dense) - np.asarray(sharded))) < 1e-4
+
+    # HF attention_bias=True carries an o_proj bias the native attention
+    # cannot represent — refuse at config time, never silently drop it
+    from ray_lightning_tpu.models.hf_import import config_from_hf
+
+    with pytest.raises(NotImplementedError, match="o_proj"):
+        config_from_hf(transformers.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=4,
+            attention_bias=True,
+        ))
+
+
 def test_hf_mixtral_import_logit_parity(tmp_root):
     """A transformers Mixtral (MoE) checkpoint imports with logit parity
     — its softmax-over-top-k routing is algebraically our
@@ -596,6 +666,7 @@ def test_pp_1f1b_matches_dense_loss_and_grads():
         assert err < 1e-5 + 1e-3 * scale, (name, err)
 
 
+@pytest.mark.slow
 def test_train_pp_1f1b_mesh(tmp_root):
     """Full fit through the Trainer with the 1F1B schedule."""
     import dataclasses
@@ -614,6 +685,7 @@ def test_train_pp_1f1b_mesh(tmp_root):
     assert np.isfinite(float(trainer.callback_metrics["val_loss"]))
 
 
+@pytest.mark.slow
 def test_pp_fsdp_forward_matches_dense():
     """Pipeline x ZeRO-3-in-stage: stage weights sharded over 'fsdp' with
     per-layer all-gather on use must be numerically identical to the plain
@@ -834,6 +906,7 @@ def test_pp_1f1b_fsdp_matches_dense_loss_and_grads():
     ],
     ids=["ep2xtp2", "tp2_no_ep"],
 )
+@pytest.mark.slow
 def test_pp_ep_tp_forward_matches_dense(axes):
     """Pipeline x expert x tensor parallelism: megatron-split expert FFNs
     inside pipeline stages (w_gate/w_up column-, w_down row-sharded over
@@ -899,6 +972,7 @@ def _grad_close(g_ref, g_new, paths, tol=1e-3):
     ],
     ids=["ep2xdp2", "ep2xtp2"],
 )
+@pytest.mark.slow
 def test_pp_1f1b_moe_matches_gpipe(axes):
     """MoE under the 1F1B manual VJP: the expert combine and routing go
     through the megatron f/g custom-VJP pair (moe_ffn_local_experts
@@ -1021,6 +1095,7 @@ def test_pp_1f1b_moe_fsdp_matches_gpipe():
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
 def test_pp_moe_sp_matches_dense(schedule):
     """MoE with in-stage sequence parallelism (pp x ep x sp): routing runs

@@ -93,6 +93,7 @@ def test_ring_flash_grad_matches_reference():
         assert float(jnp.max(jnp.abs(a - b_))) < 1e-4, name
 
 
+@pytest.mark.slow
 def test_ring_flash_zigzag_matches_reference():
     """The load-balanced (zigzag) ring: shards re-laid so every device
     runs equal work per causal step. The layout transform is internal —
