@@ -179,9 +179,13 @@ def import_hf_llama(
     ``max_seq`` for fine-tuning, ``remat_policy=...``).
     """
     if isinstance(model_or_path, str):
-        from transformers import LlamaForCausalLM
+        # Auto, not LlamaForCausalLM: a Qwen2/Mistral checkpoint loaded
+        # through the Llama class coerces the config with only a warning
+        # and DROPS the qkv biases as unexpected keys — the exact silent
+        # divergence this importer refuses everywhere else
+        from transformers import AutoModelForCausalLM
 
-        model_or_path = LlamaForCausalLM.from_pretrained(model_or_path)
+        model_or_path = AutoModelForCausalLM.from_pretrained(model_or_path)
     model = model_or_path
     sd = dict(model.state_dict())
     # the state_dict is the ground truth on biases: Qwen2's qkv bias is
