@@ -2,9 +2,12 @@
 prefill + preallocated KV cache + fully compiled decode loop.
 
 TPU-first design:
-- the cache is STATIC-shaped ([L, B, Hkv, max_len, D]) and updated with
+- the cache is STATIC-shaped ([L, B, Hkv, C, D]) and updated with
   ``lax.dynamic_update_slice`` — no reallocation, no dynamic shapes, one
-  compile for the whole generation;
+  compile for the whole generation. Sliding-window configs get a ROLLING
+  buffer (C = window, slot = pos % C — the Mistral rolling-buffer
+  design): decode memory is O(window) regardless of generation length,
+  and the band mask is implied by the buffer itself;
 - the prompt is consumed in ONE batched forward pass (``prefill``) that
   reuses the training layer math (models/llama.py::_decoder_layer with
   ``return_kv=True``) — MXU-shaped [B, P, D] matmuls instead of P
@@ -48,8 +51,15 @@ from ray_lightning_tpu.ops.rope import rope_angles
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict[str, jnp.ndarray]:
-    """Preallocated cache: k/v of shape [L, B, Hkv, max_len, head_dim]."""
-    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+    """Preallocated cache: k/v of shape [L, B, Hkv, C, head_dim], where
+    C = min(max_len, sliding_window) — a sliding-window config never
+    needs more than the last W positions resident, so the cache ROLLS
+    (slot = pos % C) and decode memory is O(W) regardless of generation
+    length (the Mistral rolling-buffer design, natively)."""
+    length = (
+        min(max_len, cfg.sliding_window) if cfg.sliding_window else max_len
+    )
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, length, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -88,9 +98,10 @@ def prefill(
     """
     B, P = prompt.shape
     hd = cfg.head_dim
-    max_len = cache["k"].shape[3]
     if rope_table is None:
-        rope_table = rope_angles(max_len, hd, cfg.rope_theta,
+        # sized to the PROMPT, not the cache: a rolling window buffer is
+        # shorter than the prompt positions it receives
+        rope_table = rope_angles(P, hd, cfg.rope_theta,
                                  scaling=cfg.rope_scaling)
     cos, sin = rope_table[0][:P], rope_table[1][:P]
     x = params["embed"][prompt]  # [B, P, D]
@@ -121,12 +132,27 @@ def prefill(
         return x, kv
 
     x, (ks, vs) = jax.lax.scan(layer_fn, x, params["layers"])
-    # ks/vs: [L, B, Hkv, P, hd] -> cache[:, :, :, :P]
-    zeros_idx = (0, 0, 0, 0, 0)
-    cache = {
-        "k": jax.lax.dynamic_update_slice(cache["k"], ks.astype(cache["k"].dtype), zeros_idx),
-        "v": jax.lax.dynamic_update_slice(cache["v"], vs.astype(cache["v"].dtype), zeros_idx),
-    }
+    # ks/vs: [L, B, Hkv, P, hd]. C >= P: slots [0, P) (pos % C == pos).
+    # C < P (rolling window cache, prompt longer than the window): only
+    # the last C positions can ever be attended again — scatter them to
+    # their slots pos % C. P and C are static, so the branch is static.
+    C = cache["k"].shape[3]
+    if P <= C:
+        zeros_idx = (0, 0, 0, 0, 0)
+        cache = {
+            "k": jax.lax.dynamic_update_slice(
+                cache["k"], ks.astype(cache["k"].dtype), zeros_idx),
+            "v": jax.lax.dynamic_update_slice(
+                cache["v"], vs.astype(cache["v"].dtype), zeros_idx),
+        }
+    else:
+        slots = jnp.arange(P - C, P) % C
+        cache = {
+            "k": cache["k"].at[:, :, :, slots, :].set(
+                ks[:, :, :, P - C:, :].astype(cache["k"].dtype)),
+            "v": cache["v"].at[:, :, :, slots, :].set(
+                vs[:, :, :, P - C:, :].astype(cache["v"].dtype)),
+        }
     h = rmsnorm(x[:, -1], params["final_norm"], cfg.norm_eps)
     logits = h @ params["lm_head"]
     return logits.astype(jnp.float32), cache
@@ -146,25 +172,35 @@ def decode_step(
     The layer stack is a ``lax.scan`` over the stacked params with the
     per-layer cache slices as a second scanned input, mirroring the
     training forward's structure (models/llama.py::forward).
-    ``rope_table``: precomputed (cos, sin) for the cache length — pass it
-    when stepping in a loop so the tables are built once, not per step.
+    ``rope_table``: precomputed (cos, sin) covering the model's position
+    range (>= the largest ``pos`` you will step — NOT the cache length,
+    which under a rolling window buffer is shorter than the positions it
+    serves) — pass it when stepping in a loop so the tables are built
+    once, not per step.
     """
     hd = cfg.head_dim
-    max_len = cache["k"].shape[3]
+    C = cache["k"].shape[3]  # may be a ROLLING window buffer (< total)
     if rope_table is None:
-        rope_table = rope_angles(max_len, hd, cfg.rope_theta,
+        # sized to the model's position limit, NOT the cache: a rolling
+        # buffer is shorter than the positions it serves, and a too-short
+        # table would make _rope_at clamp to the last row silently
+        rope_table = rope_angles(max(C, cfg.max_seq), hd, cfg.rope_theta,
                                  scaling=cfg.rope_scaling)
     c, s = _rope_at(rope_table, pos)
     x = params["embed"][token]  # [B, D]
 
-    # causal-by-position mask over the static cache length; under a
-    # sliding window only the last W cache slots stay visible (matches
-    # the training band: i attends [i-W+1, i])
-    positions = jnp.arange(max_len)
+    # cache slot for this position: pos % C — the identity when the
+    # cache covers every position, the rolling slot when C == window
+    slot = pos % C
+    # validity over the C slots: slot s is filled once s <= pos (after
+    # the first wrap every slot is, since pos >= C); a rolling buffer
+    # (C <= window) holds exactly the band by construction, while a
+    # full-length cache with a window still needs the band mask
+    positions = jnp.arange(C)
     keep = positions <= pos
-    if cfg.sliding_window:
+    if cfg.sliding_window and C > cfg.sliding_window:
         keep &= positions > pos - cfg.sliding_window
-    valid = keep[None, None, :]  # [1, 1, max_len]
+    valid = keep[None, None, :]  # [1, 1, C]
 
     def layer_fn(x, inputs):
         lp, k_cache, v_cache = inputs  # k/v: [B, Hkv, max_len, hd]
@@ -184,10 +220,10 @@ def decode_step(
         q = _apply_rope_one(q, c, s)
         k = _apply_rope_one(k, c, s)
         k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k[:, :, None, :].astype(k_cache.dtype), (0, 0, pos, 0)
+            k_cache, k[:, :, None, :].astype(k_cache.dtype), (0, 0, slot, 0)
         )
         v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v[:, :, None, :].astype(v_cache.dtype), (0, 0, pos, 0)
+            v_cache, v[:, :, None, :].astype(v_cache.dtype), (0, 0, slot, 0)
         )
         # GQA: fold q heads to [B, Hkv, G, hd]; attend over the cache
         qf = q.reshape(B, nkv, group, hd).astype(jnp.float32)

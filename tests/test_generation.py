@@ -215,3 +215,29 @@ def test_prefill_matches_stepwise_cache(preset):
         err = float(jnp.max(jnp.abs(cache_b[name] - cache_s[name])))
         assert err < 1e-4, (name, err)
     assert float(jnp.max(jnp.abs(logits_b - logits_s))) < 1e-3
+
+
+def test_rolling_window_cache_matches_full_forward():
+    """Sliding-window configs decode through a ROLLING buffer of length W
+    (slot = pos % W): cache memory is O(W) regardless of generation
+    length, and greedy tokens match the banded training forward's argmax
+    at every position — across prompts shorter AND longer than the
+    window (the prefill scatter path)."""
+    cfg = dataclasses.replace(_cfg(), sliding_window=8)
+    params = init_params(jax.random.key(1), cfg)
+    rng = np.random.default_rng(3)
+
+    # the cache is bounded by the window, not the generation length
+    cache = init_kv_cache(cfg, 2, 64)
+    assert cache["k"].shape[3] == 8
+
+    for P, n_new in ((4, 20), (12, 10), (32, 8)):
+        prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, P)), jnp.int32)
+        out = np.asarray(generate(params, prompt, cfg, max_new_tokens=n_new))
+        # reference: iterated banded full forward (no cache at all)
+        seq = np.asarray(prompt)
+        for _ in range(n_new):
+            logits, _ = forward(params, jnp.asarray(seq, jnp.int32), cfg)
+            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+            seq = np.concatenate([seq, nxt[:, None].astype(seq.dtype)], axis=1)
+        assert np.array_equal(out, seq), (P, n_new)

@@ -75,24 +75,12 @@ def test_flash_backward_mosaic_lowering(tpu_backend):
     q = jax.random.normal(kq, (b, h, s, d), jnp.float32)
     k = jax.random.normal(kk, (b, h, s, d), jnp.float32)
     v = jax.random.normal(kv, (b, h, s, d), jnp.float32)
-
-    def loss(fn):
-        return lambda q, k, v: (fn(q, k, v).astype(jnp.float32) ** 2).sum()
-
-    g_flash = jax.jit(jax.grad(
-        loss(lambda q, k, v: attention(q, k, v, causal=True, impl="flash",
-                                       interpret=False)),
-        argnums=(0, 1, 2),
-    ))(q, k, v)
-    g_ref = jax.grad(
-        loss(lambda q, k, v: reference_attention(q, k, v, causal=True)),
-        argnums=(0, 1, 2),
-    )(q, k, v)
-    for name, a, b_ in zip("qkv", g_ref, g_flash):
-        rel = float(
-            jnp.max(jnp.abs(a - b_)) / (jnp.max(jnp.abs(a)) + 1e-6)
-        )
-        assert rel < 2e-2, (name, rel)
+    _assert_grads_match(
+        lambda q, k, v: attention(q, k, v, causal=True, impl="flash",
+                                  interpret=False),
+        lambda q, k, v: reference_attention(q, k, v, causal=True),
+        q, k, v,
+    )
 
 
 def _assert_grads_match(attn_fn, ref_fn, q, k, v, tol=2e-2):
