@@ -18,7 +18,8 @@ TPU-first design:
 - attention at decode is a masked matvec over the cache (memory-bound;
   the MXU flash kernel buys nothing at q-length 1, so the plain einsum is
   the right kernel here), GQA folded the same way as training;
-- rope tables are precomputed ONCE for ``max_len`` in ``generate`` and
+- rope tables are precomputed ONCE for the full generation length in
+  ``generate`` and
   passed into every step (loop-invariant by construction, not by hoping
   XLA hoists them);
 - MoE configs route LOSSLESSLY throughout generation
@@ -145,7 +146,9 @@ def prefill(
             "v": jax.lax.dynamic_update_slice(
                 cache["v"], vs.astype(cache["v"].dtype), zeros_idx),
         }
-    else:
+    elif cfg.sliding_window and C >= cfg.sliding_window:
+        # dropping all but the last C positions is only sound when the
+        # band guarantees they can never be attended again
         slots = jnp.arange(P - C, P) % C
         cache = {
             "k": cache["k"].at[:, :, :, slots, :].set(
@@ -153,6 +156,12 @@ def prefill(
             "v": cache["v"].at[:, :, :, slots, :].set(
                 vs[:, :, :, P - C:, :].astype(cache["v"].dtype)),
         }
+    else:
+        raise ValueError(
+            f"cache length {C} < prompt length {P}: an undersized cache "
+            "silently loses attendable context (rolling is only valid "
+            "for sliding-window configs with cache length >= the window)"
+        )
     h = rmsnorm(x[:, -1], params["final_norm"], cfg.norm_eps)
     logits = h @ params["lm_head"]
     return logits.astype(jnp.float32), cache
@@ -203,7 +212,7 @@ def decode_step(
     valid = keep[None, None, :]  # [1, 1, C]
 
     def layer_fn(x, inputs):
-        lp, k_cache, v_cache = inputs  # k/v: [B, Hkv, max_len, hd]
+        lp, k_cache, v_cache = inputs  # k/v: [B, Hkv, C, hd]
         B = x.shape[0]
         nh = lp["wq"].shape[-1] // hd
         nkv = lp["wk"].shape[-1] // hd

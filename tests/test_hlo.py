@@ -388,3 +388,40 @@ def test_pipeline_schedule_tick_counts():
             spans[schedule] = sorted(l for l in lens if l > 1)
         assert spans["gpipe"] == [M + P - 1, M + P - 1], spans
         assert spans["1f1b"] == [2 * P + M - 2], spans
+
+
+@pytest.mark.slow
+def test_moe_expert_weights_never_cross_devices():
+    """Under 'ep' the expert-stacked weights are the thing sharded; the
+    whole point is that TOKENS (dispatch/combine activations, gate
+    tensors) move between devices while expert weights stay put. No
+    collective may materialize a full expert-stacked weight leaf (or its
+    gradient) — that would be the all-experts-resident anti-pattern that
+    caps n_experts at single-chip HBM."""
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny_moe(), dtype=jnp.float32, n_layers=2
+    )
+    mesh = build_mesh(MeshSpec(axes={"ep": 4, "dp": 2}))
+    params = jax.tree_util.tree_map(
+        jax.device_put,
+        init_params(jax.random.key(0), cfg),
+        shardings_for_mesh(cfg, mesh),
+    )
+    tokens = jnp.zeros((8, cfg.max_seq), jnp.int32)
+    txt = compiled_text(
+        jax.grad(lambda p: lm_loss(p, tokens, cfg, mesh)[0]), params
+    )
+
+    expert_shapes = set()
+    for leaf in jax.tree_util.tree_leaves(params["layers"]["moe"]):
+        shape = tuple(leaf.shape)
+        if cfg.n_experts in shape and len(shape) >= 3:
+            expert_shapes.add(shape)        # stacked [L, E, ...]
+            expert_shapes.add(shape[1:])    # per-layer [E, ...]
+    assert expert_shapes, "no expert-stacked leaves found"
+
+    for op in COLLECTIVES:
+        for s in result_shapes(txt, op):
+            assert dims(s) not in expert_shapes, (
+                f"{op} materialized a full expert stack: {s}"
+            )
