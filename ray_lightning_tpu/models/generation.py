@@ -48,7 +48,26 @@ import jax.numpy as jnp
 from ray_lightning_tpu.models.llama import LlamaConfig, _decoder_layer
 from ray_lightning_tpu.ops.attention import attention, flash_supported
 from ray_lightning_tpu.ops.rmsnorm import rmsnorm
-from ray_lightning_tpu.ops.rope import rope_angles
+from ray_lightning_tpu.ops.rope import rope_angles, rope_scaling_kind
+
+
+def _default_table_or_raise(cfg: LlamaConfig, seq_len: int):
+    """Default rope table for a caller that passed ``rope_table=None``.
+    longrope refuses: its long/short factor choice keys on the FULL
+    generation length, so prefill and decode defaults built from
+    different lengths could rotate Q and cached K with different factor
+    sets — pass one shared table (``generate`` builds it from
+    prompt + new tokens)."""
+    if rope_scaling_kind(cfg.rope_scaling) == "longrope":
+        raise ValueError(
+            "longrope configs need an explicit rope_table covering the "
+            "full generation length (rope_angles(total, ...)): the "
+            "long/short factor choice is length-dependent, and prefill/"
+            "decode defaults built from different lengths would rotate "
+            "queries and cached keys inconsistently"
+        )
+    return rope_angles(seq_len, cfg.head_dim, cfg.rope_theta,
+                       scaling=cfg.rope_scaling)
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict[str, jnp.ndarray]:
@@ -102,8 +121,7 @@ def prefill(
     if rope_table is None:
         # sized to the PROMPT, not the cache: a rolling window buffer is
         # shorter than the prompt positions it receives
-        rope_table = rope_angles(P, hd, cfg.rope_theta,
-                                 scaling=cfg.rope_scaling)
+        rope_table = _default_table_or_raise(cfg, P)
     cos, sin = rope_table[0][:P], rope_table[1][:P]
     x = params["embed"][prompt]  # [B, P, D]
 
@@ -193,8 +211,7 @@ def decode_step(
         # sized to the model's position limit, NOT the cache: a rolling
         # buffer is shorter than the positions it serves, and a too-short
         # table would make _rope_at clamp to the last row silently
-        rope_table = rope_angles(max(C, cfg.max_seq), hd, cfg.rope_theta,
-                                 scaling=cfg.rope_scaling)
+        rope_table = _default_table_or_raise(cfg, max(C, cfg.max_seq))
     c, s = _rope_at(rope_table, pos)
     x = params["embed"][token]  # [B, D]
 

@@ -44,7 +44,31 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16, **overrides) -> LlamaConfig:
             raw_scaling["original_max_position_embeddings"] = int(
                 hf_config.max_position_embeddings
             )
+        if kind == "longrope":
+            # Phi-3 semantics (transformers' _compute_longrope_parameters):
+            # the pretrain length lives on the CONFIG
+            # (original_max_position_embeddings); when present, the
+            # attention-factor ratio is max_position / original,
+            # overriding any 'factor' in the scaling dict. Absent, HF
+            # treats max_position as the pretrain length (short factors
+            # always) with the dict's own factor.
+            attr_orig = getattr(
+                hf_config, "original_max_position_embeddings", None
+            )
+            orig = attr_orig or hf_config.max_position_embeddings
+            raw_scaling["original_max_position_embeddings"] = int(orig)
+            if attr_orig:
+                raw_scaling["factor"] = (
+                    float(hf_config.max_position_embeddings) / int(orig)
+                )
     scaling = normalize_rope_scaling(raw_scaling)
+    if float(getattr(hf_config, "partial_rotary_factor", 1.0) or 1.0) != 1.0:
+        # e.g. Phi-4-mini (0.75): the native rope rotates the full head
+        # dim; importing anyway would silently diverge
+        raise NotImplementedError(
+            "partial_rotary_factor != 1.0 is not mapped (the native rope "
+            "rotates the whole head dim)"
+        )
     if getattr(hf_config, "mlp_bias", False):
         raise NotImplementedError(
             "mlp_bias checkpoints are not mapped (the native MLP is "
@@ -299,3 +323,58 @@ def import_hf_mixtral(
     layer_tree = {k: jnp.stack(v) for k, v in layers.items()}
     layer_tree["moe"] = {k: jnp.stack(v) for k, v in moe.items()}
     return _assemble(take, hf_cfg, layer_tree), cfg
+
+
+def import_hf_phi3(
+    model_or_path, dtype=jnp.bfloat16, **config_overrides
+) -> Tuple[Dict[str, Any], LlamaConfig]:
+    """Build ``(params, cfg)`` from a ``transformers`` Phi-3 model.
+
+    Architecturally a Llama-family member (rmsnorm, SwiGLU, GQA, no
+    biases) with two deltas: the qkv and gate/up projections ship FUSED
+    (``self_attn.qkv_proj``, ``mlp.gate_up_proj`` — split here along the
+    torch OUT dim into the native separate leaves) and position scaling
+    is 'longrope' (per-frequency long/short factor lists keyed on the
+    pretrain context, ops/rope.py::_longrope_scale).
+
+    Factor-regime note: each jit program picks long/short factors from
+    its STATIC length (forward: the sequence; generate: prompt + new
+    tokens). transformers switches factor sets mid-generation when the
+    live length crosses the pretrain context — a generation whose length
+    straddles the boundary will differ from HF at the crossing (HF's
+    switch rewrites rope for the whole cache mid-stream; ours is
+    consistent for the whole program)."""
+    if isinstance(model_or_path, str):
+        from transformers import AutoModelForCausalLM
+
+        model_or_path = AutoModelForCausalLM.from_pretrained(model_or_path)
+    model = model_or_path
+    cfg = config_from_hf(model.config, dtype=dtype, **config_overrides)
+    _check_uniform_heads(cfg)
+
+    take = _make_take(dict(model.state_dict()), cfg.dtype)
+    hd = cfg.head_dim
+    q_rows = cfg.n_heads * hd
+    kv_rows = cfg.n_kv_heads * hd
+    layers: Dict[str, Any] = {
+        "attn_norm": [], "wq": [], "wk": [], "wv": [], "wo": [],
+        "mlp_norm": [], "w_gate": [], "w_up": [], "w_down": [],
+    }
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        layers["attn_norm"].append(take(p + "input_layernorm.weight"))
+        # fused [q_rows + 2*kv_rows, D] torch layout; transpose AFTER the
+        # row split so each piece lands [in, out] like the native leaves
+        qkv = take(p + "self_attn.qkv_proj.weight")  # [out, in]
+        layers["wq"].append(qkv[:q_rows].T)
+        layers["wk"].append(qkv[q_rows:q_rows + kv_rows].T)
+        layers["wv"].append(qkv[q_rows + kv_rows:].T)
+        layers["wo"].append(take(p + "self_attn.o_proj.weight", True))
+        layers["mlp_norm"].append(take(p + "post_attention_layernorm.weight"))
+        gate_up = take(p + "mlp.gate_up_proj.weight")  # [2F, D]
+        layers["w_gate"].append(gate_up[:cfg.ffn_dim].T)
+        layers["w_up"].append(gate_up[cfg.ffn_dim:].T)
+        layers["w_down"].append(take(p + "mlp.down_proj.weight", True))
+
+    layer_tree = {k: jnp.stack(v) for k, v in layers.items()}
+    return _assemble(take, model.config, layer_tree), cfg

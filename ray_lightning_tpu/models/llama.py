@@ -113,15 +113,16 @@ class LlamaConfig:
                 f"sliding_window={self.sliding_window}: must be >= 0 "
                 "(0 = dense causal)"
             )
-        if self.rope_scaling is not None and not isinstance(
-            self.rope_scaling, tuple
-        ):
+        if self.rope_scaling is not None:
             # dict/list input -> hashable canonical form (frozen dataclass
-            # hashing must keep working; from_dict round-trips lists)
-            object.__setattr__(
-                self, "rope_scaling",
-                tuple(sorted(dict(self.rope_scaling).items())),
+            # hashing must keep working; from_dict round-trips lists).
+            # VALUES that are lists (longrope's long/short factor arrays)
+            # canonicalize to tuples for the same reason.
+            items = tuple(
+                (k, tuple(v) if isinstance(v, list) else v)
+                for k, v in sorted(dict(self.rope_scaling).items())
             )
+            object.__setattr__(self, "rope_scaling", items)
 
     @property
     def head_dim(self) -> int:

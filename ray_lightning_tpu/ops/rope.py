@@ -88,6 +88,40 @@ def _yarn_scale(inv_freq: jnp.ndarray, scaling: dict, head_dim: int,
     return blended, float(attention_factor)
 
 
+def _longrope_scale(scaling: dict, head_dim: int, theta: float,
+                    seq_len: int):
+    """LongRoPE ('rope_type': 'longrope', the Phi-3 family,
+    arXiv:2402.13753): per-frequency rescale factors — ``short_factor``
+    within the pretrain context, ``long_factor`` beyond it — plus a
+    cos/sin magnitude correction. Matches transformers'
+    ``_compute_longrope_parameters``; the long/short choice keys on the
+    STATIC table length (transformers re-derives it per forward from the
+    live sequence length — identical for any fixed-length program).
+
+    Returns (inv_freq, attention_factor)."""
+    orig = int(scaling["original_max_position_embeddings"])
+    ext = scaling["long_factor"] if seq_len > orig else scaling["short_factor"]
+    ext = jnp.asarray(ext, jnp.float32)
+    if ext.shape != (head_dim // 2,):
+        raise ValueError(
+            f"longrope factor lists must have head_dim/2 = {head_dim // 2} "
+            f"entries, got {ext.shape}"
+        )
+    factor = float(scaling.get("factor") or 1.0)
+    attention_factor = scaling.get("attention_factor")
+    if attention_factor is None:
+        attention_factor = (
+            1.0 if factor <= 1.0
+            else math.sqrt(1.0 + math.log(factor) / math.log(orig))
+        )
+    inv_freq = 1.0 / (
+        ext * theta ** (
+            jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+        )
+    )
+    return inv_freq, float(attention_factor)
+
+
 def normalize_rope_scaling(scaling) -> Optional[dict]:
     """The ONE validation point for HF-style ``rope_scaling``: accepts a
     dict or a (key, value)-pair tuple (LlamaConfig's hashable storage),
@@ -100,20 +134,48 @@ def normalize_rope_scaling(scaling) -> Optional[dict]:
     kind = d.get("rope_type", d.get("type", "default"))
     if kind == "default":
         return None
-    if kind not in ("llama3", "linear", "yarn"):
+    if kind not in ("llama3", "linear", "yarn", "longrope"):
         raise NotImplementedError(
-            f"rope_scaling type {kind!r}; 'llama3'/'linear'/'yarn' are mapped"
+            f"rope_scaling type {kind!r}; 'llama3'/'linear'/'yarn'/"
+            "'longrope' are mapped"
         )
-    if kind == "yarn" and not d.get("original_max_position_embeddings"):
-        # yarn's correction range needs the PRETRAIN context length; HF
-        # configs that omit it mean max_position_embeddings (hf_import
-        # injects that) — a hand-built config must say it explicitly
+    if kind in ("yarn", "longrope") and not d.get(
+        "original_max_position_embeddings"
+    ):
+        # both families key on the PRETRAIN context length (yarn's
+        # correction range; longrope's long/short switch). HF configs
+        # that omit it mean max_position_embeddings / the config-level
+        # original_max attr (hf_import injects those) — a hand-built
+        # config must say it explicitly
         raise ValueError(
-            "yarn rope_scaling requires 'original_max_position_embeddings' "
-            "(the pretrain context length the correction range is "
-            "computed against)"
+            f"{kind} rope_scaling requires "
+            "'original_max_position_embeddings' (the pretrain context "
+            "length)"
+        )
+    if kind == "longrope" and not (
+        d.get("long_factor") and d.get("short_factor")
+    ):
+        raise ValueError(
+            "longrope rope_scaling requires 'long_factor' and "
+            "'short_factor' (per-frequency rescale lists)"
+        )
+    if kind == "longrope" and not d.get("factor"):
+        # the cos/sin magnitude correction derives from this ratio;
+        # defaulting it to 1.0 would silently drop the correction HF
+        # applies (~1.19 for a 4k->128k Phi-3)
+        raise ValueError(
+            "longrope rope_scaling requires 'factor' — the context "
+            "extension ratio max_position_embeddings / "
+            "original_max_position_embeddings (hf_import injects it; "
+            "hand-built configs must state it)"
         )
     return d
+
+
+def rope_scaling_kind(scaling) -> Optional[str]:
+    """The validated rope_scaling type name, or None for default/absent."""
+    d = normalize_rope_scaling(scaling)
+    return d.get("rope_type", d.get("type")) if d else None
 
 
 def rope_angles(seq_len: int, head_dim: int, theta: float = 500000.0,
@@ -121,9 +183,11 @@ def rope_angles(seq_len: int, head_dim: int, theta: float = 500000.0,
     """Return (cos, sin) tables of shape [seq_len, head_dim//2].
 
     ``scaling``: an optional HF-style ``rope_scaling`` dict (or pair
-    tuple); 'llama3' (Llama-3.1+), 'linear', and 'yarn' (Qwen2/DeepSeek
-    long-context; its cos/sin magnitude correction is baked into the
-    returned tables) types are supported."""
+    tuple); 'llama3' (Llama-3.1+), 'linear', 'yarn' (Qwen2/DeepSeek
+    long-context), and 'longrope' (Phi-3 family; picks long/short
+    factors by ``offset + seq_len`` vs the pretrain context) types are
+    supported — yarn's and longrope's cos/sin magnitude correction is
+    baked into the returned tables."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
@@ -137,12 +201,16 @@ def rope_angles(seq_len: int, head_dim: int, theta: float = 500000.0,
             inv_freq, attention_factor = _yarn_scale(
                 inv_freq, scaling, head_dim, theta
             )
+        elif kind == "longrope":
+            inv_freq, attention_factor = _longrope_scale(
+                scaling, head_dim, theta, offset + seq_len
+            )
         else:  # "linear" (normalize_rope_scaling admits no other kind)
             inv_freq = inv_freq / float(scaling["factor"])
     positions = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
     angles = positions[:, None] * inv_freq[None, :]
-    # yarn's magnitude correction rides the tables (both q and k pick it
-    # up, matching transformers' cos/sin * attention_scaling)
+    # yarn's/longrope's magnitude correction rides the tables (both q and
+    # k pick it up, matching transformers' cos/sin * attention_scaling)
     return (
         jnp.cos(angles) * attention_factor,
         jnp.sin(angles) * attention_factor,

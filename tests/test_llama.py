@@ -265,10 +265,9 @@ def test_hf_llama_import_logit_parity(tmp_root):
     hf_cfg_unknown = transformers.LlamaConfig(
         vocab_size=64, hidden_size=32, intermediate_size=64,
         num_hidden_layers=1, num_attention_heads=4,
-        rope_scaling={"rope_type": "longrope", "factor": 4.0,
-                      "long_factor": [1.0] * 4, "short_factor": [1.0] * 4},
+        rope_scaling={"rope_type": "dynamic", "factor": 4.0},
     )
-    with pytest.raises(NotImplementedError, match="longrope"):
+    with pytest.raises(NotImplementedError, match="dynamic"):
         import_hf_llama(transformers.LlamaForCausalLM(hf_cfg_unknown))
 
     # the imported weights fine-tune through the real Trainer on a mesh
@@ -426,6 +425,58 @@ def test_hf_qwen2_import_bias_parity():
             num_hidden_layers=1, num_attention_heads=4,
             attention_bias=True,
         ))
+
+
+def test_hf_phi3_import_longrope_parity():
+    """A Phi-3-family checkpoint (fused qkv/gate_up projections, longrope
+    scaling) imports with logit parity in BOTH factor regimes — short
+    factors within the pretrain context, long factors beyond it — and
+    token-identical greedy generation."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from ray_lightning_tpu.models.generation import generate
+    from ray_lightning_tpu.models.hf_import import import_hf_phi3
+    from ray_lightning_tpu.models.llama import forward as rlt_forward
+
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, original_max_position_embeddings=32,
+        rope_theta=10000.0, pad_token_id=0, bos_token_id=1, eos_token_id=2,
+        tie_word_embeddings=False, attention_dropout=0.0,
+        resid_pdrop=0.0, embd_pdrop=0.0,
+        rope_scaling={
+            "type": "longrope",
+            "long_factor": [2.0, 2.5, 3.0, 3.5, 4.0, 4.5, 5.0, 5.5],
+            "short_factor": [1.0, 1.05, 1.1, 1.15, 1.2, 1.25, 1.3, 1.35],
+        },
+    )
+    torch.manual_seed(0)
+    hf = transformers.Phi3ForCausalLM(hf_cfg).eval()
+    params, cfg = import_hf_phi3(hf, dtype=jnp.float32)
+    # the fused qkv/gate_up split produced the separate native leaves
+    assert params["layers"]["wq"].shape == (2, 64, 64)
+    assert params["layers"]["wk"].shape == (2, 64, 32)
+    assert params["layers"]["w_gate"].shape == (2, 64, 128)
+
+    for S in (16, 48):  # within / beyond original_max (short/long factors)
+        tokens = np.random.default_rng(S).integers(0, 128, (2, S))
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(tokens)).logits.numpy()
+        ours, _ = rlt_forward(params, jnp.asarray(tokens, jnp.int32), cfg)
+        assert np.max(np.abs(ref - np.asarray(ours, np.float32))) < 1e-3, S
+
+    prompt = jnp.asarray(
+        np.random.default_rng(7).integers(0, 128, (2, 8)), jnp.int32
+    )
+    out = generate(params, prompt, cfg, max_new_tokens=6)
+    with torch.no_grad():
+        ref_gen = hf.generate(
+            torch.from_numpy(np.ascontiguousarray(prompt)),
+            max_new_tokens=6, do_sample=False,
+        ).numpy()
+    assert np.array_equal(np.asarray(out), ref_gen)
 
 
 def test_hf_mixtral_import_logit_parity(tmp_root):

@@ -256,6 +256,51 @@ def test_yarn_requires_original_max_positions():
 
     with pytest.raises(ValueError, match="original_max_position"):
         normalize_rope_scaling({"rope_type": "yarn", "factor": 4.0})
+    with pytest.raises(ValueError, match="original_max_position"):
+        normalize_rope_scaling({"rope_type": "longrope",
+                                "long_factor": [1.0], "short_factor": [1.0]})
+    with pytest.raises(ValueError, match="long_factor"):
+        normalize_rope_scaling({"rope_type": "longrope",
+                                "original_max_position_embeddings": 64})
+
+
+def test_longrope_matches_transformers():
+    """longrope inv_freq and the inferred attention factor match
+    transformers' _compute_longrope_parameters in both regimes (seq_len
+    under/over the pretrain context selects short/long factors)."""
+    pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    import numpy as np
+    from types import SimpleNamespace
+
+    from transformers.modeling_rope_utils import _compute_longrope_parameters
+
+    from ray_lightning_tpu.ops.rope import _longrope_scale, rope_angles
+
+    head_dim, theta, orig = 16, 10000.0, 64
+    long_f = [2.0 + 0.5 * i for i in range(head_dim // 2)]
+    short_f = [1.0 + 0.05 * i for i in range(head_dim // 2)]
+    cfg = SimpleNamespace(
+        rope_theta=theta, hidden_size=head_dim * 4, num_attention_heads=4,
+        head_dim=head_dim, max_position_embeddings=256,
+        original_max_position_embeddings=orig,
+        rope_scaling={"rope_type": "longrope", "long_factor": long_f,
+                      "short_factor": short_f},
+    )
+    scaling = {"rope_type": "longrope", "long_factor": long_f,
+               "short_factor": short_f,
+               "original_max_position_embeddings": orig,
+               "factor": 256 / orig}  # hf_import injects max/orig
+    for seq_len in (32, 128):
+        ref_inv, ref_att = _compute_longrope_parameters(
+            cfg, device="cpu", seq_len=seq_len
+        )
+        ours_inv, ours_att = _longrope_scale(scaling, head_dim, theta, seq_len)
+        assert np.allclose(ref_inv.numpy(), np.asarray(ours_inv),
+                           rtol=1e-6), seq_len
+        assert abs(ref_att - ours_att) < 1e-6, seq_len
+        cos, _ = rope_angles(seq_len, head_dim, theta, scaling=scaling)
+        assert abs(float(cos[0, 0]) - ours_att) < 1e-6  # factor on tables
 
 
 def test_flash_multiblock_grid(monkeypatch):
