@@ -23,7 +23,12 @@ regression:
   one);
 - tensor parallelism is megatron-shaped: exactly two activation
   all-reduces per layer body (post-attention, post-MLP), both inside the
-  layer scan.
+  layer scan;
+- the pipeline schedules trace to their exact tick counts (GPipe: two
+  M+P-1-tick scans; 1F1B: one 2P+M-2-tick scan) — the span model behind
+  the interleaved-1F1B rejection in docs/parallelism.md;
+- expert parallelism moves TOKENS, not weights: no collective in the MoE
+  step materializes a full expert-stacked leaf.
 
 Reference frame: the reference has no compiled-graph assertions at all
 (its CI asserts behavior only, e.g. tests/test_ddp.py); this tier is the
