@@ -57,6 +57,21 @@ import sys
 import time
 
 
+def _env_demands_cpu(value) -> bool:
+    """True when a JAX_PLATFORMS value pins the process to CPU. The env var
+    is a comma-separated priority list and case-insensitive ('cpu,host',
+    'CPU'); an exact-string comparison against 'cpu' would let those pins
+    slip through to the TPU path (ADVICE r5)."""
+    return any(p.strip().lower() == "cpu" for p in (value or "").split(","))
+
+
+def _env_timeout(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 def _probe() -> int:
     """Child: touch the native backend; print its platform if alive."""
     import jax
@@ -170,7 +185,7 @@ def _child(args: argparse.Namespace) -> int:
     """Child: run the measurement and print one JSON line."""
     import jax
 
-    if args.platform == "cpu" or os.environ.get("JAX_PLATFORMS") == "cpu":
+    if args.platform == "cpu" or _env_demands_cpu(os.environ.get("JAX_PLATFORMS")):
         # the image's sitecustomize prepends its TPU plugin to jax_platforms
         # regardless of env; only a config-level pin keeps us off the backend
         jax.config.update("jax_platforms", "cpu")
@@ -336,6 +351,190 @@ def _child(args: argparse.Namespace) -> int:
         result["detail"]["remat_sweep"] = remat_note
     print(json.dumps(result))
     return 0
+
+
+def _dcn_sweep(args: argparse.Namespace) -> int:
+    """Child: the compressed-DCN-collectives sweep (--_dcn_sweep).
+
+    Measures tokens/s of a tiny-LM train step with the standard implicit
+    full-precision all-reduce vs the explicit shard_map int8 two-phase
+    reduction (parallel/compression.py) on a {dp: N} mesh whose dp axis is
+    DECLARED as DCN. Single host, forced-CPU virtual devices: the
+    collectives and quantization math are real, the slow cross-slice link
+    is not — so the payload-bytes reduction (the quantity DCN actually
+    cares about) is reported analytically alongside the measured step
+    times, and the whole result is labeled with its platform.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import optax
+    import jax.numpy as jnp
+    from dataclasses import replace
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_lightning_tpu.models.llama import LlamaConfig, init_params, lm_loss
+    from ray_lightning_tpu.parallel.compression import (
+        DEFAULT_BLOCK_SIZE,
+        payload_bytes,
+        two_phase_dcn_reduce,
+        with_error_feedback,
+    )
+    from ray_lightning_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    n = len(jax.devices())
+    if n < 2:
+        print(json.dumps({"error": f"dcn sweep needs >= 2 devices, have {n}"}))
+        return 0
+    mesh = build_mesh(MeshSpec(axes={"dp": n}, dcn_axes=("dp",)))
+    cfg = replace(LlamaConfig.tiny(), remat=False)
+    seq = cfg.max_seq
+    batch = n  # one sequence per emulated slice
+    reps = max(1, int(_env_timeout("RLT_BENCH_DCN_STEPS", 5)))
+    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    params = jax.device_put(
+        init_params(jax.random.key(0), cfg), NamedSharding(mesh, P())
+    )
+    tokens = jax.device_put(
+        jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)),
+            jnp.int32,
+        ),
+        NamedSharding(mesh, P("dp")),
+    )
+
+    def time_mode(step, state):
+        p, s, loss = step(params, state, tokens)  # compile + warm
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            p, s, loss = step(p, s, tokens)
+        final = float(loss)
+        dt = time.perf_counter() - t0
+        return batch * seq * reps / dt, final
+
+    # off: GSPMD's implicit full-precision all-reduce over dp
+    def plain_step(p, s, toks):
+        (loss, _), grads = jax.value_and_grad(
+            lambda q: lm_loss(q, toks, cfg), has_aux=True
+        )(p)
+        upd, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, upd), s, loss
+
+    off_tps, off_loss = time_mode(jax.jit(plain_step), tx.init(params))
+
+    # on: the trainer's compressed step shape — explicit shard_map
+    # collective, int8 wire payload, error feedback stacked over dp
+    ctx = optax.chain(
+        with_error_feedback(
+            two_phase_dcn_reduce((), "dp", n, block_size=DEFAULT_BLOCK_SIZE)
+        ),
+        tx,
+    )
+    state0 = ctx.init(params)
+    ef0 = jax.tree_util.tree_map(
+        lambda r: jax.device_put(
+            jnp.zeros((n,) + r.shape, r.dtype), NamedSharding(mesh, P("dp"))
+        ),
+        state0[0],
+    )
+    state0 = (ef0,) + tuple(state0[1:])
+    ef_spec = jax.tree_util.tree_map(lambda _: P("dp"), state0[0])
+    st_spec = (ef_spec,) + tuple(
+        jax.tree_util.tree_map(lambda _: P(), s) for s in state0[1:]
+    )
+
+    def comp_body(p, s, toks):
+        (loss, _), grads = jax.value_and_grad(
+            lambda q: lm_loss(q, toks, cfg), has_aux=True
+        )(p)
+        ef_local = jax.tree_util.tree_map(lambda x: x[0], s[0])
+        upd, new = ctx.update(grads, (ef_local,) + tuple(s[1:]), p)
+        new_ef = jax.tree_util.tree_map(lambda x: x[None], new[0])
+        return (
+            optax.apply_updates(p, upd),
+            (new_ef,) + tuple(new[1:]),
+            jax.lax.pmean(loss, "dp"),
+        )
+
+    comp_step = jax.jit(
+        shard_map(
+            comp_body,
+            mesh=mesh,
+            in_specs=(P(), st_spec, P("dp")),
+            out_specs=(P(), st_spec, P()),
+            check_rep=False,
+        )
+    )
+    on_tps, on_loss = time_mode(comp_step, state0)
+
+    unc_bytes, comp_bytes = payload_bytes(params, DEFAULT_BLOCK_SIZE)
+    # ring all-reduce (or reduce-scatter + all-gather) moves 2(n-1)/n of
+    # the payload per device per step; the ratio is payload-independent
+    wire = 2.0 * (n - 1) / n
+    print(
+        json.dumps(
+            {
+                "platform": "cpu",
+                "emulated": True,
+                "devices": n,
+                "dcn_axis": "dp",
+                "block_size": DEFAULT_BLOCK_SIZE,
+                "preset": "tiny",
+                "steps": reps,
+                "tokens_per_sec": {
+                    "none": round(off_tps, 1),
+                    "int8": round(on_tps, 1),
+                },
+                "final_loss": {
+                    "none": round(off_loss, 4),
+                    "int8": round(on_loss, 4),
+                },
+                "dcn_bytes_per_device_per_step": {
+                    "none": round(unc_bytes * wire),
+                    "int8": round(comp_bytes * wire),
+                },
+                "payload_reduction": round(unc_bytes / comp_bytes, 2),
+            }
+        )
+    )
+    return 0
+
+
+def _attach_dcn_sweep(result: dict, here: str, env: dict) -> None:
+    """Attach detail.dcn_compression (the compressed-collectives sweep) to a
+    fresh measurement. The sweep child is pinned to the virtual CPU backend
+    with 4 forced host devices — it never acquires the chip, so it cannot
+    orphan device-side work (the one-process rule in the module docstring
+    is about chip acquisition). RLT_BENCH_DCN_SWEEP=0 disables."""
+    if os.environ.get("RLT_BENCH_DCN_SWEEP", "1") == "0":
+        return
+    sweep_env = dict(env)
+    sweep_env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(
+        f
+        for f in sweep_env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    sweep_env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    ok, sweep, serr = _run(
+        [sys.executable, here, "--_dcn_sweep"],
+        _env_timeout("RLT_BENCH_DCN_TIMEOUT", 600.0),
+        sweep_env,
+    )
+    detail = result.setdefault("detail", {})
+    if ok and isinstance(sweep, dict) and "tokens_per_sec" in sweep:
+        detail["dcn_compression"] = sweep
+    else:
+        detail["dcn_compression"] = {
+            "error": (sweep or {}).get("error")
+            or serr
+            or "sweep produced no JSON"
+        }
 
 
 def _last_json_dict(stdout: str):
@@ -514,18 +713,15 @@ def main() -> int:
     parser.add_argument("--platform", default=None, choices=[None, "cpu", "native"])
     parser.add_argument("--_probe", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--_dcn_sweep", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
 
     if args._probe:
         return _probe()
     if args._child:
         return _child(args)
-
-    def _env_timeout(name: str, default: float) -> float:
-        try:
-            return float(os.environ.get(name, default))
-        except ValueError:
-            return default
+    if args._dcn_sweep:
+        return _dcn_sweep(args)
 
     probe_timeout = _env_timeout("RLT_BENCH_PROBE_TIMEOUT", 600.0)
     bench_timeout = _env_timeout("RLT_BENCH_TIMEOUT", 1800.0)
@@ -543,7 +739,7 @@ def main() -> int:
         # cache does not hold.
         bare = (
             args.platform is None
-            and env.get("JAX_PLATFORMS") != "cpu"  # env pin = CPU demand
+            and not _env_demands_cpu(env.get("JAX_PLATFORMS"))  # env pin = CPU demand
             and args.batch is None
             and args.steps == parser.get_default("steps")
             and args.warmup == parser.get_default("warmup")
@@ -573,7 +769,7 @@ def main() -> int:
     error = None
     # explicit --platform beats the ambient env var
     force_cpu = args.platform == "cpu" or (
-        args.platform != "native" and env.get("JAX_PLATFORMS") == "cpu"
+        args.platform != "native" and _env_demands_cpu(env.get("JAX_PLATFORMS"))
     )
     if not force_cpu:
         ok, probe_res, perr = _run(
@@ -587,6 +783,7 @@ def main() -> int:
                 bench_timeout, env,
             )
             if ok:
+                _attach_dcn_sweep(result, here, env)
                 if _is_on_chip(result):
                     _save_tpu_cache(result, _args_key(args))
                 print(json.dumps(result))
@@ -626,6 +823,8 @@ def main() -> int:
     )
     if not ok:
         result = _fail_result({"cpu_error": cerr})
+    else:
+        _attach_dcn_sweep(result, here, env)
     if error:
         result.setdefault("detail", {})["error"] = error
     print(json.dumps(result))

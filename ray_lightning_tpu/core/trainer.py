@@ -222,6 +222,10 @@ class Trainer:
         self._tx = None
         self._alt_txs = None  # alternating optimizers (GAN-style), or None
         self._alt_labels = None
+        # compressed DCN collectives context (parallel/compression.py), set
+        # by _setup_dcn_compression when the strategy enables it; None means
+        # the standard GSPMD implicit-all-reduce train step
+        self._dcn_ctx = None
         self._rng_root = None
         self._datamodule = None
         self._restored_ckpt: Optional[Dict[str, Any]] = None
@@ -480,11 +484,218 @@ class Trainer:
         return self._wrap_tx(configured)
 
     # ------------------------------------------------------------------ #
+    # compressed DCN collectives (parallel/compression.py)
+    # ------------------------------------------------------------------ #
+    def _setup_dcn_compression(self):
+        """Resolve the strategy's ``dcn_grad_compression`` knob into a
+        context dict for the compressed train step, or None for the
+        standard implicit-all-reduce path.
+
+        Compression replaces XLA's implicit gradient all-reduce with an
+        explicit ``shard_map`` collective, so it only composes with
+        configurations where the gradient reduction is the ONLY cross-
+        device traffic in the step: replicated params/optimizer over pure
+        data-parallel axes. Anything else raises (or warns and falls back
+        where a silent no-op is the correct semantics).
+        """
+        mode = getattr(self.strategy, "dcn_grad_compression", "none")
+        if mode == "none":
+            return None
+        from ray_lightning_tpu.parallel.compression import DEFAULT_BLOCK_SIZE
+        from ray_lightning_tpu.parallel.mesh import split_dcn_axes
+        from ray_lightning_tpu.utils.common import rank_zero_warn
+
+        if self._alt_txs is not None:
+            rank_zero_warn(
+                "dcn_grad_compression=%r is not supported with alternating "
+                "optimizers; gradients stay uncompressed",
+                mode,
+            )
+            return None
+        mesh = self.strategy.mesh
+        policy = self.strategy.sharding_policy
+        ici_axes, dcn_axes = split_dcn_axes(
+            self.strategy.mesh_spec, mesh, policy.data_axes
+        )
+        if not dcn_axes:
+            rank_zero_warn(
+                "dcn_grad_compression=%r but no data axis rides DCN "
+                "(MeshSpec.dcn_axes is empty or the dcn axes have size 1); "
+                "gradients stay uncompressed",
+                mode,
+            )
+            return None
+        if len(dcn_axes) > 1:
+            raise ValueError(
+                f"dcn_grad_compression supports one DCN data axis, got "
+                f"{dcn_axes}; fold the cross-slice axes into a single one"
+            )
+        if policy.zero_stage != 0:
+            raise ValueError(
+                f"dcn_grad_compression requires replicated params and "
+                f"optimizer state (zero_stage=0), got zero_stage="
+                f"{policy.zero_stage}: under ZeRO the update itself is "
+                "sharded and the quantized reduce-scatter is not implemented"
+            )
+        non_data = [
+            a
+            for a in mesh.axis_names
+            if a not in policy.data_axes and mesh.shape[a] > 1
+        ]
+        if non_data:
+            raise ValueError(
+                f"dcn_grad_compression supports pure data-parallel meshes; "
+                f"model axes {non_data} have size > 1"
+            )
+        module_fn = getattr(self._module, "param_shardings", None)
+        if callable(module_fn) and module_fn(mesh) is not None:
+            raise ValueError(
+                "dcn_grad_compression requires replicated params, but the "
+                "module owns a sharded layout (param_shardings)"
+            )
+        try:
+            block_size = int(
+                os.environ.get("RLT_DCN_BLOCK_SIZE", DEFAULT_BLOCK_SIZE)
+            )
+        except ValueError:
+            raise ValueError(
+                f"RLT_DCN_BLOCK_SIZE={os.environ['RLT_DCN_BLOCK_SIZE']!r} "
+                "is not an int"
+            )
+        dcn_axis = dcn_axes[0]
+        batch_axes = tuple(
+            a
+            for a in policy.data_axes
+            if a in mesh.axis_names and mesh.shape[a] > 1
+        )
+        return {
+            "mesh": mesh,
+            "dcn_axis": dcn_axis,
+            "dcn_size": int(mesh.shape[dcn_axis]),
+            "ici_axes": ici_axes,
+            "batch_axes": batch_axes,
+            "block_size": block_size,
+        }
+
+    def _stack_ef_residual(self, opt_state):
+        """The error-feedback residual is device-varying over the dcn axis
+        (each rank's quantization error is its own), but the jit boundary
+        carries GLOBAL arrays — so the residual lives globally stacked as
+        ``[n_dcn, *leaf]`` sharded over the dcn axis, and the shard_map'd
+        step squeezes/restores the local singleton. Replaces the chain's
+        freshly-initialized (unstacked) EF state with stacked zeros."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ctx = self._dcn_ctx
+        mesh, n = ctx["mesh"], ctx["dcn_size"]
+        ef, rest = opt_state[0], tuple(opt_state[1:])
+        shardings = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P(ctx["dcn_axis"])), ef
+        )
+        # jit + out_shardings materializes the global zeros correctly in
+        # multi-process meshes (a host-side device_put could not address
+        # other processes' shards)
+        stacked = jax.jit(
+            lambda: jax.tree_util.tree_map(
+                lambda r: jnp.zeros((n,) + r.shape, r.dtype), ef
+            ),
+            out_shardings=shardings,
+        )()
+        return (stacked,) + rest
+
+    def _build_compressed_train_step(self):
+        """The single-optimizer train step with the dp-axis gradient
+        reduction as an EXPLICIT shard_map collective: full-precision pmean
+        over the in-slice (ICI) axes, block-scaled int8 payload over the
+        cross-slice (DCN) hop, error feedback carried in the optimizer
+        chain's leading ``ErrorFeedbackState``. Same math as
+        ``_build_train_step`` otherwise."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        module = self._module
+        tx = self._tx
+        policy = self.precision_policy
+        compute_dtype = policy.compute_dtype
+        ctx = self._dcn_ctx
+        mesh = ctx["mesh"]
+        batch_axes = ctx["batch_axes"]
+        batch_entry = batch_axes[0] if len(batch_axes) == 1 else batch_axes
+        reduce_axes = tuple(ctx["ici_axes"]) + (ctx["dcn_axis"],)
+        ef_spec = jax.tree_util.tree_map(
+            lambda _: P(ctx["dcn_axis"]), self._opt_state[0]
+        )
+        opt_spec = (ef_spec,) + tuple(
+            jax.tree_util.tree_map(lambda _: P(), s)
+            for s in self._opt_state[1:]
+        )
+
+        def _mean(v):
+            return (
+                jax.lax.pmean(v, reduce_axes)
+                if jnp.issubdtype(jnp.result_type(v), jnp.inexact)
+                else v
+            )
+
+        def train_step(params, opt_state, batch, rng_root, step):
+            rng = jax.random.fold_in(rng_root, step)
+            batch = cast_floats(batch, compute_dtype)
+
+            def loss_fn(p):
+                if policy.cast_params_in_compute:
+                    p = cast_floats(p, compute_dtype)
+                module._capture_begin("train", rng)
+                out = module.training_step(p, batch, step)
+                logs = module._capture_end()
+                if isinstance(out, dict):
+                    loss = out["loss"]
+                    mutated = out.get("mutated_params")
+                else:
+                    loss, mutated = out, None
+                return loss, (logs, mutated)
+
+            (loss, (logs, mutated)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            # the leading EF transform reduces the gradient across the mesh
+            # (two_phase_dcn_reduce); drop the residual's local singleton
+            # before the update, restore it for the carried-out state
+            ef_local = jax.tree_util.tree_map(lambda x: x[0], opt_state[0])
+            updates, new_state = tx.update(
+                grads, (ef_local,) + tuple(opt_state[1:]), params
+            )
+            new_ef = jax.tree_util.tree_map(lambda x: x[None], new_state[0])
+            new_opt_state = (new_ef,) + tuple(new_state[1:])
+            new_params = optax.apply_updates(params, updates)
+            if mutated is not None and isinstance(new_params, dict):
+                # forward-mutated collections (e.g. batch_stats) are
+                # device-varying here — average them like DDP buffers
+                mutated = jax.tree_util.tree_map(_mean, mutated)
+                new_params = {
+                    k: (mutated[k] if (k != "params" and k in mutated) else v)
+                    for k, v in new_params.items()
+                }
+            logs = {k: _mean(v) for k, v in logs.items()}
+            logs.setdefault("loss", _mean(loss))
+            return new_params, new_opt_state, logs
+
+        mapped = shard_map(
+            train_step,
+            mesh=mesh,
+            in_specs=(P(), opt_spec, P(batch_entry), P(), P()),
+            out_specs=(P(), opt_spec, P()),
+            check_rep=False,
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------ #
     # compiled steps
     # ------------------------------------------------------------------ #
     def _build_train_step(self):
         if self._alt_txs is not None:
             return self._build_alternating_train_step()
+        if self._dcn_ctx is not None:
+            return self._build_compressed_train_step()
         module = self._module
         tx = self._tx
         policy = self.precision_policy
@@ -651,6 +862,23 @@ class Trainer:
         host_params = cast_floats(host_params, self.precision_policy.param_dtype)
         self._params = self.strategy.place_params(host_params)
         self._tx = self._normalize_tx(model.configure_optimizers())
+        self._dcn_ctx = self._setup_dcn_compression()
+        if self._dcn_ctx is not None:
+            from ray_lightning_tpu.parallel.compression import (
+                two_phase_dcn_reduce,
+                with_error_feedback,
+            )
+
+            # the EF wrapper runs FIRST in the chain: it performs the
+            # two-phase reduction itself (inside the shard_map'd step), so
+            # every transform after it sees the fully reduced gradient
+            compressor = two_phase_dcn_reduce(
+                self._dcn_ctx["ici_axes"],
+                self._dcn_ctx["dcn_axis"],
+                self._dcn_ctx["dcn_size"],
+                block_size=self._dcn_ctx["block_size"],
+            )
+            self._tx = optax.chain(with_error_feedback(compressor), self._tx)
         if self._alt_txs is not None:
             # every label must name a real optimizer and every optimizer
             # must own at least one leaf — an out-of-range label would
@@ -684,6 +912,8 @@ class Trainer:
             self._opt_state = jax.jit(init_fn, out_shardings=opt_shardings)(
                 self._params
             )
+        if self._dcn_ctx is not None:
+            self._opt_state = self._stack_ef_residual(self._opt_state)
 
         relaunch_ckpt = getattr(self, "_relaunch_ckpt_path", None)
         if relaunch_ckpt is not None:

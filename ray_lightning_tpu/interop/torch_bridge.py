@@ -1216,6 +1216,29 @@ def torch_optimizer_to_optax(
     )
 
 
+# kinds _torch_scheduler_to_optax translates without a total_steps horizon
+# (each either ignores it or carries its own: T_max, total_iters, ...)
+_HORIZON_FREE_SCHEDULERS = (
+    "StepLR",
+    "CosineAnnealingLR",
+    "ExponentialLR",
+    "OneCycleLR",
+    "LinearLR",
+    "ConstantLR",
+)
+
+
+def _scheduler_needs_horizon(sched) -> bool:
+    """True when translating ``sched`` with total_steps=None would silently
+    degrade: a nested SequentialLR whose own tail needs a horizon, or an
+    untranslated kind (whose fallback is constant lr)."""
+    kind = type(sched).__name__
+    if kind == "SequentialLR":
+        tail = sched._schedulers[len(sched._milestones):]
+        return any(_scheduler_needs_horizon(c) for c in tail)
+    return kind not in _HORIZON_FREE_SCHEDULERS
+
+
 def _torch_scheduler_to_optax(sched, lr, total_steps):
     if sched is None:
         return lr
@@ -1290,10 +1313,24 @@ def _torch_scheduler_to_optax(sched, lr, total_steps):
                 budgets.append(miles[i] - prev)
                 prev = miles[i]
             else:
-                budgets.append(
+                budget = (
                     (total_steps - prev)
                     if total_steps and total_steps > prev else None
                 )
+                if budget is None and _scheduler_needs_horizon(children[i]):
+                    # without this, the warning fallback would quietly run
+                    # the tail segment at constant lr — an invisible
+                    # scheduler bug, not a translation choice
+                    raise UnsupportedTorchOp(
+                        "SequentialLR: the segment after the last milestone "
+                        f"(step {prev}) is a {type(children[i]).__name__}, "
+                        "whose translation needs a step horizon, but "
+                        "total_steps is unknown or <= the milestone; pass "
+                        "total_steps to the adapter or use a tail scheduler "
+                        "that carries its own horizon (e.g. "
+                        "CosineAnnealingLR with T_max)"
+                    )
+                budgets.append(budget)
         parts = [
             _torch_scheduler_to_optax(c, lr, b)
             for c, b in zip(children, budgets)

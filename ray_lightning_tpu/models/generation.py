@@ -212,6 +212,23 @@ def decode_step(
         # buffer is shorter than the positions it serves, and a too-short
         # table would make _rope_at clamp to the last row silently
         rope_table = _default_table_or_raise(cfg, max(C, cfg.max_seq))
+    # total = positions this table (and therefore this decode loop) can
+    # serve. A cache strictly between the window and that range is unsound:
+    # once pos wraps (pos >= C) the band mask below compares SLOT indices
+    # against absolute positions, silently attending stale entries. Valid
+    # sizes are C <= window (rolling buffer) or C >= every served position
+    # (full cache); reject the middle loudly at trace time.
+    total = int(rope_table[0].shape[0])
+    if cfg.sliding_window and cfg.sliding_window < C < total:
+        raise ValueError(
+            f"cache length {C} is between sliding_window "
+            f"{cfg.sliding_window} and the served position range {total}: "
+            "the rolling slot (pos % C) wraps at C while the band mask "
+            "compares absolute positions, silently corrupting attention "
+            "once pos >= C. Size the cache to the window (rolling) or to "
+            "the full position range, or pass a rope_table no longer than "
+            "the positions you will actually step"
+        )
     c, s = _rope_at(rope_table, pos)
     x = params["embed"][token]  # [B, D]
 

@@ -1,4 +1,4 @@
-from ray_lightning_tpu.parallel.mesh import build_mesh, MeshSpec
+from ray_lightning_tpu.parallel.mesh import build_mesh, MeshSpec, split_dcn_axes
 from ray_lightning_tpu.parallel.sharding import (
     ShardingPolicy,
     batch_sharding,
@@ -6,13 +6,36 @@ from ray_lightning_tpu.parallel.sharding import (
     fsdp_param_shardings,
     infer_param_shardings,
 )
+from ray_lightning_tpu.parallel.compression import (
+    DEFAULT_BLOCK_SIZE,
+    MIN_COMPRESS_SIZE,
+    ErrorFeedbackState,
+    QuantizedBlocks,
+    dequantize_int8,
+    int8_payload_bytes,
+    payload_bytes,
+    quantize_int8,
+    two_phase_dcn_reduce,
+    with_error_feedback,
+)
 
 __all__ = [
     "build_mesh",
     "MeshSpec",
+    "split_dcn_axes",
     "ShardingPolicy",
     "batch_sharding",
     "replicated_sharding",
     "fsdp_param_shardings",
     "infer_param_shardings",
+    "DEFAULT_BLOCK_SIZE",
+    "MIN_COMPRESS_SIZE",
+    "ErrorFeedbackState",
+    "QuantizedBlocks",
+    "dequantize_int8",
+    "int8_payload_bytes",
+    "payload_bytes",
+    "quantize_int8",
+    "two_phase_dcn_reduce",
+    "with_error_feedback",
 ]

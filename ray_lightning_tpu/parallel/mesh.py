@@ -73,6 +73,23 @@ class MeshSpec:
         return MeshSpec(axes={"pp": pp, "dp": -1})
 
 
+def split_dcn_axes(
+    spec: MeshSpec, mesh: Mesh, axes: Sequence[str]
+) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+    """Partition ``axes`` into (ici, dcn) per the spec's ``dcn_axes``.
+
+    Only axes actually present in the mesh with size > 1 are returned —
+    collectives over absent or singleton axes are no-ops, and the
+    compressed-collective layer keys its two phases off this split
+    (full-precision in-slice reduce over the ici axes, quantized payload
+    over the dcn axes).
+    """
+    present = [a for a in axes if a in mesh.axis_names and mesh.shape[a] > 1]
+    ici = tuple(a for a in present if a not in spec.dcn_axes)
+    dcn = tuple(a for a in present if a in spec.dcn_axes)
+    return ici, dcn
+
+
 def build_mesh(
     spec: Optional[MeshSpec] = None,
     devices: Optional[Sequence[jax.Device]] = None,

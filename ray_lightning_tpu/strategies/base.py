@@ -16,6 +16,7 @@ strategies (launch + multi-host) derive from it and add a launcher.
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Optional
 
 import jax
@@ -40,14 +41,34 @@ class Strategy:
         self,
         mesh_spec: Optional[MeshSpec] = None,
         sharding_policy: Optional[ShardingPolicy] = None,
+        dcn_grad_compression: Optional[str] = None,
     ):
         self.mesh_spec = mesh_spec or MeshSpec.data_parallel()
         self.sharding_policy = sharding_policy or ShardingPolicy.ddp()
+        self._dcn_grad_compression = dcn_grad_compression
         self._mesh: Optional[Mesh] = None
         self._trainer = None
         self._module = None
         self.launcher = None
         self._is_remote = False  # True inside a worker actor
+
+    @property
+    def dcn_grad_compression(self) -> str:
+        """Gradient compression mode for the cross-slice (DCN) hop:
+        ``"none"`` (default, XLA's implicit full-precision all-reduce) or
+        ``"int8"`` (block-scaled int8 reduce-scatter/all-gather with error
+        feedback — see ``parallel/compression.py``). The constructor
+        argument wins; otherwise the ``RLT_DCN_COMPRESSION`` env var."""
+        mode = self._dcn_grad_compression
+        if mode is None:
+            mode = os.environ.get("RLT_DCN_COMPRESSION") or "none"
+        mode = str(mode).lower()
+        if mode not in ("none", "int8"):
+            raise ValueError(
+                f"dcn_grad_compression (RLT_DCN_COMPRESSION) must be 'none' "
+                f"or 'int8', got {mode!r}"
+            )
+        return mode
 
     # ------------------------------------------------------------------ #
     # wiring
@@ -226,8 +247,11 @@ class XLAStrategy(Strategy):
         mesh_spec: Optional[MeshSpec] = None,
         sharding_policy: Optional[ShardingPolicy] = None,
         devices: Optional[int] = None,
+        dcn_grad_compression: Optional[str] = None,
     ):
-        super().__init__(mesh_spec, sharding_policy)
+        super().__init__(
+            mesh_spec, sharding_policy, dcn_grad_compression=dcn_grad_compression
+        )
         self._num_devices = devices
 
     def _devices(self):

@@ -55,11 +55,14 @@ class RayStrategy(XLAStrategy):
         chips_per_host: Optional[int] = None,
         mesh_spec: Optional[MeshSpec] = None,
         sharding_policy: Optional[ShardingPolicy] = None,
+        dcn_grad_compression: Optional[str] = None,
         debug_collectives: bool = False,
         max_failures: int = 0,
         **kwargs: Any,
     ):
-        super().__init__(mesh_spec, sharding_policy)
+        super().__init__(
+            mesh_spec, sharding_policy, dcn_grad_compression=dcn_grad_compression
+        )
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
         self.num_workers = int(num_workers)

@@ -24,6 +24,9 @@ def _isolated_cache_dir(tmp_path, monkeypatch):
     these tests observe (e.g. the wedged-probe test would serve the
     cached result instead of the CPU fallback)."""
     monkeypatch.setattr(bench, "_CACHE_DIR", str(tmp_path))
+    # the dcn-compression sweep is opt-in per test: the orchestrator tests
+    # assert the exact probe/child spawn sequence
+    monkeypatch.setenv("RLT_BENCH_DCN_SWEEP", "0")
 
 
 def _result(value, **detail):
@@ -248,6 +251,96 @@ def test_auto_preset_explicit_platform_native_runs_live(monkeypatch, capsys):
     assert any("--_probe" in c for c in calls), "never probed live"
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["value"] == 42.0  # the live measurement, not the cache
+
+
+def test_env_demands_cpu_normalization():
+    """JAX_PLATFORMS is a case-insensitive comma-separated priority list:
+    any entry equal to 'cpu' is a CPU demand, not just the exact string
+    (ADVICE r5 — 'cpu,host' and 'CPU' used to slip through to the cached
+    TPU measurement)."""
+    assert bench._env_demands_cpu("cpu")
+    assert bench._env_demands_cpu("CPU")
+    assert bench._env_demands_cpu("cpu,host")
+    assert bench._env_demands_cpu("tpu, CPU ")
+    assert not bench._env_demands_cpu(None)
+    assert not bench._env_demands_cpu("")
+    assert not bench._env_demands_cpu("tpu")
+    assert not bench._env_demands_cpu("cpuX")
+
+
+def test_auto_preset_cpu_pin_variants_bypass_cache(monkeypatch, capsys):
+    """A 'cpu,host' env pin is a CPU demand: the cached TPU number must not
+    be served and the native backend must never be probed."""
+    key = {"preset": "small", "batch": 8, "steps": 10, "warmup": 2}
+    bench._save_tpu_cache(_result(200.0, platform="tpu"), key)
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append(list(cmd))
+        assert "--_probe" not in cmd, "CPU pin must not touch the native backend"
+        return True, _result(10.0, platform="cpu"), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu,host")
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 10.0
+    assert calls and "--_child" in calls[0] and "cpu" in calls[0]
+
+
+def test_dcn_sweep_attaches_detail(monkeypatch, capsys):
+    """The compression sweep child's JSON lands in detail.dcn_compression,
+    and its spawn is pinned to the virtual CPU backend (never the chip)."""
+    monkeypatch.setenv("RLT_BENCH_DCN_SWEEP", "1")
+    sweep = {
+        "platform": "cpu",
+        "tokens_per_sec": {"none": 800.0, "int8": 500.0},
+        "payload_reduction": 1.98,
+    }
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append(list(cmd))
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        if "--_dcn_sweep" in cmd:
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            assert "--xla_force_host_platform_device_count=4" in env.get(
+                "XLA_FLAGS", ""
+            )
+            return True, dict(sweep), None
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    assert any("--_dcn_sweep" in c for c in calls)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 42.0
+    assert out["detail"]["dcn_compression"]["payload_reduction"] == 1.98
+
+
+def test_dcn_sweep_failure_is_reported_not_fatal(monkeypatch, capsys):
+    """A failed sweep must not cost the measurement: the headline number
+    stands and the failure is disclosed in detail.dcn_compression.error."""
+    monkeypatch.setenv("RLT_BENCH_DCN_SWEEP", "1")
+
+    def fake_run(cmd, timeout, env):
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        if "--_dcn_sweep" in cmd:
+            return False, None, "timeout after 600s"
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 42.0
+    assert "timeout" in out["detail"]["dcn_compression"]["error"]
 
 
 def _import_prober():

@@ -241,3 +241,33 @@ def test_rolling_window_cache_matches_full_forward():
             nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
             seq = np.concatenate([seq, nxt[:, None].astype(seq.dtype)], axis=1)
         assert np.array_equal(out, seq), (P, n_new)
+
+
+def test_decode_step_rejects_midsized_cache_under_sliding_window():
+    """A cache strictly between the window and the served position range is
+    unsound: the rolling slot (pos % C) wraps at C while the band mask
+    compares absolute positions, so decode would silently attend stale
+    entries once pos >= C. decode_step must reject it at trace time; the
+    two sound sizes — C <= window (rolling) and C >= the table's range
+    (full) — must keep working."""
+    cfg = dataclasses.replace(_cfg(), sliding_window=8)
+    params = init_params(jax.random.key(2), cfg)
+    token = jnp.zeros((2,), jnp.int32)
+
+    # C=16 sits between window=8 and the default table range (max_seq=128)
+    bad = init_kv_cache(dataclasses.replace(cfg, sliding_window=0), 2, 16)
+    assert bad["k"].shape[3] == 16
+    with pytest.raises(ValueError, match="between sliding_window"):
+        decode_step(params, bad, token, jnp.int32(0), cfg)
+
+    # C <= window: the rolling buffer init_kv_cache builds — fine
+    rolling = init_kv_cache(cfg, 2, 64)
+    decode_step(params, rolling, token, jnp.int32(0), cfg)
+
+    # C >= every served position: the same C=16 cache is a FULL cache when
+    # the caller's rope table promises it will never step past 16
+    from ray_lightning_tpu.ops.rope import rope_angles
+
+    table = rope_angles(16, cfg.head_dim, cfg.rope_theta,
+                        scaling=cfg.rope_scaling)
+    decode_step(params, bad, token, jnp.int32(0), cfg, rope_table=table)

@@ -684,6 +684,53 @@ def test_scheduler_translations():
         assert abs(torch_lrs[i] - float(s4(i))) < 5e-3, (i, torch_lrs[i])
 
 
+def test_sequential_lr_tail_without_horizon_raises():
+    """A SequentialLR tail segment whose translation needs a step horizon
+    (an untranslated kind, or a nested SequentialLR with such a tail) must
+    raise UnsupportedTorchOp when total_steps is unknown — the old warning
+    fallback silently ran the tail at constant lr (ADVICE r5)."""
+    from ray_lightning_tpu.interop.torch_bridge import (
+        UnsupportedTorchOp,
+        _torch_scheduler_to_optax,
+    )
+
+    def make_chain():
+        net = nn.Linear(4, 4)
+        opt = torch.optim.SGD(net.parameters(), lr=0.1)
+        warm = torch.optim.lr_scheduler.LinearLR(
+            opt, start_factor=0.01, total_iters=10
+        )
+        # MultiStepLR is an untranslated kind: its fallback is constant lr
+        tail = torch.optim.lr_scheduler.MultiStepLR(opt, milestones=[30, 60])
+        return torch.optim.lr_scheduler.SequentialLR(
+            opt, [warm, tail], milestones=[10]
+        )
+
+    with pytest.raises(UnsupportedTorchOp, match="MultiStepLR"):
+        _torch_scheduler_to_optax(make_chain(), 0.1, total_steps=None)
+    # total_steps <= the last milestone leaves the tail budget None too
+    with pytest.raises(UnsupportedTorchOp, match="horizon"):
+        _torch_scheduler_to_optax(make_chain(), 0.1, total_steps=10)
+    # with a real horizon the documented warning fallback still applies
+    with pytest.warns(UserWarning, match="not translated"):
+        s = _torch_scheduler_to_optax(make_chain(), 0.1, total_steps=100)
+    assert abs(float(s(50)) - 0.1) < 1e-6  # constant-lr tail, disclosed
+
+    # a tail that carries its own horizon (T_max) stays fine without
+    # total_steps
+    net = nn.Linear(4, 4)
+    opt = torch.optim.SGD(net.parameters(), lr=0.1)
+    warm = torch.optim.lr_scheduler.LinearLR(
+        opt, start_factor=0.01, total_iters=10
+    )
+    cos = torch.optim.lr_scheduler.CosineAnnealingLR(opt, T_max=90)
+    chain = torch.optim.lr_scheduler.SequentialLR(
+        opt, [warm, cos], milestones=[10]
+    )
+    s2 = _torch_scheduler_to_optax(chain, 0.1, total_steps=None)
+    assert float(s2(99)) < 0.01
+
+
 def test_adagrad_translation():
     """torch.optim.Adagrad maps to optax.adagrad (initial accumulator +
     eps preserved; L2 weight_decay folded into gradients); lr_decay
