@@ -995,6 +995,18 @@ class Trainer:
         if prev is not None:
             yield prev
 
+    def _health_tick(self, train: bool) -> None:
+        """Per-batch liveness tick: fire any scripted fault for this rank at
+        this global step (train batches only — a validation batch must not
+        re-fire a step fault), then publish a heartbeat for the driver-side
+        hang supervisor. Both are cheap no-ops when unconfigured."""
+        from ray_lightning_tpu import session as _session
+        from ray_lightning_tpu.runtime import faults as _faults
+
+        if train:
+            _faults.fire_step_faults(self.global_step)
+        _session.emit_heartbeat(self.global_step)
+
     def _run_train_epoch(self, train_loader, train_step, val_loader, val_step):
         model = self._module
         if hasattr(train_loader, "set_epoch"):
@@ -1046,6 +1058,7 @@ class Trainer:
         for batch_idx, batch, device_batch in self._prefetch_shard(
             train_loader, limit_train
         ):
+            self._health_tick(train=True)
             self._cb("on_train_batch_start", batch, batch_idx)
             self._params, self._opt_state, logs = train_step(
                 self._params,
@@ -1164,6 +1177,7 @@ class Trainer:
         for batch_idx, batch in enumerate(loader):
             if limit is not None and batch_idx >= limit:
                 break
+            self._health_tick(train=False)
             device_batch = self.strategy.shard_batch(batch)
             logs = eval_step(self._params, device_batch, np.int32(batch_idx))
             aggregator.update(logs, self._batch_size_of(batch))

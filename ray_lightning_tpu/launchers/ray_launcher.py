@@ -46,13 +46,21 @@ def _drain_queue(queue) -> None:
             item()
 
 
-def process_results(futures: List[rt.CallFuture], queue=None) -> List[Any]:
+def process_results(
+    futures: List[rt.CallFuture], queue=None, supervisor=None
+) -> List[Any]:
     """Poll worker futures while draining the tune queue (reference:
     util.py:57-70). Raises a worker error, preferring a PROCESS failure
     over a collective-abort exception from a surviving peer — when one
     worker dies, its peers typically also error (all-reduce abort) and
     whichever future settles first is a race; only the process failure is
-    the retryable root cause."""
+    the retryable root cause.
+
+    With a ``supervisor`` this is a *supervised* wait, not an unbounded
+    one: each poll round also checks the hang watchdog's verdict
+    (``Supervisor.poll`` raises ``WorkerHangError`` once the group has been
+    declared hung and torn down), so a deadlocked collective can no longer
+    block the driver forever."""
     remaining = list(futures)
     first_error: Optional[Exception] = None
 
@@ -72,6 +80,12 @@ def process_results(futures: List[rt.CallFuture], queue=None) -> List[Any]:
 
     while remaining:
         ready, remaining = rt.wait(remaining, num_returns=1, timeout=0.1)
+        # verdict BEFORE futures: the supervisor records its hang verdict
+        # and THEN kills the group, so by the time a killed worker's future
+        # settles as connection_lost the verdict is guaranteed visible —
+        # polling first reports "hang" instead of a generic process failure
+        if supervisor is not None:
+            supervisor.poll()
         for fut in ready:
             check(fut)
         if first_error is not None:
@@ -131,6 +145,8 @@ def _wrapping_function(
     queue_handle,
     local_rank: int = 0,
     node_rank: Optional[int] = None,
+    heartbeat_handle=None,
+    heartbeat_interval: float = 1.0,
 ) -> Optional[WorkerOutput]:
     """Runs inside the worker actor (via ``RayExecutor.execute``): rebuild
     the trainer, join the session, run the requested trainer stage, and on
@@ -153,7 +169,12 @@ def _wrapping_function(
     )
 
     reset_session()
-    init_session(rank=global_rank, queue=queue_handle)
+    init_session(
+        rank=global_rank,
+        queue=queue_handle,
+        heartbeat=heartbeat_handle,
+        heartbeat_interval=heartbeat_interval,
+    )
 
     # fn_args[0] is the module; it and trainer._module are the same object
     # (one cloudpickle memo), so driver-side identity is preserved — the
@@ -223,6 +244,8 @@ class RayLauncher:
         self._worker_ranks: List[Tuple[int, int]] = []  # (node_rank, local_rank)
         self._any_remote = False
         self._tune_queue = None
+        self._hb_queue = None  # heartbeat channel (only with hang_timeout)
+        self._group_killed = False  # set once the supervisor hard-killed us
 
     def get_local_ranks(self) -> List[Tuple[int, int]]:
         """global_rank -> (node_rank, local_rank) for the current worker set
@@ -404,16 +427,20 @@ class RayLauncher:
         placement = "spread" if len(rt.nodes()) > 1 else None
         assignments = rt.plan_placement(demands, placement)
 
+        # per-rank env from interpreter boot: the rank is known before the
+        # wrapping function runs, so boot-time fault injection (RLT_FAULT
+        # @boot) and rank-tagged diagnostics work during bring-up
+        per_actor_env: List[Dict[str, str]] = [
+            {"RLT_GLOBAL_RANK": str(i)} for i in range(n)
+        ]
         # chip partitioning: workers sharing a host must own disjoint chips
         # (the reference's CUDA_VISIBLE_DEVICES role, ray_launcher.py:177-219)
-        per_actor_env: Optional[List[Dict[str, str]]] = None
         workers_by_node: Dict[int, List[int]] = {}
         for i, node_id in enumerate(assignments):
             workers_by_node.setdefault(node_id, []).append(i)
         if any("TPU" in d for d in demands) and any(
             len(idxs) > 1 for idxs in workers_by_node.values()
         ):
-            per_actor_env = [{} for _ in range(n)]
             chips = strategy.chips_per_host or int(
                 os.environ.get("RLT_CHIPS_PER_HOST", "4")
             )
@@ -475,6 +502,12 @@ class RayLauncher:
             # shared-memory queues cannot cross machines
             self._tune_queue = rt.make_queue(cross_host=self._any_remote)
 
+        self._group_killed = False
+        if getattr(strategy, "hang_timeout", None):
+            # heartbeat channel for the hang watchdog; without hang_timeout
+            # no ticks are emitted and no supervisor runs
+            self._hb_queue = rt.make_queue(cross_host=self._any_remote)
+
     @staticmethod
     def _is_tune_session() -> bool:
         from ray_lightning_tpu.tune.session import is_session_enabled
@@ -507,6 +540,8 @@ class RayLauncher:
             trainer._opt_state = opt
 
         queue_handle = self._tune_queue.handle() if self._tune_queue else None
+        hb_handle = self._hb_queue.handle() if self._hb_queue else None
+        supervisor = self._make_supervisor()
         try:
             futures = [
                 w.execute.remote(
@@ -517,17 +552,74 @@ class RayLauncher:
                     queue_handle,
                     self._worker_ranks[rank][1] if self._worker_ranks else 0,
                     self._worker_ranks[rank][0] if self._worker_ranks else rank,
+                    hb_handle,
+                    getattr(self._strategy, "heartbeat_interval", 1.0),
                 )
                 for rank, w in enumerate(self._workers)
             ]
-            results = process_results(futures, self._tune_queue)
+            results = process_results(futures, self._tune_queue, supervisor)
         finally:
+            if supervisor is not None:
+                supervisor.stop()
             # free the trainer+params shm segment once workers have consumed
             # it (repeated fit/tune launches would otherwise exhaust /dev/shm)
             if not isinstance(payload_ref, bytes):
                 rt.delete(payload_ref)
         output = next((r for r in results if r is not None), None)
         return output
+
+    # ------------------------------------------------------------------ #
+    # health supervision
+    # ------------------------------------------------------------------ #
+    def _make_supervisor(self):
+        hang_timeout = getattr(self._strategy, "hang_timeout", None)
+        if not hang_timeout or self._hb_queue is None:
+            return None
+        from ray_lightning_tpu.runtime.supervisor import Supervisor
+
+        supervisor = Supervisor(
+            num_workers=self._strategy.num_workers,
+            drain=self._hb_queue.get_all,
+            hang_timeout=hang_timeout,
+            heartbeat_interval=getattr(self._strategy, "heartbeat_interval", 1.0),
+            kill_group=self._kill_worker_group,
+            is_alive=self._worker_alive,
+            label=f"worker group ({self._strategy.num_workers} ranks)",
+        )
+        supervisor.start()
+        return supervisor
+
+    def _worker_alive(self, rank: int) -> bool:
+        """Best-effort liveness probe: only decisive for local workers whose
+        pid we can signal-0; remote workers default to alive so an aged-out
+        remote rank classifies as a hang (killing it is safe either way)."""
+        try:
+            w = self._workers[rank]
+        except IndexError:
+            return False
+        if rt.actor_node_id(w) != 0:
+            return True
+        pid = getattr(w, "_pid", 0)
+        if not pid:
+            return True
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            pass  # exists, not ours to signal — still alive
+        return True
+
+    def _kill_worker_group(self) -> None:
+        """Supervisor verdict path: hard-kill every worker NOW. A hung
+        group's survivors sit inside collectives with the dead rank — there
+        is nothing graceful left to do, and each grace window would stack."""
+        self._group_killed = True
+        for w in self._workers:
+            try:
+                rt.kill(w, force=True, timeout=2.0)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------ #
     def _recover_results_in_main_process(self, output: WorkerOutput, trainer) -> None:
@@ -547,9 +639,13 @@ class RayLauncher:
         if self._tune_queue is not None:
             self._tune_queue.shutdown()
             self._tune_queue = None
-        if len(self._workers) > 1:
+        if self._hb_queue is not None:
+            self._hb_queue.shutdown()
+            self._hb_queue = None
+        if len(self._workers) > 1 and not self._group_killed:
             # leave the collective group before killing processes so the
             # coordination service doesn't log spurious peer-loss errors
+            # (pointless after a supervisor hard-kill: everyone is dead)
             try:
                 rt.get(
                     [w.shutdown_distributed.remote() for w in self._workers],
@@ -558,5 +654,6 @@ class RayLauncher:
             except Exception:
                 pass
         for w in self._workers:
-            rt.kill(w)
+            rt.kill(w, force=self._group_killed)
         self._workers = []
+        self._group_killed = False

@@ -92,6 +92,16 @@ class RayExecutor:
         )
         return float(jax.jit(jnp.sum)(arr))
 
+    def ping(self) -> Dict[str, float]:
+        """Liveness probe: a reply proves the process and its call pipeline
+        are up. Actor calls run serially, so a ping issued while ``execute``
+        is mid-trainer queues behind it — which is why live training health
+        rides the heartbeat queue (session.heartbeat) instead; ping is for
+        probing workers that *should* be idle (pre-launch, post-teardown)."""
+        import time
+
+        return {"pid": float(os.getpid()), "time": time.time()}
+
     def execute(self, fn: Callable, *args, **kwargs) -> Any:
         return fn(*args, **kwargs)
 

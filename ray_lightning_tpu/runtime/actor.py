@@ -35,6 +35,7 @@ from concurrent.futures import Future
 from typing import Any, Dict, Optional, Tuple
 
 import cloudpickle
+from concurrent.futures import TimeoutError as _FuturesTimeout
 
 _LEN = struct.Struct("!Q")
 
@@ -72,6 +73,17 @@ class ActorError(RuntimeError):
         self.is_process_failure = is_process_failure
 
 
+class ActorTimeout(ActorError, TimeoutError):
+    """A bounded wait on a :class:`CallFuture` expired.
+
+    Not a process failure: the call may still complete — the future stays
+    pending and ``result(timeout)`` can be re-invoked (the supervisor's
+    polling loop relies on exactly this re-waitability)."""
+
+    def __init__(self, message: str):
+        super().__init__(message, is_process_failure=False)
+
+
 # --------------------------------------------------------------------- #
 # server side (runs inside the spawned actor process)
 # --------------------------------------------------------------------- #
@@ -90,6 +102,13 @@ def serve_instance(
     connections can arrive over the network; the authkey handshake is what
     gates access, not the interface.
     """
+    # chaos hook: scripted @boot faults fire here, before the ready
+    # handshake, for BOTH spawn paths (actor_boot subprocess and zygote
+    # fork) — the spawner sees a startup failure, not a wedged actor
+    from ray_lightning_tpu.runtime.faults import fire_boot_faults
+
+    fire_boot_faults()
+
     bind_host = bind_host or os.environ.get("RLT_BIND_HOST") or "127.0.0.1"
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -174,7 +193,15 @@ class CallFuture:
         self.method = method
 
     def result(self, timeout: Optional[float] = None) -> Any:
-        status, value = self._fut.result(timeout)
+        try:
+            status, value = self._fut.result(timeout)
+        except (_FuturesTimeout, TimeoutError):
+            # the underlying future is untouched by an expired wait, so the
+            # call remains poll-able with a later result(timeout)
+            raise ActorTimeout(
+                f"{self.actor.name}.{self.method}: no reply within "
+                f"{timeout}s (call may still be running)"
+            ) from None
         if status == "connection_lost":
             raise ActorError(
                 f"{self.actor.name}.{self.method}: worker process failed: {value}",
@@ -200,6 +227,9 @@ class _Connection:
         self._pending: Dict[int, Future] = {}
         self._ids = itertools.count()
         self._lock = threading.Lock()
+        # socket writes get their own lock: _lock only guards _pending/_ids,
+        # so the reader can dispatch responses while a large send is inflight
+        self._send_lock = threading.Lock()
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
@@ -225,8 +255,19 @@ class _Connection:
         with self._lock:
             call_id = next(self._ids)
             self._pending[call_id] = fut
-            payload = cloudpickle.dumps((call_id, method, args, kwargs))
-            _send_msg(self.sock, payload)
+        # serialize + send outside _lock: a multi-MB payload must not stall
+        # every concurrent caller (and the reader's completion dispatch)
+        payload = cloudpickle.dumps((call_id, method, args, kwargs))
+        try:
+            with self._send_lock:
+                _send_msg(self.sock, payload)
+        except OSError as e:
+            # a failed send would otherwise leak the pending entry forever:
+            # nobody will ever answer a call that never left this process
+            with self._lock:
+                self._pending.pop(call_id, None)
+            if not fut.done():
+                fut.set_result(("connection_lost", repr(e)))
         return fut
 
     def close(self):

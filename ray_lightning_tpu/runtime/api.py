@@ -680,9 +680,19 @@ def actor_node_id(handle: ActorHandle) -> int:
     return entry[2] if entry is not None else 0
 
 
-def kill(handle: ActorHandle, no_restart: bool = True, timeout: float = 5.0) -> None:
+def kill(
+    handle: ActorHandle,
+    no_restart: bool = True,
+    timeout: float = 5.0,
+    force: bool = False,
+) -> None:
     """Graceful-then-hard actor kill (reference kills workers with
-    ``ray.kill(no_restart=True)``, ray_launcher.py:116-128)."""
+    ``ray.kill(no_restart=True)``, ray_launcher.py:116-128).
+
+    ``force=True`` skips the graceful socket shutdown and goes straight to
+    SIGKILL — the supervisor's path for *hung* actors, whose serve loop may
+    never answer a shutdown call and must not cost a grace window per
+    worker."""
     entry = _state.actors.pop(handle.name, None)
     node_id = entry[2] if entry is not None else None
     node = None
@@ -692,23 +702,29 @@ def kill(handle: ActorHandle, no_restart: bool = True, timeout: float = 5.0) -> 
         except KeyError:
             node = None
     if node is not None and node.agent is not None:
-        # graceful shutdown over the actor's own socket FIRST — the agent's
-        # kill_actor only reaps (or force-kills after its grace window)
-        handle.shutdown(timeout=timeout)
+        if not force:
+            # graceful shutdown over the actor's own socket FIRST — the
+            # agent's kill_actor only reaps (or force-kills after its grace
+            # window)
+            handle.shutdown(timeout=timeout)
         try:
-            node.agent.kill_actor.remote(handle.name, timeout).result(
+            node.agent.kill_actor.remote(handle.name, timeout, force).result(
                 timeout=timeout + 10
             )
         except Exception:
             pass
         node.release(handle.name)
+        _drop_connection(handle)
         return
-    handle.shutdown(timeout=timeout)
+    if not force:
+        handle.shutdown(timeout=timeout)
     if entry is not None:
         _, proc, _ = entry
         if node is not None:
             node.release(handle.name)
         if proc is not None:
+            if force:
+                proc.kill()
             try:
                 proc.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
@@ -720,7 +736,35 @@ def kill(handle: ActorHandle, no_restart: bool = True, timeout: float = 5.0) -> 
         elif getattr(handle, "_pid", 0):
             # zygote-forked child: not our subprocess, reaped by the
             # zygote's SIGCHLD handler — poll for exit, then escalate
-            _wait_pid_exit(handle._pid, timeout)
+            if force:
+                _kill_pid_now(handle._pid, timeout)
+            else:
+                _wait_pid_exit(handle._pid, timeout)
+    # closing our end settles any pending CallFutures as connection_lost,
+    # which is what unblocks result-polling loops after a hard kill
+    _drop_connection(handle)
+
+
+def _drop_connection(handle: ActorHandle) -> None:
+    conn = handle.__dict__.pop("_connection", None)
+    if conn is not None:
+        conn.close()
+
+
+def _kill_pid_now(pid: int, timeout: float) -> None:
+    import signal as _signal
+
+    try:
+        os.kill(pid, _signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        return
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except (ProcessLookupError, PermissionError):
+            return
+        time.sleep(0.02)
 
 
 def _wait_pid_exit(pid: int, timeout: float) -> None:

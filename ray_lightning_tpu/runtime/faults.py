@@ -1,0 +1,234 @@
+"""Deterministic fault injection: script exact failure sequences for chaos
+tests without flaky sleeps.
+
+The harness is driven entirely by environment variables so it reaches every
+process layer (driver, node agents, actor interpreters, zygote forks) with
+zero API surface in the happy path:
+
+``RLT_FAULT`` — comma-separated fault specs::
+
+    rank<R>:<kind>@<where>[:<arg>]
+
+    rank1:crash@step5          # os._exit(1) at the START of global step 5
+    rank0:hang@step3           # block forever at step 3 (supervisor food)
+    rank2:slow@step4:2.5       # sleep 2.5s at step 4 (straggler)
+    rank1:drop-heartbeats@step2  # stay alive but go silent from step 2 on
+    rank0:crash@boot           # die during actor bring-up, before the
+                               # ready handshake (startup-failure path)
+
+Step faults fire at the start of the named *global training step* (the
+trainer's per-step health tick, ``core/trainer.py``); boot faults fire in
+``serve_instance`` before the actor announces readiness, so they exercise
+the launcher's spawn-failure handling. ``drop-heartbeats`` is deliberately
+distinct from ``hang``: the worker keeps training but its liveness channel
+goes dark — the supervisor must treat silence as a hang even though work
+continues.
+
+``RLT_FAULT_FUSE`` — a directory. When set, each spec fires AT MOST ONCE
+across process relaunches (a marker file per spec is written before
+firing). This is how chaos tests script "crash once, then recover": the
+relaunched worker replays the same steps, matches the same spec, and skips
+it because the fuse is blown. Without a fuse dir faults are pure functions
+of (rank, step) and fire on every match.
+
+Rank resolution: ``RLT_GLOBAL_RANK`` (set by the launcher for worker
+actors). Step faults default to rank 0 when unset so in-process trainers
+can be chaos-tested too; boot faults require the env var — queue actors,
+node agents and trial runners boot through the same ``serve_instance`` and
+must never inherit rank-0 faults.
+"""
+from __future__ import annotations
+
+import os
+import re
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Tuple, Union
+
+FAULT_ENV = "RLT_FAULT"
+FUSE_ENV = "RLT_FAULT_FUSE"
+
+KINDS = ("crash", "hang", "slow", "drop-heartbeats")
+BOOT = "boot"
+
+_SPEC_RE = re.compile(
+    r"^rank(?P<rank>\d+):(?P<kind>crash|hang|slow|drop-heartbeats)"
+    r"(?:@(?:step(?P<step>\d+)|(?P<boot>boot)))?"
+    r"(?::(?P<arg>[0-9.]+))?$"
+)
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One scripted fault: ``kind`` fires for ``rank`` at ``at`` (a global
+    step number, or the string ``"boot"``). ``seconds`` is the slow-fault
+    stall length."""
+
+    rank: int
+    kind: str
+    at: Union[int, str] = 0
+    seconds: float = 0.0
+
+    @property
+    def fuse_id(self) -> str:
+        return f"rank{self.rank}-{self.kind}-at{self.at}"
+
+
+def parse_faults(text: Optional[str]) -> List[FaultSpec]:
+    """Parse an ``RLT_FAULT`` value; raises ValueError naming the bad spec.
+
+    ``drop-heartbeats`` defaults to ``@step0`` (silent from the start);
+    every other kind requires an explicit ``@step<N>`` or ``@boot``.
+    """
+    if not text:
+        return []
+    specs: List[FaultSpec] = []
+    for raw in text.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        m = _SPEC_RE.match(raw)
+        if m is None:
+            raise ValueError(
+                f"bad {FAULT_ENV} spec {raw!r}: expected "
+                "rank<R>:<crash|hang|slow|drop-heartbeats>@<step<N>|boot>"
+                "[:<seconds>]"
+            )
+        kind = m.group("kind")
+        if m.group("boot"):
+            at: Union[int, str] = BOOT
+        elif m.group("step") is not None:
+            at = int(m.group("step"))
+        elif kind == "drop-heartbeats":
+            at = 0
+        else:
+            raise ValueError(
+                f"bad {FAULT_ENV} spec {raw!r}: {kind} needs an explicit "
+                "@step<N> or @boot"
+            )
+        if kind == "slow" and m.group("arg") is None:
+            raise ValueError(
+                f"bad {FAULT_ENV} spec {raw!r}: slow needs a stall length, "
+                "e.g. rank0:slow@step3:2.5"
+            )
+        if at == BOOT and kind in ("slow", "drop-heartbeats"):
+            raise ValueError(
+                f"bad {FAULT_ENV} spec {raw!r}: only crash/hang make sense "
+                "at boot"
+            )
+        specs.append(
+            FaultSpec(
+                rank=int(m.group("rank")),
+                kind=kind,
+                at=at,
+                seconds=float(m.group("arg") or 0.0),
+            )
+        )
+    return specs
+
+
+# parse cache keyed on the raw env string: fire_step_faults runs once per
+# optimizer step and must not re-parse (or re-regex) in the hot loop
+_cache: Tuple[Optional[str], List[FaultSpec]] = (None, [])
+
+
+def _env_specs() -> List[FaultSpec]:
+    global _cache
+    text = os.environ.get(FAULT_ENV)
+    if text != _cache[0]:
+        _cache = (text, parse_faults(text))
+    return _cache[1]
+
+
+def _rank(default: Optional[int] = 0) -> Optional[int]:
+    raw = os.environ.get("RLT_GLOBAL_RANK")
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def _fuse_blown(spec: FaultSpec) -> bool:
+    fuse_dir = os.environ.get(FUSE_ENV)
+    if not fuse_dir:
+        return False
+    return os.path.exists(os.path.join(fuse_dir, spec.fuse_id))
+
+
+def _blow_fuse(spec: FaultSpec) -> None:
+    fuse_dir = os.environ.get(FUSE_ENV)
+    if not fuse_dir:
+        return
+    os.makedirs(fuse_dir, exist_ok=True)
+    # write + flush BEFORE firing: a crash fault must not lose its marker
+    with open(os.path.join(fuse_dir, spec.fuse_id), "w") as f:
+        f.write(str(time.time()))
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _fire(spec: FaultSpec) -> None:
+    _blow_fuse(spec)
+    if spec.kind == "crash":
+        os._exit(1)
+    elif spec.kind == "hang":
+        # a real hang, not an exception: nothing in this thread ever runs
+        # again — only an external kill (the supervisor's job) ends it
+        while True:
+            time.sleep(60)
+    elif spec.kind == "slow":
+        time.sleep(spec.seconds)
+
+
+def fire_step_faults(step: int) -> None:
+    """Trainer hook: fire any crash/hang/slow fault scripted for this rank
+    at this global step. No-op without ``RLT_FAULT``."""
+    specs = _env_specs()
+    if not specs:
+        return
+    rank = _rank(default=0)
+    for spec in specs:
+        if (
+            spec.rank == rank
+            and spec.at == step
+            and spec.kind in ("crash", "hang", "slow")
+            and not _fuse_blown(spec)
+        ):
+            _fire(spec)
+
+
+def fire_boot_faults() -> None:
+    """serve_instance hook: fire crash/hang faults scripted ``@boot`` —
+    before the ready handshake, so the spawner sees a startup failure.
+    Requires an explicit RLT_GLOBAL_RANK (rankless actors never match)."""
+    specs = _env_specs()
+    if not specs:
+        return
+    rank = _rank(default=None)
+    if rank is None:
+        return
+    for spec in specs:
+        if spec.rank == rank and spec.at == BOOT and not _fuse_blown(spec):
+            _fire(spec)
+
+
+def heartbeats_dropped(step: int) -> bool:
+    """Heartbeat-emitter hook: True when a ``drop-heartbeats`` spec for
+    this rank is active at ``step`` (silence starts at the spec's step and
+    never resumes — a half-dead worker, not a blip)."""
+    specs = _env_specs()
+    if not specs:
+        return False
+    rank = _rank(default=0)
+    for spec in specs:
+        if (
+            spec.rank == rank
+            and spec.kind == "drop-heartbeats"
+            and isinstance(spec.at, int)
+            and step >= spec.at
+            and not _fuse_blown(spec)
+        ):
+            return True
+    return False

@@ -96,10 +96,19 @@ class NodeAgent:
             results.append({"name": name, "port": port, "pid": proc.pid})
         return results
 
-    def kill_actor(self, name: str, timeout: float = 5.0) -> bool:
+    def kill_actor(self, name: str, timeout: float = 5.0, force: bool = False) -> bool:
         proc = self._procs.pop(name, None)
         if proc is None:
             return False
+        if force:
+            # supervisor verdict: the actor is HUNG, a graceful wait would
+            # just burn the grace window — SIGKILL immediately
+            proc.kill()
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                pass
+            return True
         # the driver already sent the actor a graceful shutdown; this is the
         # hard backstop
         try:

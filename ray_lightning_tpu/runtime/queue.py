@@ -21,13 +21,33 @@ import ctypes
 import os
 import queue as _queue_mod
 import secrets
+import time
 from typing import Any, List, Optional
 
 import cloudpickle
 
 from ray_lightning_tpu.runtime import api, native
+from ray_lightning_tpu.runtime.actor import ActorError, ActorTimeout
 
 Full = _queue_mod.Full
+
+
+def _actor_put(actor, item: Any, timeout: Optional[float]) -> None:
+    """Bounded put against a queue actor: every failure mode names the
+    queue so a worker stuck reporting can be diagnosed from the traceback."""
+    try:
+        ok = actor.call("put", item).result(timeout=timeout)
+    except ActorTimeout:
+        raise Full(
+            f"queue actor {actor.name!r}: put got no reply within {timeout}s "
+            "(driver torn down, or queue actor wedged?)"
+        ) from None
+    except ActorError as e:
+        raise RuntimeError(
+            f"queue actor {actor.name!r}: put failed: {e}"
+        ) from e
+    if not ok:
+        raise Full(f"queue actor {actor.name!r} is full")
 
 
 # --------------------------------------------------------------------- #
@@ -95,9 +115,8 @@ class Queue:
             )
         return QueueClient(handle)
 
-    def put(self, item: Any) -> None:
-        if not self._actor.call("put", item).result():
-            raise Full("queue is full")
+    def put(self, item: Any, timeout: Optional[float] = 30.0) -> None:
+        _actor_put(self._actor, item, timeout)
 
     def get_all(self) -> List[Any]:
         return self._actor.call("get_nowait_batch").result()
@@ -118,9 +137,8 @@ class QueueClient:
     def __init__(self, actor_handle):
         self._actor = actor_handle
 
-    def put(self, item: Any) -> None:
-        if not self._actor.call("put", item).result():
-            raise Full("queue is full")
+    def put(self, item: Any, timeout: Optional[float] = 30.0) -> None:
+        _actor_put(self._actor, item, timeout)
 
 
 # --------------------------------------------------------------------- #
@@ -153,7 +171,9 @@ class _ShmQueueBase:
             self._len = length
         return native.get_lib()
 
-    def put(self, item: Any) -> None:
+    def put(self, item: Any, timeout: Optional[float] = None) -> None:
+        """Push; on a full ring retry until ``timeout`` (None = fail fast),
+        then raise :class:`Full` naming the queue."""
         lib = self._attach()
         payload = cloudpickle.dumps(item)
         slot_bytes = lib.rlt_queue_slot_bytes(self._queue)
@@ -166,12 +186,24 @@ class _ShmQueueBase:
                 api.delete(spill_ref)
                 raise Full("queue slot too small even for a spill ref")
         buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
-        rc = lib.rlt_queue_push(self._queue, buf, len(payload))
-        if rc != 0 and spill_ref is not None:
-            api.delete(spill_ref)  # the ref never made it into the ring
-        if rc == -11:  # -EAGAIN
-            raise Full("queue is full")
-        if rc != 0:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            rc = lib.rlt_queue_push(self._queue, buf, len(payload))
+            if rc == 0:
+                return
+            if rc == -11:  # -EAGAIN: ring full
+                if deadline is not None and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                    continue
+                if spill_ref is not None:
+                    api.delete(spill_ref)  # the ref never made it in
+                raise Full(
+                    f"shm queue {self._name} is full"
+                    + (f" (gave up after {timeout}s)" if timeout else "")
+                    + "; is the driver draining it?"
+                )
+            if spill_ref is not None:
+                api.delete(spill_ref)
             raise RuntimeError(f"rlt_queue_push failed: {rc}")
 
     def _detach(self):

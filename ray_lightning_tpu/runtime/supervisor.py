@@ -1,0 +1,251 @@
+"""Driver-side worker health supervision.
+
+The launcher's ``process_results`` used to be an unbounded wait: a worker
+that *crashes* settles its future via ``connection_lost``, but a worker
+that *hangs* (deadlocked collective, wedged XLA compile, stuck NFS write)
+never settles anything and the driver blocks forever. Ray solves this with
+runtime-level actor heartbeats; here the trainer itself is the heartbeat
+source — each worker publishes ``(rank, step, wall_time)`` ticks through a
+queue (one tick per optimizer step / validation batch, throttled to
+``heartbeat_interval`` by the session), and a :class:`Supervisor` thread on
+the driver watches tick ages.
+
+Classification (see :func:`classify`):
+
+- ``crash``  — the worker process is gone. Left to the connection-lost
+  path, which already raises ``ActorError(is_process_failure=True)``.
+- ``hung``   — process alive but no tick for > ``hang_timeout``. The
+  supervisor force-kills the whole worker group (a partial group is useless
+  — the survivors are blocked inside collectives with the hung peer) and
+  records a :class:`WorkerHangError` verdict; ``process_results`` polls
+  :meth:`Supervisor.poll` and raises it, which engages the launcher's
+  ``max_failures`` relaunch + checkpoint resume exactly like a crash.
+- ``slow``   — no tick for > ``slow_ratio * hang_timeout``: a straggler
+  warning is logged once per incident, nothing is killed.
+
+A rank only arms its watchdog AFTER its first heartbeat: bring-up work
+(spawn, jax.distributed handshake, first XLA compile) has unbounded
+latency and must not trip the hang detector. Startup itself can be bounded
+separately via ``startup_timeout`` (disabled by default).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ray_lightning_tpu.runtime.actor import ActorError
+
+logger = logging.getLogger(__name__)
+
+OK = "ok"
+SLOW = "slow"
+HUNG = "hung"
+
+# a straggler warning fires when a rank's tick age crosses this fraction of
+# hang_timeout — late enough to skip routine jitter, early enough to matter
+SLOW_RATIO = 0.5
+
+
+class WorkerHangError(ActorError):
+    """A worker group was declared hung and torn down by the supervisor.
+
+    ``is_process_failure=True`` so the launcher's relaunch loop treats a
+    hang exactly like a crashed process: retry (up to ``max_failures``)
+    from the newest checkpoint."""
+
+    def __init__(self, message: str):
+        super().__init__(message, is_process_failure=True)
+
+
+@dataclass
+class WorkerHealth:
+    """Everything the supervisor knows about one rank."""
+
+    rank: int
+    last_step: int = -1
+    last_beat: Optional[float] = None  # monotonic receive time; None = no tick yet
+    started: float = field(default_factory=time.monotonic)
+    warned_slow: bool = False
+
+
+def classify(
+    health: WorkerHealth,
+    now: float,
+    hang_timeout: float,
+    startup_timeout: Optional[float] = None,
+    slow_ratio: float = SLOW_RATIO,
+) -> str:
+    """Pure per-rank verdict: ``"ok"`` / ``"slow"`` / ``"hung"``.
+
+    Pre-first-heartbeat silence is OK unless ``startup_timeout`` bounds it;
+    after that, tick age against ``hang_timeout`` decides.
+    """
+    if health.last_beat is None:
+        if startup_timeout is not None and now - health.started > startup_timeout:
+            return HUNG
+        return OK
+    age = now - health.last_beat
+    if age > hang_timeout:
+        return HUNG
+    if age > hang_timeout * slow_ratio:
+        return SLOW
+    return OK
+
+
+class Supervisor:
+    """Watches one worker group; runs as a daemon thread on the driver.
+
+    ``drain`` returns a batch of ``(rank, step, wall_time)`` heartbeats
+    (the hb queue's ``get_all``); ``kill_group`` hard-kills every worker;
+    ``is_alive(rank)`` is a best-effort local liveness probe used to tell
+    crashes (leave to connection_lost) from hangs (our job).
+    """
+
+    def __init__(
+        self,
+        num_workers: int,
+        drain: Callable[[], List[Tuple[int, int, float]]],
+        hang_timeout: float,
+        heartbeat_interval: float = 1.0,
+        kill_group: Optional[Callable[[], None]] = None,
+        is_alive: Optional[Callable[[int], bool]] = None,
+        startup_timeout: Optional[float] = None,
+        label: str = "workers",
+    ):
+        # a timeout below a couple of heartbeat periods would flag healthy
+        # workers; clamp rather than error so the knobs stay independent
+        self.hang_timeout = max(float(hang_timeout), 2.0 * heartbeat_interval)
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.startup_timeout = startup_timeout
+        self._drain = drain
+        self._kill_group = kill_group
+        self._is_alive = is_alive
+        self._label = label
+        self.health: Dict[int, WorkerHealth] = {
+            r: WorkerHealth(rank=r) for r in range(num_workers)
+        }
+        self._verdict: Optional[WorkerHangError] = None
+        self._verdict_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._poll_interval = max(0.02, min(self.heartbeat_interval / 2.0, 0.25))
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="rlt-supervisor"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # ------------------------------------------------------------------ #
+    # observation
+    # ------------------------------------------------------------------ #
+    def observe(self, rank: int, step: int, wall_time: float) -> None:
+        """Ingest one heartbeat (exposed for unit tests; the thread calls
+        this from drained queue batches)."""
+        h = self.health.get(rank)
+        if h is None:
+            h = self.health[rank] = WorkerHealth(rank=rank)
+        h.last_beat = time.monotonic()
+        h.last_step = max(h.last_step, int(step))
+        h.warned_slow = False  # a fresh tick ends the incident
+
+    def check(self, now: Optional[float] = None) -> Dict[int, str]:
+        """Classify every rank; logs straggler warnings, returns verdicts.
+        (Also exposed for unit tests — drives the same logic as the thread.)"""
+        now = time.monotonic() if now is None else now
+        out: Dict[int, str] = {}
+        for rank, h in self.health.items():
+            verdict = classify(h, now, self.hang_timeout, self.startup_timeout)
+            if verdict == SLOW and not h.warned_slow:
+                h.warned_slow = True
+                logger.warning(
+                    "rank %d is straggling: no heartbeat for %.1fs "
+                    "(last step %d, hang_timeout %.1fs)",
+                    rank,
+                    now - (h.last_beat or h.started),
+                    h.last_step,
+                    self.hang_timeout,
+                )
+            out[rank] = verdict
+        return out
+
+    # ------------------------------------------------------------------ #
+    # verdict
+    # ------------------------------------------------------------------ #
+    def poll(self) -> None:
+        """Raise the hang verdict if one was reached; otherwise return
+        immediately. Called from the launcher's result-polling loop."""
+        with self._verdict_lock:
+            if self._verdict is not None:
+                raise self._verdict
+
+    @property
+    def tripped(self) -> bool:
+        with self._verdict_lock:
+            return self._verdict is not None
+
+    # ------------------------------------------------------------------ #
+    # the watch loop
+    # ------------------------------------------------------------------ #
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_interval):
+            try:
+                for beat in self._drain() or []:
+                    try:
+                        rank, step, wall = beat
+                    except (TypeError, ValueError):
+                        continue
+                    self.observe(rank, step, wall)
+            except Exception:
+                # the hb queue dying mid-teardown must not kill the thread;
+                # silence simply ages the ranks out
+                pass
+            verdicts = self.check()
+            hung = sorted(r for r, v in verdicts.items() if v == HUNG)
+            if not hung:
+                continue
+            # a dead process shows up as an aged-out rank too — that is a
+            # crash, and the connection_lost path reports it better
+            if self._is_alive is not None:
+                try:
+                    hung = [r for r in hung if self._is_alive(r)]
+                except Exception:
+                    pass
+            if not hung:
+                continue
+            self._trip(hung)
+            return
+
+    def _trip(self, hung: List[int]) -> None:
+        detail = ", ".join(
+            f"rank {r} (last step {self.health[r].last_step}, "
+            f"silent {time.monotonic() - (self.health[r].last_beat or self.health[r].started):.1f}s)"
+            for r in hung
+        )
+        msg = (
+            f"{self._label}: hang detected — no heartbeat within "
+            f"hang_timeout={self.hang_timeout:.1f}s from {detail}; "
+            f"killing the worker group"
+        )
+        logger.error(msg)
+        # verdict BEFORE the kill: once workers start dying their futures
+        # settle as generic connection_lost, and the poller must already
+        # see the hang classification instead of racing against it
+        with self._verdict_lock:
+            self._verdict = WorkerHangError(msg)
+        if self._kill_group is not None:
+            try:
+                self._kill_group()
+            except Exception:
+                logger.exception("supervisor: worker-group kill failed")

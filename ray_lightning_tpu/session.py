@@ -1,45 +1,104 @@
-"""Per-worker singleton session: actor rank + queue handle back to driver.
+"""Per-worker singleton session: actor rank + queue handles back to driver.
 
 Direct role parity with the reference's session module (reference:
 ray_lightning/session.py:6-63): ``init_session`` is called exactly once per
 worker by the launcher's wrapping function; ``put_queue`` is how
 Tune callbacks tunnel ``report``/checkpoint lambdas back to the driver
-process.
+process. On top of that the session owns the worker side of health
+supervision: ``heartbeat(step)`` publishes ``(rank, step, wall_time)``
+ticks (throttled to ``heartbeat_interval``) that the driver's
+``runtime.supervisor`` consumes to tell live workers from hung ones.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Optional
+
+from ray_lightning_tpu.runtime import faults
+
+# how long a worker will wait to deliver a report before giving up with a
+# diagnosable error instead of blocking training forever
+PUT_TIMEOUT = 30.0
 
 
 class RayLightningSession:
-    def __init__(self, rank: int, queue: Optional[Any]):
+    def __init__(
+        self,
+        rank: int,
+        queue: Optional[Any],
+        heartbeat: Optional[Any] = None,
+        heartbeat_interval: float = 1.0,
+    ):
         self._rank = rank
         self._queue = queue
+        self._heartbeat = heartbeat
+        self._heartbeat_interval = max(float(heartbeat_interval), 0.01)
+        self._last_beat = 0.0  # monotonic; 0 => first tick always emits
 
     @property
     def rank(self) -> int:
         return self._rank
 
-    def put_queue(self, item: Callable) -> None:
+    def put_queue(self, item: Callable, timeout: float = PUT_TIMEOUT) -> None:
         if self._queue is None:
             raise ValueError(
                 "Trying to put something into a session queue, but no queue "
                 "was configured (not running under tune?)"
             )
-        self._queue.put(item)
+        # bounded: a full ring or a torn-down driver must surface as an
+        # error naming the rank, not as a worker frozen inside a callback
+        try:
+            self._queue.put(item, timeout=timeout)
+        except Exception as e:
+            raise RuntimeError(
+                f"worker rank {self._rank}: could not deliver an item to the "
+                f"driver queue within {timeout}s ({type(e).__name__}: {e}); "
+                "the driver may be gone or the queue full and undrained"
+            ) from e
+
+    def heartbeat(self, step: int, force: bool = False) -> None:
+        """Publish a liveness tick, at most one per ``heartbeat_interval``.
+
+        Best-effort and lossy by design: a dropped beat costs nothing (the
+        next one re-arms the watchdog) and a worker must never fail or stall
+        over its own liveness channel — so puts are bounded-short and every
+        failure is swallowed.
+        """
+        if self._heartbeat is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_beat < self._heartbeat_interval:
+            return
+        if faults.heartbeats_dropped(step):
+            return
+        self._last_beat = now
+        try:
+            self._heartbeat.put((self._rank, int(step), time.time()), timeout=1.0)
+        except Exception:
+            pass
 
 
 _session: Optional[RayLightningSession] = None
 
 
-def init_session(rank: int, queue: Optional[Any]) -> None:
+def init_session(
+    rank: int,
+    queue: Optional[Any],
+    heartbeat: Optional[Any] = None,
+    heartbeat_interval: float = 1.0,
+) -> None:
     global _session
     if _session is not None:
         raise ValueError(
             "A session already exists in this process; only one training "
             "session may be active per worker."
         )
-    _session = RayLightningSession(rank=rank, queue=queue)
+    _session = RayLightningSession(
+        rank=rank,
+        queue=queue,
+        heartbeat=heartbeat,
+        heartbeat_interval=heartbeat_interval,
+    )
 
 
 def reset_session() -> None:
@@ -64,3 +123,10 @@ def get_actor_rank() -> int:
 
 def put_queue(item: Callable) -> None:
     get_session().put_queue(item)
+
+
+def emit_heartbeat(step: int, force: bool = False) -> None:
+    """Module-level tick entry for the trainer: silently a no-op when no
+    session (in-process strategies) or no heartbeat channel is configured."""
+    if _session is not None:
+        _session.heartbeat(step, force=force)

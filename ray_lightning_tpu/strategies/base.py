@@ -42,10 +42,14 @@ class Strategy:
         mesh_spec: Optional[MeshSpec] = None,
         sharding_policy: Optional[ShardingPolicy] = None,
         dcn_grad_compression: Optional[str] = None,
+        heartbeat_interval: Optional[float] = None,
+        hang_timeout: Optional[float] = None,
     ):
         self.mesh_spec = mesh_spec or MeshSpec.data_parallel()
         self.sharding_policy = sharding_policy or ShardingPolicy.ddp()
         self._dcn_grad_compression = dcn_grad_compression
+        self._heartbeat_interval = heartbeat_interval
+        self._hang_timeout = hang_timeout
         self._mesh: Optional[Mesh] = None
         self._trainer = None
         self._module = None
@@ -69,6 +73,42 @@ class Strategy:
                 f"or 'int8', got {mode!r}"
             )
         return mode
+
+    @property
+    def heartbeat_interval(self) -> float:
+        """Seconds between worker liveness ticks (see runtime/supervisor.py).
+        Constructor argument wins; otherwise the ``RLT_HEARTBEAT_INTERVAL``
+        env var; default 1.0s."""
+        value = self._heartbeat_interval
+        if value is None:
+            value = os.environ.get("RLT_HEARTBEAT_INTERVAL")
+        if value in (None, ""):
+            return 1.0
+        value = float(value)
+        if value <= 0:
+            raise ValueError(
+                f"heartbeat_interval (RLT_HEARTBEAT_INTERVAL) must be > 0, "
+                f"got {value}"
+            )
+        return value
+
+    @property
+    def hang_timeout(self) -> Optional[float]:
+        """Seconds of worker heartbeat silence before the driver declares a
+        hang, kills the group and (with ``max_failures``) relaunches from
+        the newest checkpoint. ``None``/``0`` disables supervision (the
+        default). Constructor argument wins; otherwise ``RLT_HANG_TIMEOUT``."""
+        value = self._hang_timeout
+        if value is None:
+            value = os.environ.get("RLT_HANG_TIMEOUT")
+        if value in (None, ""):
+            return None
+        value = float(value)
+        if value < 0:
+            raise ValueError(
+                f"hang_timeout (RLT_HANG_TIMEOUT) must be >= 0, got {value}"
+            )
+        return value or None
 
     # ------------------------------------------------------------------ #
     # wiring
@@ -248,9 +288,15 @@ class XLAStrategy(Strategy):
         sharding_policy: Optional[ShardingPolicy] = None,
         devices: Optional[int] = None,
         dcn_grad_compression: Optional[str] = None,
+        heartbeat_interval: Optional[float] = None,
+        hang_timeout: Optional[float] = None,
     ):
         super().__init__(
-            mesh_spec, sharding_policy, dcn_grad_compression=dcn_grad_compression
+            mesh_spec,
+            sharding_policy,
+            dcn_grad_compression=dcn_grad_compression,
+            heartbeat_interval=heartbeat_interval,
+            hang_timeout=hang_timeout,
         )
         self._num_devices = devices
 

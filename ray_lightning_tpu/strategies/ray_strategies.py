@@ -58,10 +58,16 @@ class RayStrategy(XLAStrategy):
         dcn_grad_compression: Optional[str] = None,
         debug_collectives: bool = False,
         max_failures: int = 0,
+        heartbeat_interval: Optional[float] = None,
+        hang_timeout: Optional[float] = None,
         **kwargs: Any,
     ):
         super().__init__(
-            mesh_spec, sharding_policy, dcn_grad_compression=dcn_grad_compression
+            mesh_spec,
+            sharding_policy,
+            dcn_grad_compression=dcn_grad_compression,
+            heartbeat_interval=heartbeat_interval,
+            hang_timeout=hang_timeout,
         )
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")

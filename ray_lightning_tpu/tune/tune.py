@@ -110,6 +110,7 @@ class Trial:
     _actor: Any = None
     _future: Any = None
     _stopping: bool = False
+    _last_activity: float = 0.0  # monotonic time of start/last message
 
     @property
     def last_result(self) -> Dict[str, Any]:
@@ -271,9 +272,21 @@ def run(
     poll_interval: float = 0.05,
     verbose: int = 1,
     max_failures: int = 0,
+    hang_timeout: Optional[float] = None,
 ) -> ExperimentAnalysis:
+    """``hang_timeout``: seconds a RUNNING trial may go without any report
+    or checkpoint message before the controller declares it hung, force-kills
+    the trial actor, and counts the hang toward the trial's ``max_failures``
+    retries (resuming from its latest checkpoint) — the same semantics the
+    launcher's supervisor gives worker groups (runtime/supervisor.py). Must
+    exceed the trial's longest legitimate report interval, startup included.
+    Defaults to the ``RLT_HANG_TIMEOUT`` env var; None/0 disables."""
     if not rt.is_initialized():
         rt.init()
+    if hang_timeout is None:
+        env_hang = os.environ.get("RLT_HANG_TIMEOUT")
+        hang_timeout = float(env_hang) if env_hang else None
+    hang_timeout = hang_timeout or None
     scheduler = scheduler or FIFOScheduler()
     name = name or f"tune-{int(time.time())}"
     local_dir = os.path.abspath(local_dir or os.path.join(os.getcwd(), "tune_results"))
@@ -381,6 +394,7 @@ def run(
         trial._future = trial._actor.run.remote(
             trainable_bytes, trial.config, trial.trial_id, trial.logdir, queue.handle()
         )
+        trial._last_activity = time.monotonic()
 
     def stop_trial(trial: Trial, status: str):
         trial._stopping = True
@@ -429,10 +443,70 @@ def run(
             trial.config = new_config
             trial.status = "PENDING"
 
+    def resolve_failure(trial: Trial):
+        """A trial just entered ERROR (organic crash or hang verdict):
+        retry it per ray.tune's per-trial ``max_failures`` — from the
+        trial's latest checkpoint when one exists (the same restore
+        contract PBT exploit uses) — or finalize. Drain first: a
+        checkpoint written just before the failure may still sit in the
+        queue."""
+        if trial.num_failures >= max_failures:
+            # a retried trial keeps its scheduler state (ASHA rung entries
+            # must not double-count on resume), so on_complete only fires
+            # when the trial is truly final
+            scheduler.on_complete(trial.trial_id)
+            return
+        drain_messages()
+        trial.num_failures += 1
+        trial._future = None
+        trial.error = None
+        if trial.checkpoints:
+            trial.config = dict(
+                trial.config,
+                __checkpoint_path__=trial.checkpoints[-1]["path"],
+            )
+        if verbose:
+            print(
+                f"[tune] {trial.trial_id} errored; retry "
+                f"{trial.num_failures}/{max_failures}"
+            )
+        trial.status = "PENDING"
+
+    def sweep_hung_trials():
+        """Tune-level hang watchdog: a RUNNING trial whose future never
+        settles AND whose message stream has gone silent past hang_timeout
+        is force-killed and treated as a failure (counts toward
+        max_failures, resumes from its latest checkpoint)."""
+        now = time.monotonic()
+        for trial in trials:
+            if (
+                trial.status != "RUNNING"
+                or trial._future is None
+                or trial._future.done()
+            ):
+                continue
+            silent = now - trial._last_activity
+            if silent <= hang_timeout:
+                continue
+            trial.error = (
+                f"trial hung: no report or checkpoint for {silent:.1f}s "
+                f"(hang_timeout={hang_timeout}s, last iteration "
+                f"{trial.last_iteration}); trial actor killed"
+            )
+            if verbose:
+                print(f"[tune] {trial.trial_id} {trial.error}")
+            if trial._actor is not None:
+                rt.kill(trial._actor, force=True, timeout=2.0)
+                trial._actor = None
+            trial._future = None
+            trial.status = "ERROR"
+            resolve_failure(trial)
+
     def drain_messages():
         for msg in queue.get_all():
             kind, trial_id, payload, iteration = msg
             trial = by_id[trial_id]
+            trial._last_activity = time.monotonic()
             if kind == "report":
                 trial.results.append(payload)
                 trial.last_iteration = iteration
@@ -459,6 +533,8 @@ def run(
                 running.append(trial)
 
             drain_messages()
+            if hang_timeout:
+                sweep_hung_trials()
 
             # reap finished trials
             for trial in trials:
@@ -469,37 +545,12 @@ def run(
                     if trial._actor is not None:
                         rt.kill(trial._actor, timeout=2.0)
                         trial._actor = None
-                    retrying = (
-                        trial.status == "ERROR"
-                        and trial.num_failures < max_failures
-                    )
-                    if not retrying:
-                        # a retried trial keeps its scheduler state (ASHA
-                        # rung entries must not double-count on resume)
-                        scheduler.on_complete(trial.trial_id)
+                    if trial.status == "ERROR":
+                        # organic errors only — a scheduler-STOPped trial is
+                        # final by the scheduler's decision even if it errored
+                        resolve_failure(trial)
                     else:
-                        # ray.tune's per-trial max_failures: retry from the
-                        # trial's latest checkpoint when one exists (the
-                        # same restore contract PBT exploit uses). Organic
-                        # errors only — a scheduler-STOPped trial is final
-                        # by the scheduler's decision even if it errored.
-                        # Drain first: a checkpoint written just before
-                        # the crash may still sit in the queue.
-                        drain_messages()
-                        trial.num_failures += 1
-                        trial._future = None
-                        trial.error = None
-                        if trial.checkpoints:
-                            trial.config = dict(
-                                trial.config,
-                                __checkpoint_path__=trial.checkpoints[-1]["path"],
-                            )
-                        if verbose:
-                            print(
-                                f"[tune] {trial.trial_id} errored; retry "
-                                f"{trial.num_failures}/{max_failures}"
-                            )
-                        trial.status = "PENDING"
+                        scheduler.on_complete(trial.trial_id)
 
             if all(t.status in ("TERMINATED", "STOPPED", "ERROR") for t in trials):
                 # a trial's last reports may have landed in the queue after

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Full fault-injection matrix: every chaos-marked test (including the slow
+# ones tier-1 skips) plus the slow relaunch/retry path tests that predate
+# the RLT_FAULT harness. Extra args pass through to pytest, e.g.
+#   scripts/chaos.sh -k hang
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== chaos tests (fault injection + supervisor) =="
+python -m pytest tests/test_chaos.py -v -m chaos -p no:cacheprovider "$@"
+
+echo "== legacy relaunch/retry path (slow) =="
+python -m pytest tests/test_cli_and_checkpointing.py -v -m slow \
+    -k "retries or relaunch" -p no:cacheprovider "$@"

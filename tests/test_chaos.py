@@ -1,0 +1,616 @@
+"""Chaos tests: deterministic fault injection + driver-side hang supervision.
+
+Every failure here is SCRIPTED (``RLT_FAULT`` specs fired by the trainer's
+per-step health tick, fused to at-most-once by ``RLT_FAULT_FUSE``) so the
+tests assert exact recovery behavior — which step crashed, which checkpoint
+the relaunch resumed from, what the hang verdict said — instead of racing
+sleeps against the scheduler. The fast subset runs in tier-1; the full
+matrix (plus the pre-harness relaunch tests) is ``scripts/chaos.sh``.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+import types
+from concurrent.futures import Future
+
+import pytest
+
+import ray_lightning_tpu as rlt
+from ray_lightning_tpu import tune as rlt_tune
+from ray_lightning_tpu.runtime import faults
+from ray_lightning_tpu.runtime.actor import ActorError, ActorTimeout, CallFuture
+from ray_lightning_tpu.runtime.queue import Full, _actor_put
+from ray_lightning_tpu.runtime.supervisor import (
+    HUNG,
+    OK,
+    SLOW,
+    Supervisor,
+    WorkerHangError,
+    WorkerHealth,
+    classify,
+)
+from ray_lightning_tpu.session import RayLightningSession
+
+from tests.utils import BoringModel
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def clean_fault_env(monkeypatch):
+    """Fault-injection state must be exactly what the test scripts: no
+    inherited specs, no inherited rank, and a blank fuse box."""
+    monkeypatch.delenv(faults.FAULT_ENV, raising=False)
+    monkeypatch.delenv(faults.FUSE_ENV, raising=False)
+    monkeypatch.delenv("RLT_GLOBAL_RANK", raising=False)
+    return monkeypatch
+
+
+# ===================================================================== #
+# fault-spec grammar
+# ===================================================================== #
+def test_parse_faults_grammar():
+    specs = faults.parse_faults(
+        "rank1:hang@step3, rank0:slow@step2:1.5,"
+        "rank2:drop-heartbeats,rank0:crash@boot"
+    )
+    assert [(s.rank, s.kind, s.at, s.seconds) for s in specs] == [
+        (1, "hang", 3, 0.0),
+        (0, "slow", 2, 1.5),
+        (2, "drop-heartbeats", 0, 0.0),  # silent-from-birth default
+        (0, "crash", "boot", 0.0),
+    ]
+    assert specs[0].fuse_id == "rank1-hang-at3"
+    assert faults.parse_faults(None) == []
+    assert faults.parse_faults("") == []
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "rank0:explode@step1",  # unknown kind
+        "crash@step3",  # missing rank
+        "rank0:crash",  # crash needs a place to fire
+        "rank0:hang",  # so does hang
+        "rank0:slow@step2",  # slow needs a stall length
+        "rank0:slow@boot:1.5",  # boot is crash/hang only
+        "rank0:drop-heartbeats@boot",
+        "rank0:crash@step-3",  # negative step
+    ],
+)
+def test_parse_faults_rejects_malformed(bad):
+    with pytest.raises(ValueError, match="spec"):
+        faults.parse_faults(bad)
+
+
+def test_step_fault_matches_rank_and_step(clean_fault_env):
+    exits = []
+    clean_fault_env.setattr(faults.os, "_exit", lambda code: exits.append(code))
+    clean_fault_env.setenv(faults.FAULT_ENV, "rank1:crash@step5")
+    faults.fire_step_faults(5)  # rankless process defaults to rank 0
+    assert exits == []
+    clean_fault_env.setenv("RLT_GLOBAL_RANK", "1")
+    faults.fire_step_faults(4)  # right rank, wrong step
+    assert exits == []
+    faults.fire_step_faults(5)
+    assert exits == [1]
+
+
+def test_fuse_makes_faults_fire_at_most_once(clean_fault_env, tmp_path):
+    sleeps = []
+    clean_fault_env.setattr(faults.time, "sleep", lambda s: sleeps.append(s))
+    clean_fault_env.setenv(faults.FAULT_ENV, "rank0:slow@step2:1.25")
+    clean_fault_env.setenv(faults.FUSE_ENV, str(tmp_path / "fuses"))
+    faults.fire_step_faults(2)
+    assert sleeps == [1.25]
+    # the marker is on disk — a relaunched process replaying step 2 skips it
+    assert os.path.exists(str(tmp_path / "fuses" / "rank0-slow-at2"))
+    faults.fire_step_faults(2)
+    assert sleeps == [1.25]
+    # without a fuse dir the fault is a pure function of (rank, step)
+    clean_fault_env.delenv(faults.FUSE_ENV)
+    faults.fire_step_faults(2)
+    assert sleeps == [1.25, 1.25]
+
+
+def test_boot_faults_require_explicit_rank(clean_fault_env):
+    """Queue actors / node agents / trial runners boot through the same
+    serve_instance and have no rank — they must never match rank-0 specs."""
+    exits = []
+    clean_fault_env.setattr(faults.os, "_exit", lambda code: exits.append(code))
+    clean_fault_env.setenv(faults.FAULT_ENV, "rank0:crash@boot")
+    faults.fire_boot_faults()  # no RLT_GLOBAL_RANK -> no-op
+    assert exits == []
+    clean_fault_env.setenv("RLT_GLOBAL_RANK", "0")
+    faults.fire_boot_faults()
+    assert exits == [1]
+
+
+def test_heartbeats_dropped_window(clean_fault_env):
+    clean_fault_env.setenv(faults.FAULT_ENV, "rank0:drop-heartbeats@step2")
+    assert not faults.heartbeats_dropped(0)
+    assert not faults.heartbeats_dropped(1)
+    # silence starts at the spec's step and never resumes
+    assert faults.heartbeats_dropped(2)
+    assert faults.heartbeats_dropped(7)
+    clean_fault_env.setenv("RLT_GLOBAL_RANK", "1")
+    assert not faults.heartbeats_dropped(7)
+
+
+# ===================================================================== #
+# supervisor classification + trip sequence
+# ===================================================================== #
+def test_classify_verdicts():
+    h = WorkerHealth(rank=0, started=100.0)
+    # pre-first-heartbeat silence is bring-up, not a hang ...
+    assert classify(h, now=1e9, hang_timeout=5.0) == OK
+    # ... unless startup_timeout explicitly bounds it
+    assert classify(h, now=100.0 + 31, hang_timeout=5.0, startup_timeout=30) == HUNG
+    h.last_beat = 200.0
+    assert classify(h, now=200.5, hang_timeout=5.0) == OK
+    assert classify(h, now=203.0, hang_timeout=5.0) == SLOW  # > 50% of timeout
+    assert classify(h, now=205.5, hang_timeout=5.0) == HUNG
+
+
+def test_supervisor_check_warns_straggler_once():
+    sup = Supervisor(num_workers=1, drain=list, hang_timeout=10.0)
+    sup.observe(0, step=3, wall_time=time.time())
+    beat = sup.health[0].last_beat
+    verdicts = sup.check(now=beat + 6.0)
+    assert verdicts == {0: SLOW}
+    assert sup.health[0].warned_slow
+    # a fresh tick ends the incident and re-arms the warning
+    sup.observe(0, step=4, wall_time=time.time())
+    assert not sup.health[0].warned_slow
+    assert sup.check(now=sup.health[0].last_beat + 1.0) == {0: OK}
+
+
+def test_supervisor_trips_only_on_armed_silent_rank():
+    """rank 0 keeps beating, rank 1 beats once then goes silent: only rank 1
+    trips, the verdict names it with its last step, and the kill callback
+    runs AFTER the verdict is readable (process_results depends on that
+    ordering to classify the failure as a hang, not connection loss)."""
+    beats = []
+    lock = threading.Lock()
+
+    def drain():
+        with lock:
+            out, beats[:] = beats[:], []
+        return out
+
+    seen_at_kill = {}
+
+    def kill_group():
+        seen_at_kill["tripped"] = sup.tripped
+
+    sup = Supervisor(
+        num_workers=2,
+        drain=drain,
+        hang_timeout=0.3,
+        heartbeat_interval=0.05,
+        kill_group=kill_group,
+        is_alive=lambda rank: True,
+    )
+    sup.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        first = True
+        while time.monotonic() < deadline and not sup.tripped:
+            with lock:
+                beats.append((0, 10, time.time()))
+                if first:
+                    beats.append((1, 3, time.time()))
+                    first = False
+            time.sleep(0.02)
+        assert sup.tripped, "supervisor never tripped on the silent rank"
+        with pytest.raises(WorkerHangError) as ei:
+            sup.poll()
+        msg = str(ei.value)
+        assert "rank 1" in msg and "last step 3" in msg
+        assert "rank 0" not in msg  # the live rank is not accused
+        assert ei.value.is_process_failure  # relaunch loop treats it as retryable
+        assert seen_at_kill == {"tripped": True}
+    finally:
+        sup.stop()
+
+
+def test_supervisor_leaves_dead_processes_to_crash_path():
+    """An aged-out rank whose process is GONE is a crash; connection_lost
+    reports it better, so the supervisor must not trip."""
+    killed = []
+    sup = Supervisor(
+        num_workers=1,
+        drain=list,
+        hang_timeout=0.1,
+        heartbeat_interval=0.05,
+        kill_group=lambda: killed.append(True),
+        is_alive=lambda rank: False,
+    )
+    sup.observe(0, step=1, wall_time=time.time())
+    sup.start()
+    try:
+        time.sleep(0.5)
+        assert not sup.tripped
+        assert not killed
+        sup.poll()  # no verdict -> returns quietly
+    finally:
+        sup.stop()
+
+
+def test_supervisor_never_trips_before_first_heartbeat():
+    """Bring-up (spawn, jax.distributed handshake, first XLA compile) has
+    unbounded latency; the watchdog arms per-rank on the first beat."""
+    sup = Supervisor(num_workers=2, drain=list, hang_timeout=0.1,
+                     heartbeat_interval=0.05)
+    sup.start()
+    try:
+        time.sleep(0.4)
+        assert not sup.tripped
+    finally:
+        sup.stop()
+
+
+def test_supervisor_clamps_timeout_to_heartbeat_interval():
+    sup = Supervisor(num_workers=1, drain=list, hang_timeout=0.1,
+                     heartbeat_interval=2.0)
+    assert sup.hang_timeout == 4.0  # 2 heartbeat periods minimum
+
+
+# ===================================================================== #
+# bounded waits: ActorTimeout, send failure, queue puts
+# ===================================================================== #
+def test_call_future_timeout_is_rewaitable():
+    fake_actor = types.SimpleNamespace(name="rlt-worker-3")
+    fut: Future = Future()
+    cf = CallFuture(fut, fake_actor, "execute")
+    for _ in range(2):  # an expired wait leaves the call poll-able
+        with pytest.raises(ActorTimeout) as ei:
+            cf.result(timeout=0.01)
+        assert isinstance(ei.value, TimeoutError)
+        assert isinstance(ei.value, ActorError)
+        assert not ei.value.is_process_failure  # the call may still finish
+        assert "rlt-worker-3.execute" in str(ei.value)
+    fut.set_result(("ok", 41))
+    assert cf.result(timeout=1.0) == 41
+
+
+def test_connection_send_failure_settles_future(monkeypatch):
+    """A send that dies on the wire must settle its future as
+    connection_lost immediately — not leak a pending entry that nobody
+    will ever answer (the pre-fix behavior: result() blocked forever)."""
+    from ray_lightning_tpu.runtime import actor as actor_mod
+
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.bind(("127.0.0.1", 0))
+    server.listen(1)
+
+    def serve():
+        try:
+            s, _ = server.accept()
+            actor_mod._recv_msg(s)  # consume the authkey, then just hold
+            while True:
+                actor_mod._recv_msg(s)
+        except (ConnectionError, OSError):
+            pass
+
+    threading.Thread(target=serve, daemon=True).start()
+    conn = actor_mod._Connection(server.getsockname(), b"k")
+    try:
+        monkeypatch.setattr(
+            actor_mod, "_send_msg",
+            lambda sock, payload: (_ for _ in ()).throw(OSError("wire cut")),
+        )
+        fut = conn.call("ping", (), {})
+        assert fut.done()
+        assert fut.result(timeout=1.0)[0] == "connection_lost"
+        assert not conn._pending
+    finally:
+        conn.close()
+        server.close()
+
+
+class _FakeReplyFuture:
+    def __init__(self, exc=None, value=True):
+        self._exc, self._value = exc, value
+
+    def result(self, timeout=None):
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class _FakeQueueActor:
+    name = "rlt-queue-7"
+
+    def __init__(self, exc=None, value=True):
+        self._exc, self._value = exc, value
+
+    def call(self, method, *args):
+        assert method == "put"
+        return _FakeReplyFuture(self._exc, self._value)
+
+
+def test_bounded_queue_put_names_the_actor():
+    with pytest.raises(Full, match=r"rlt-queue-7.*no reply within 2"):
+        _actor_put(_FakeQueueActor(exc=ActorTimeout("slow")), "item", 2)
+    with pytest.raises(RuntimeError, match="rlt-queue-7.*put failed"):
+        _actor_put(_FakeQueueActor(exc=ActorError("boom")), "item", 2)
+    with pytest.raises(Full, match="rlt-queue-7.*full"):
+        _actor_put(_FakeQueueActor(value=False), "item", 2)
+    _actor_put(_FakeQueueActor(), "item", 2)  # happy path
+
+
+def test_session_put_queue_error_names_rank():
+    class _Exploding:
+        def put(self, item, timeout=None):
+            raise Full("ring full")
+
+    sess = RayLightningSession(rank=3, queue=_Exploding())
+    with pytest.raises(RuntimeError, match="worker rank 3.*Full: ring full"):
+        sess.put_queue(lambda: None, timeout=0.1)
+
+
+def test_session_heartbeat_throttles_and_never_raises(clean_fault_env):
+    puts = []
+
+    class _Channel:
+        def put(self, item, timeout=None):
+            puts.append(item)
+
+    sess = RayLightningSession(
+        rank=2, queue=None, heartbeat=_Channel(), heartbeat_interval=60.0
+    )
+    sess.heartbeat(0)
+    sess.heartbeat(1)  # throttled: inside the interval
+    assert [p[:2] for p in puts] == [(2, 0)]
+    sess.heartbeat(1, force=True)
+    assert [p[:2] for p in puts] == [(2, 0), (2, 1)]
+
+    # drop-heartbeats keeps the worker alive but the channel dark
+    clean_fault_env.setenv(faults.FAULT_ENV, "rank2:drop-heartbeats@step5")
+    clean_fault_env.setenv("RLT_GLOBAL_RANK", "2")
+    sess.heartbeat(5, force=True)
+    assert len(puts) == 2
+
+    # a dying channel must never take the worker down with it
+    class _Dying:
+        def put(self, item, timeout=None):
+            raise OSError("driver gone")
+
+    RayLightningSession(rank=0, queue=None, heartbeat=_Dying()).heartbeat(0)
+
+    # no channel configured -> free no-op
+    RayLightningSession(rank=0, queue=None).heartbeat(0)
+
+
+def test_strategy_knob_precedence(monkeypatch):
+    monkeypatch.delenv("RLT_HANG_TIMEOUT", raising=False)
+    monkeypatch.delenv("RLT_HEARTBEAT_INTERVAL", raising=False)
+    s = rlt.RayStrategy(num_workers=1)
+    assert s.hang_timeout is None  # supervision is opt-in
+    assert s.heartbeat_interval == 1.0
+
+    monkeypatch.setenv("RLT_HANG_TIMEOUT", "3")
+    monkeypatch.setenv("RLT_HEARTBEAT_INTERVAL", "0.5")
+    assert rlt.RayStrategy(num_workers=1).hang_timeout == 3.0
+    assert rlt.RayStrategy(num_workers=1).heartbeat_interval == 0.5
+
+    # constructor beats environment
+    s = rlt.RayStrategy(num_workers=1, hang_timeout=7.5, heartbeat_interval=0.2)
+    assert (s.hang_timeout, s.heartbeat_interval) == (7.5, 0.2)
+    # 0 disables, even over an env var
+    assert rlt.RayStrategy(num_workers=1, hang_timeout=0).hang_timeout is None
+
+    with pytest.raises(ValueError, match="heartbeat_interval"):
+        _ = rlt.RayStrategy(num_workers=1, heartbeat_interval=-1).heartbeat_interval
+    with pytest.raises(ValueError, match="hang_timeout"):
+        _ = rlt.RayStrategy(num_workers=1, hang_timeout=-2).hang_timeout
+
+
+# ===================================================================== #
+# end-to-end: scripted faults through real worker groups
+# ===================================================================== #
+class _EpochLogModel(BoringModel):
+    """Logs each rank-0 epoch start to a file the driver can read back —
+    the proof of WHERE a relaunch resumed."""
+
+    def __init__(self, log_path):
+        super().__init__()
+        self._log_path = log_path
+
+    def on_train_epoch_start(self):
+        if os.environ.get("RLT_GLOBAL_RANK", "0") == "0":
+            with open(self._log_path, "a") as f:
+                f.write(f"{self.trainer.current_epoch}\n")
+
+
+def _read_epochs(path):
+    with open(path) as f:
+        return [int(line) for line in f.read().split()]
+
+
+def _chaos_trainer(tmp_root, strategy, max_epochs=3):
+    ckpt_cb = rlt.ModelCheckpoint(
+        dirpath=os.path.join(tmp_root, "ckpts"), save_last=True
+    )
+    return rlt.Trainer(
+        max_epochs=max_epochs, strategy=strategy, logger=False,
+        callbacks=[ckpt_cb], seed=0, default_root_dir=tmp_root,
+        limit_train_batches=2, limit_val_batches=1, num_sanity_val_steps=0,
+        enable_progress_bar=False,
+    )
+
+
+def test_crash_at_step_resumes_from_fresh_checkpoint(tmp_root, monkeypatch):
+    """rank0:crash@step3 (epoch 1, second batch): the fused crash fires
+    once, the relaunch resumes from the epoch-0 checkpoint — epoch 1 re-runs
+    but epoch 0 does NOT — and training lands on the uninjected final step."""
+    monkeypatch.setenv("RLT_FAULT", "rank0:crash@step3")
+    monkeypatch.setenv("RLT_FAULT_FUSE", os.path.join(tmp_root, "fuses"))
+    log = os.path.join(tmp_root, "epochs")
+
+    strategy = rlt.RayStrategy(
+        num_workers=1, platform="cpu", devices_per_worker=1, max_failures=1
+    )
+    trainer = _chaos_trainer(tmp_root, strategy)
+    trainer.fit(_EpochLogModel(log))
+
+    assert os.path.exists(os.path.join(tmp_root, "fuses", "rank0-crash-at3"))
+    # epoch 1 started, crashed at its second step, re-ran after the resume
+    assert _read_epochs(log) == [0, 1, 1, 2]
+    assert trainer.current_epoch == 3
+    assert trainer.global_step == 6  # same final step as an uninjected run
+
+
+def test_hang_detected_group_killed_and_relaunched(tmp_root, monkeypatch):
+    """The acceptance scenario: a worker hangs at step 3 inside training —
+    no crash, no settled future — and without supervision the driver would
+    wait forever. With hang_timeout set the supervisor notices the heartbeat
+    silence, hard-kills the group, classifies it as a hang, and the relaunch
+    resumes from the checkpoint — finishing at the same final step as an
+    uninjected run. (One worker: this jaxlib cannot run multiprocess
+    collectives on the CPU backend, so the cross-rank variant — a silent
+    rank starving its live peers — is covered at the supervisor level by
+    test_supervisor_trips_only_on_armed_silent_rank.)"""
+    monkeypatch.setenv("RLT_FAULT", "rank0:hang@step3")
+    monkeypatch.setenv("RLT_FAULT_FUSE", os.path.join(tmp_root, "fuses"))
+    log = os.path.join(tmp_root, "epochs")
+
+    strategy = rlt.RayStrategy(
+        num_workers=1, platform="cpu", devices_per_worker=1,
+        max_failures=1, hang_timeout=2.5, heartbeat_interval=0.1,
+    )
+    trainer = _chaos_trainer(tmp_root, strategy)
+    trainer.fit(_EpochLogModel(log))
+
+    assert os.path.exists(os.path.join(tmp_root, "fuses", "rank0-hang-at3"))
+    assert _read_epochs(log) == [0, 1, 1, 2]  # resumed from epoch-0 ckpt
+    assert trainer.current_epoch == 3
+    assert trainer.global_step == 6
+
+
+def test_hang_with_max_failures_zero_raises(tmp_root, monkeypatch):
+    """Without the retry budget the hang must surface as a clear, classified
+    error — not a silent forever-block and not a generic connection loss."""
+    monkeypatch.setenv("RLT_FAULT", "rank0:hang@step1")
+    strategy = rlt.RayStrategy(
+        num_workers=1, platform="cpu", devices_per_worker=1,
+        max_failures=0, hang_timeout=2.0, heartbeat_interval=0.1,
+    )
+    trainer = _chaos_trainer(tmp_root, strategy, max_epochs=1)
+    with pytest.raises(WorkerHangError, match="hang detected.*rank 0"):
+        trainer.fit(BoringModel())
+
+
+@pytest.mark.slow
+def test_relaunch_ignores_stale_pre_run_checkpoint(tmp_root, monkeypatch):
+    """A crash BEFORE this run saved anything must restart from scratch —
+    the mtime fence has to reject a leftover .ckpt from a previous run in
+    the same dirpath. The stale file is garbage bytes: picking it would
+    blow up the restore, so surviving it proves it was never considered."""
+    monkeypatch.setenv("RLT_FAULT", "rank0:crash@step1")
+    monkeypatch.setenv("RLT_FAULT_FUSE", os.path.join(tmp_root, "fuses"))
+    log = os.path.join(tmp_root, "epochs")
+
+    ckpt_dir = os.path.join(tmp_root, "ckpts")
+    os.makedirs(ckpt_dir)
+    stale = os.path.join(ckpt_dir, "stale.ckpt")
+    with open(stale, "wb") as f:
+        f.write(b"not a checkpoint")
+    past = time.time() - 60
+    os.utime(stale, (past, past))
+
+    strategy = rlt.RayStrategy(
+        num_workers=1, platform="cpu", devices_per_worker=1, max_failures=1
+    )
+    trainer = _chaos_trainer(tmp_root, strategy)
+    trainer.fit(_EpochLogModel(log))
+
+    # epoch 0 ran twice: crash at step 1, then a from-scratch relaunch
+    assert _read_epochs(log) == [0, 0, 1, 2]
+    assert trainer.global_step == 6
+
+
+@pytest.mark.slow
+def test_crash_at_boot_is_retryable_startup_failure(tmp_root, monkeypatch):
+    """@boot faults fire in serve_instance before the ready handshake, so
+    the spawner sees a startup failure (not a wedged actor) and the
+    relaunch loop retries it like any other process failure."""
+    monkeypatch.setenv("RLT_FAULT", "rank0:crash@boot")
+    monkeypatch.setenv("RLT_FAULT_FUSE", os.path.join(tmp_root, "fuses"))
+
+    strategy = rlt.RayStrategy(
+        num_workers=1, platform="cpu", devices_per_worker=1, max_failures=1
+    )
+    trainer = _chaos_trainer(tmp_root, strategy, max_epochs=1)
+    model = BoringModel()
+    trainer.fit(model)
+    assert os.path.exists(os.path.join(tmp_root, "fuses", "rank0-crash-atboot"))
+    assert model.params is not None
+    assert trainer.global_step == 2
+
+
+# ===================================================================== #
+# tune: hung trials count toward per-trial max_failures
+# ===================================================================== #
+def _hang_once_trainable(config):
+    import os
+    import time
+
+    from ray_lightning_tpu.tune.session import get_trial_session
+
+    sess = get_trial_session()
+    marker = os.path.join(config["root"], "hung_once")
+    sess.report(loss=1.0)
+    if not os.path.exists(marker):
+        open(marker, "w").close()
+        while True:  # a real hang: only an external kill ends it
+            time.sleep(60)
+    sess.report(loss=0.5)
+
+
+@pytest.mark.slow
+def test_tune_hang_sweep_counts_toward_max_failures(tmp_root):
+    """A trial that reports once then wedges: the hang sweep kills its
+    actor, the failure counts against max_failures, and the retry (finding
+    the marker on disk) completes the trial."""
+    analysis = rlt_tune.run(
+        _hang_once_trainable,
+        config={"root": tmp_root},
+        num_samples=1,
+        metric="loss",
+        mode="min",
+        local_dir=tmp_root,
+        name="exp_hang",
+        trial_env={"JAX_PLATFORMS": "cpu"},
+        verbose=0,
+        max_failures=1,
+        hang_timeout=2.0,
+    )
+    (trial,) = analysis.trials
+    assert trial.status == "TERMINATED"
+    assert trial.num_failures == 1
+    assert trial.error is None  # the successful retry cleared the verdict
+
+
+@pytest.mark.slow
+def test_tune_hang_without_retry_is_final_error(tmp_root):
+    analysis = rlt_tune.run(
+        _hang_once_trainable,
+        config={"root": tmp_root},
+        num_samples=1,
+        metric="loss",
+        mode="min",
+        local_dir=tmp_root,
+        name="exp_hang_fatal",
+        trial_env={"JAX_PLATFORMS": "cpu"},
+        verbose=0,
+        hang_timeout=2.0,
+    )
+    (trial,) = analysis.trials
+    assert trial.status == "ERROR"
+    assert "hung" in trial.error
+    assert "hang_timeout" in trial.error
