@@ -314,6 +314,20 @@ def _child(args: argparse.Namespace) -> int:
     final_loss = float(loss)  # forces completion of the whole chain
     elapsed = time.perf_counter() - t0
 
+    # per-step distribution for the observability report: a handful of
+    # fully-synced steps (float(loss) blocks) so p50/p90 are honest device
+    # times, not async-dispatch enqueue times. Kept small — the throughput
+    # number above stays the pipelined measurement.
+    from ray_lightning_tpu.observability.aggregator import step_time_stats
+
+    step_times = []
+    for _ in range(min(args.steps, 8)):
+        ts = time.perf_counter()
+        params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        step_times.append(time.perf_counter() - ts)
+    step_dist = step_time_stats({0: step_times})
+
     tokens_per_step = batch * seq
     tokens_per_sec = tokens_per_step * args.steps / elapsed
     flops_per_token = cfg.flops_per_token()
@@ -341,6 +355,7 @@ def _child(args: argparse.Namespace) -> int:
             "final_loss": round(final_loss, 4),
             "platform": dev.platform,
             "device_kind": getattr(dev, "device_kind", "?"),
+            **step_dist,
         },
     }
     if matmul_ceiling is not None:

@@ -10,6 +10,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ray_lightning_tpu import observability as obs
 from ray_lightning_tpu.callbacks.base import Callback
 
 
@@ -126,6 +127,16 @@ class ModelCheckpoint(Callback):
                 ):
                     self.best_model_score = score
                     self.best_model_path = path
+                    # a new best is the checkpoint event worth seeing on
+                    # the trace timeline; plain saves already span via
+                    # trainer.save_checkpoint
+                    obs.event(
+                        "checkpoint/new_best",
+                        step=trainer.global_step,
+                        monitor=self.monitor,
+                        score=float(score),
+                        path=path,
+                    )
                 # trim in case save_top_k shrank
                 while self.save_top_k != -1 and len(self.best_k_models) > self.save_top_k:
                     worst = self._worst_kept()

@@ -16,6 +16,7 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from ray_lightning_tpu import observability as obs
 from ray_lightning_tpu.callbacks.base import Callback
 
 try:
@@ -89,7 +90,17 @@ class OrbaxModelCheckpoint(Callback):
                 "aux": np.frombuffer(aux, dtype=np.uint8).copy(),
             }
         )
-        self._manager.save(trainer.global_step, args=ocp.args.Composite(**items))
+        # the span covers only the (usually short) async dispatch; the
+        # actual shard writes overlap with subsequent training steps
+        with obs.span(
+            "checkpoint/orbax_save", step=trainer.global_step, dir=self.dirpath
+        ):
+            self._manager.save(
+                trainer.global_step, args=ocp.args.Composite(**items)
+            )
+        reg = obs.registry()
+        if reg is not None:
+            reg.counter("rlt_checkpoint_saves_total", format="orbax").inc()
 
     def on_fit_end(self, trainer, module) -> None:
         if self._manager is not None:
@@ -121,6 +132,13 @@ class OrbaxModelCheckpoint(Callback):
         """
         dirpath = os.path.abspath(dirpath)
         manager = ocp.CheckpointManager(dirpath)
+        with obs.span("checkpoint/orbax_restore", dir=dirpath):
+            return OrbaxModelCheckpoint._restore_with(
+                manager, dirpath, params_template, opt_state_template, step
+            )
+
+    @staticmethod
+    def _restore_with(manager, dirpath, params_template, opt_state_template, step):
         try:
             step = step if step is not None else manager.latest_step()
             if step is None:

@@ -8,13 +8,16 @@ against the chip's peak matmul throughput.
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
 import jax
 import numpy as np
 
+from ray_lightning_tpu import observability as _obs
 from ray_lightning_tpu.callbacks.base import Callback
+from ray_lightning_tpu.utils.common import rank_zero_warn
 
 # Peak bf16 matmul TFLOP/s per chip for common TPU generations (public specs).
 _PEAK_TFLOPS = {
@@ -27,8 +30,27 @@ _PEAK_TFLOPS = {
 _DEFAULT_PEAK_TFLOPS = 197.0
 _CPU_ESTIMATE_TFLOPS = 0.1  # so tests on CPU produce finite MFU numbers
 
+PEAK_TFLOPS_ENV = "RLT_PEAK_TFLOPS"
+
 
 def detect_peak_tflops() -> float:
+    """Peak bf16 TFLOP/s per chip. ``RLT_PEAK_TFLOPS`` overrides detection
+    (the only correct source for chips this table doesn't know); a chip
+    missing from the table falls back with a warning instead of silently
+    reporting v5e-calibrated MFU."""
+    override = os.environ.get(PEAK_TFLOPS_ENV)
+    if override:
+        try:
+            value = float(override)
+            if value > 0:
+                return value
+            rank_zero_warn(
+                "%s must be > 0, got %r; ignoring", PEAK_TFLOPS_ENV, override
+            )
+        except ValueError:
+            rank_zero_warn(
+                "%s is not a number: %r; ignoring", PEAK_TFLOPS_ENV, override
+            )
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "").lower()
     if dev.platform == "cpu":
@@ -36,6 +58,13 @@ def detect_peak_tflops() -> float:
     for key, tflops in _PEAK_TFLOPS.items():
         if key in kind:
             return tflops
+    rank_zero_warn(
+        "unknown accelerator %r: assuming %.0f peak TFLOP/s for MFU; set "
+        "%s to the chip's real peak",
+        kind,
+        _DEFAULT_PEAK_TFLOPS,
+        PEAK_TFLOPS_ENV,
+    )
     return _DEFAULT_PEAK_TFLOPS
 
 
@@ -108,12 +137,29 @@ class ThroughputMonitor(Callback):
         if leaves:
             jax.block_until_ready(leaves)
         self._record_interval(time.perf_counter())
+        self._publish_telemetry(trainer)
         if (
             self.log_every_n_steps
             and trainer.global_step % self.log_every_n_steps == 0
             and trainer.logger is not None
         ):
             trainer.logger.log_metrics(self.summary(trainer), step=trainer.global_step)
+
+    def _publish_telemetry(self, trainer) -> None:
+        """Push the rolling throughput numbers into the flight recorder's
+        registry so the driver aggregator can report cluster samples/sec
+        and MFU. Runs only at sync points; one None check when disabled."""
+        reg = _obs.registry()
+        if reg is None:
+            return
+        summary = self.summary(trainer)
+        for name, key in (
+            ("rlt_samples_per_sec", "samples_per_sec"),
+            ("rlt_train_mfu", "train_mfu"),
+            ("rlt_tokens_per_sec_per_chip", "tokens_per_sec_per_chip"),
+        ):
+            if key in summary:
+                reg.gauge(name).set(summary[key])
 
     def summary(self, trainer) -> dict:
         if not self._times or not self._batch_size:
@@ -141,3 +187,4 @@ class ThroughputMonitor(Callback):
         summary = self.summary(trainer)
         for k, v in summary.items():
             trainer.callback_metrics[k] = np.asarray(v)
+        self._publish_telemetry(trainer)

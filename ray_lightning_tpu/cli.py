@@ -7,6 +7,10 @@ ray_lightning/tests/test_lightning_cli.py:9-27). This is a dependency-free
 equivalent: ``--model.lr 0.01 --trainer.max_epochs 3
 --strategy.class_name RayStrategy --strategy.num_workers 2`` or
 ``--config cfg.yaml`` with the same dotted keys.
+
+Also the home of the ``rlt`` operational entry points: ``python -m
+ray_lightning_tpu.cli top --dir <run>/telemetry`` renders the driver
+aggregator's live summary (see docs/observability.md).
 """
 from __future__ import annotations
 
@@ -165,3 +169,42 @@ class LightningCLI:
 
         if run:
             self.trainer.fit(self.model, datamodule=self.datamodule)
+
+
+# --------------------------------------------------------------------- #
+# operational subcommands
+# --------------------------------------------------------------------- #
+def main(argv: Optional[list] = None) -> int:
+    """``rlt``-style tool dispatch. Currently: ``top`` — live view of a
+    run's telemetry directory (summary.json + events.jsonl, written by the
+    driver aggregator when ``RLT_TELEMETRY=1``)."""
+    parser = argparse.ArgumentParser(prog="rlt")
+    sub = parser.add_subparsers(dest="command")
+    top = sub.add_parser(
+        "top", help="live cluster summary from a run's telemetry directory"
+    )
+    top.add_argument(
+        "--dir",
+        required=True,
+        help="telemetry directory (e.g. <default_root_dir>/telemetry)",
+    )
+    top.add_argument(
+        "--follow",
+        "-f",
+        action="store_true",
+        help="keep refreshing until interrupted",
+    )
+    top.add_argument(
+        "--interval", type=float, default=2.0, help="refresh period seconds"
+    )
+    args = parser.parse_args(argv)
+    if args.command == "top":
+        from ray_lightning_tpu.observability.aggregator import render_top
+
+        return render_top(args.dir, follow=args.follow, interval=args.interval)
+    parser.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

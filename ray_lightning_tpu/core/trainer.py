@@ -31,6 +31,7 @@ import numpy as np
 import optax
 from flax import serialization as flax_serialization
 
+from ray_lightning_tpu import observability as obs
 from ray_lightning_tpu.callbacks.base import Callback
 from ray_lightning_tpu.callbacks.checkpoint import ModelCheckpoint
 from ray_lightning_tpu.core.data import DataLoader, DistributedSampler, ensure_loader
@@ -228,6 +229,10 @@ class Trainer:
         self._dcn_ctx = None
         self._rng_root = None
         self._datamodule = None
+        # flight recorder handle: None when telemetry is off, so every
+        # instrumented hot path reduces to one attribute check (`if rec`)
+        self._obs = None
+        self._first_step_dispatched = False
         self._restored_ckpt: Optional[Dict[str, Any]] = None
         # set by the launcher on a max_failures relaunch: newest checkpoint
         # the crashed worker group wrote ("orbax:<dir>" for the sharded path)
@@ -833,6 +838,11 @@ class Trainer:
     # fit implementation (runs on driver, or inside a worker actor)
     # ------------------------------------------------------------------ #
     def _fit_impl(self, model, train_dataloaders, val_dataloaders, datamodule, ckpt_path):
+        if getattr(self.strategy, "telemetry", False):
+            obs.enable()
+        self._obs = obs.get_recorder()
+        self._first_step_dispatched = False
+        _setup_wall, _setup_t0 = time.time(), time.perf_counter()
         seed = seed_everything(self.seed)
         self._datamodule = datamodule
         self.strategy.setup_environment()
@@ -914,6 +924,7 @@ class Trainer:
             )
         if self._dcn_ctx is not None:
             self._opt_state = self._stack_ef_residual(self._opt_state)
+            self._publish_dcn_telemetry(host_params)
 
         relaunch_ckpt = getattr(self, "_relaunch_ckpt_path", None)
         if relaunch_ckpt is not None:
@@ -921,18 +932,26 @@ class Trainer:
             # ckpt_path the original fit() call carried
             ckpt_path = relaunch_ckpt
         if ckpt_path is not None:
-            if ckpt_path.startswith("orbax@"):
-                # "orbax@<step>:<dir>" — a step pinned by the crash-relaunch
-                # scanner so a stale step in a reused dir can't win
-                step_s, d = ckpt_path[len("orbax@"):].split(":", 1)
-                self._restore_orbax(d, step=int(step_s))
-            elif ckpt_path.startswith("orbax:"):
-                self._restore_orbax(ckpt_path[len("orbax:"):])
-            else:
-                self._restore_checkpoint(ckpt_path)
+            with obs.span("checkpoint/restore", path=ckpt_path):
+                if ckpt_path.startswith("orbax@"):
+                    # "orbax@<step>:<dir>" — a step pinned by the crash-
+                    # relaunch scanner so a stale step in a reused dir
+                    # can't win
+                    step_s, d = ckpt_path[len("orbax@"):].split(":", 1)
+                    self._restore_orbax(d, step=int(step_s))
+                elif ckpt_path.startswith("orbax:"):
+                    self._restore_orbax(ckpt_path[len("orbax:"):])
+                else:
+                    self._restore_checkpoint(ckpt_path)
 
         train_step = self._build_train_step()
         val_step = self._build_eval_step("val") if val_loader is not None else None
+        if self._obs is not None:
+            # one span covering data resolution + param/opt init + restore
+            self._obs.add_span(
+                "fit/setup", _setup_wall, time.perf_counter() - _setup_t0,
+                step=self.global_step,
+            )
 
         if self.logger is not None and self.is_global_zero:
             self.logger.log_hyperparams(dict(model.hparams))
@@ -967,7 +986,52 @@ class Trainer:
                 datamodule.teardown("fit")
 
         model._params = self._params
+        if (
+            self._obs is not None
+            and getattr(self.strategy, "launcher", None) is None
+            and not getattr(self.strategy, "_is_remote", False)
+        ):
+            # in-process strategies have no driver aggregator: dump this
+            # process's ring + registry directly so single-host runs still
+            # produce trace.json/metrics.json under the root dir
+            from ray_lightning_tpu.observability import metrics as _obs_metrics
+            from ray_lightning_tpu.observability.aggregator import (
+                telemetry_dir,
+                write_local_dump,
+            )
+
+            write_local_dump(
+                telemetry_dir(self.default_root_dir),
+                self._obs,
+                _obs_metrics.get_registry(),
+            )
         return None
+
+    def _publish_dcn_telemetry(self, host_params) -> None:
+        """Record the DCN compression contract (payload bytes before/after
+        the int8 block encoding) as gauges + a trace event. Telemetry-off
+        cost: one attribute check."""
+        if self._obs is None:
+            return
+        try:
+            from ray_lightning_tpu.parallel.compression import (
+                compression_summary,
+            )
+
+            summary = compression_summary(
+                host_params, block_size=self._dcn_ctx["block_size"]
+            )
+        except Exception:  # telemetry must never break fit
+            return
+        reg = obs.metrics.get_registry()
+        reg.gauge("rlt_dcn_payload_bytes", kind="uncompressed").set(
+            summary["uncompressed_bytes"]
+        )
+        reg.gauge("rlt_dcn_payload_bytes", kind="compressed").set(
+            summary["compressed_bytes"]
+        )
+        reg.gauge("rlt_dcn_compression_ratio").set(summary["ratio"])
+        obs.event("dcn_compression", step=self.global_step, **summary)
 
     def _prefetch_shard(self, loader, limit):
         """Yield ``(idx, host_batch, device_batch)`` with a ONE-slot
@@ -1055,9 +1119,19 @@ class Trainer:
                     "interval"
                 )
 
+        # hoisted handles: the telemetry-off hot loop pays exactly one
+        # `rec is not None` check per batch, nothing else
+        rec = self._obs
+        step_hist = (
+            obs.metrics.get_registry().histogram("rlt_step_time_seconds")
+            if rec is not None
+            else None
+        )
         for batch_idx, batch, device_batch in self._prefetch_shard(
             train_loader, limit_train
         ):
+            if rec is not None:
+                _it_wall, _it_t0 = time.time(), time.perf_counter()
             self._health_tick(train=True)
             self._cb("on_train_batch_start", batch, batch_idx)
             self._params, self._opt_state, logs = train_step(
@@ -1072,6 +1146,18 @@ class Trainer:
             self._cb("on_train_batch_end", logs, batch, batch_idx)
             self.global_step += 1
             n_batches += 1
+            if rec is not None:
+                _dt = time.perf_counter() - _it_t0
+                if self._first_step_dispatched:
+                    # host-side step interval: equals device step time once
+                    # the dispatch pipeline backpressures
+                    rec.add_span("step", _it_wall, _dt, step=self.global_step - 1)
+                    step_hist.observe(_dt)
+                else:
+                    # the first dispatch blocks on jit trace + XLA compile;
+                    # keep it out of the step-time histogram
+                    self._first_step_dispatched = True
+                    rec.add_span("compile", _it_wall, _dt, step=self.global_step - 1)
 
             if val_loader is not None and (
                 (
@@ -1159,14 +1245,15 @@ class Trainer:
                 self.logger.log_metrics(step_metrics, step=self.global_step)
 
     def _run_validation(self, val_loader, val_step):
-        self._hook("on_validation_epoch_start")
-        self._cb("on_validation_start")
-        metrics = self._run_eval_epoch(
-            val_loader, val_step, limit=self.limit_val_batches, record=True
-        )
-        self._val_ran_this_epoch = True
-        self._hook("on_validation_epoch_end")
-        self._cb("on_validation_end")
+        with obs.span("validate", step=self.global_step):
+            self._hook("on_validation_epoch_start")
+            self._cb("on_validation_start")
+            metrics = self._run_eval_epoch(
+                val_loader, val_step, limit=self.limit_val_batches, record=True
+            )
+            self._val_ran_this_epoch = True
+            self._hook("on_validation_epoch_end")
+            self._cb("on_validation_end")
         return metrics
 
     def _run_eval_epoch(self, loader, eval_step, limit=None, record=True, phase="val"):
@@ -1353,16 +1440,20 @@ class Trainer:
         return ckpt
 
     def save_checkpoint(self, filepath: str, weights_only: bool = False) -> None:
-        ckpt = self.dump_checkpoint(weights_only)
-        filepath = os.path.abspath(filepath)
-        os.makedirs(os.path.dirname(filepath), exist_ok=True)
-        # write-then-rename: a process killed mid-save (the exact moment the
-        # crash-relaunch path later scans this directory) must never leave a
-        # truncated .ckpt that the relaunch would pick as "newest"
-        tmp = filepath + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(to_state_stream(ckpt))
-        os.replace(tmp, filepath)
+        with obs.span("checkpoint/save", step=self.global_step, path=filepath):
+            ckpt = self.dump_checkpoint(weights_only)
+            filepath = os.path.abspath(filepath)
+            os.makedirs(os.path.dirname(filepath), exist_ok=True)
+            # write-then-rename: a process killed mid-save (the exact moment
+            # the crash-relaunch path later scans this directory) must never
+            # leave a truncated .ckpt that the relaunch would pick as "newest"
+            tmp = filepath + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(to_state_stream(ckpt))
+            os.replace(tmp, filepath)
+        reg = obs.registry()
+        if reg is not None:
+            reg.counter("rlt_checkpoint_saves_total").inc()
 
     def collect_aux_state(self) -> Dict[str, Any]:
         """Non-array resume state shared by BOTH checkpoint formats:

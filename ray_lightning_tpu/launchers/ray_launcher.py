@@ -24,13 +24,14 @@ import cloudpickle
 import jax
 import numpy as np
 
+from ray_lightning_tpu import observability as obs
 from ray_lightning_tpu import runtime as rt
 from ray_lightning_tpu.callbacks.base import (
     collect_callback_states,
     restore_callback_states,
 )
 from ray_lightning_tpu.launchers.utils import RayExecutor, WorkerOutput
-from ray_lightning_tpu.session import init_session, reset_session
+from ray_lightning_tpu.session import flush_telemetry, init_session, reset_session
 from ray_lightning_tpu.utils.common import rank_zero_info
 from ray_lightning_tpu.utils.seed import GLOBAL_SEED_ENV, seed_everything
 from ray_lightning_tpu.utils.serialization import load_state_stream, to_state_stream
@@ -152,12 +153,17 @@ def _wrapping_function(
     the trainer, join the session, run the requested trainer stage, and on
     rank 0 collect the results (reference: ray_launcher.py:252-349)."""
     os.environ["RLT_GLOBAL_RANK"] = str(global_rank)
-    if isinstance(payload_ref, bytes):
-        # cross-host path: shared memory cannot leave the driver's machine,
-        # so remote workers receive the payload inline over the socket
-        trainer, fn_name, fn_args = cloudpickle.loads(payload_ref)
-    else:
-        trainer, fn_name, fn_args = rt.get(payload_ref)
+    # RLT_TELEMETRY is pinned in the worker env before spawn (worker_env),
+    # so boot phases are recordable before the strategy payload even loads
+    obs.maybe_enable_from_env()
+    with obs.span("boot/payload_load"):
+        if isinstance(payload_ref, bytes):
+            # cross-host path: shared memory cannot leave the driver's
+            # machine, so remote workers receive the payload inline over
+            # the socket
+            trainer, fn_name, fn_args = cloudpickle.loads(payload_ref)
+        else:
+            trainer, fn_name, fn_args = rt.get(payload_ref)
 
     strategy = trainer.strategy
     strategy.set_remote(True)
@@ -182,7 +188,13 @@ def _wrapping_function(
     # (ray_launcher.py:272-287).
     module = trainer._module
     module.trainer = trainer
-    results = getattr(trainer, fn_name)(*fn_args)
+    try:
+        with obs.span(f"worker/{fn_name}"):
+            results = getattr(trainer, fn_name)(*fn_args)
+    finally:
+        # one forced final beat carrying everything still in the ring +
+        # a full metrics snapshot — short runs and error exits included
+        flush_telemetry(getattr(trainer, "global_step", 0))
 
     if global_rank != 0:
         return None
@@ -244,7 +256,9 @@ class RayLauncher:
         self._worker_ranks: List[Tuple[int, int]] = []  # (node_rank, local_rank)
         self._any_remote = False
         self._tune_queue = None
-        self._hb_queue = None  # heartbeat channel (only with hang_timeout)
+        # heartbeat channel (with hang_timeout and/or telemetry enabled)
+        self._hb_queue = None
+        self._aggregator = None  # driver-side telemetry collector
         self._group_killed = False  # set once the supervisor hard-killed us
 
     def get_local_ranks(self) -> List[Tuple[int, int]]:
@@ -278,11 +292,14 @@ class RayLauncher:
         max_failures = getattr(self._strategy, "max_failures", 0)
         attempt = 0
         launch_t0 = time.time()
+        if getattr(self._strategy, "telemetry", False):
+            obs.enable()  # the driver gets its own track in the merged trace
         if trainer is not None:
             trainer._relaunch_ckpt_path = None
         while True:
             try:
-                self.setup_workers()
+                with obs.span("boot/setup_workers", attempt=attempt):
+                    self.setup_workers()
                 output = self.run_function_on_workers(function, *args, trainer=trainer)
                 if trainer is not None and output is not None:
                     self._recover_results_in_main_process(output, trainer)
@@ -292,6 +309,13 @@ class RayLauncher:
                 # relaunch; a deterministic user exception would just fail
                 # again against a fresh worker group
                 if attempt >= max_failures or not e.is_process_failure:
+                    if self._aggregator is not None:
+                        self._aggregator.record_event(
+                            "crash",
+                            attempt=attempt,
+                            fatal=True,
+                            error=f"{type(e).__name__}: {e}",
+                        )
                     raise
                 attempt += 1
                 resume = None
@@ -304,6 +328,14 @@ class RayLauncher:
                     max_failures,
                     f" resuming from {resume}" if resume else " from scratch",
                 )
+                if self._aggregator is not None:
+                    self._aggregator.record_event(
+                        "crash",
+                        attempt=attempt,
+                        max_failures=max_failures,
+                        resume=resume,
+                        error=f"{type(e).__name__}: {e}",
+                    )
             finally:
                 self.teardown_workers()
 
@@ -455,14 +487,15 @@ class RayLauncher:
         import secrets as _secrets
 
         run_tag = _secrets.token_hex(3)
-        self._workers = rt.create_actors(
-            specs,
-            names=[f"rlt-worker-{i}-{os.getpid()}-{run_tag}" for i in range(n)],
-            env=env,
-            per_actor_env=per_actor_env,
-            demands=demands,
-            assignments=assignments,
-        )
+        with obs.span("boot/spawn_workers", workers=n):
+            self._workers = rt.create_actors(
+                specs,
+                names=[f"rlt-worker-{i}-{os.getpid()}-{run_tag}" for i in range(n)],
+                env=env,
+                per_actor_env=per_actor_env,
+                demands=demands,
+                assignments=assignments,
+            )
         self._any_remote = any(
             rt.actor_node_id(w) != 0 for w in self._workers
         )
@@ -481,19 +514,22 @@ class RayLauncher:
             rt.get([w.execute.remote(strategy.init_hook) for w in self._workers])
 
         if n > 1:
-            # coordinator = worker-0 IP + free port (reference pattern :85-87)
-            ip = rt.get(self._workers[0].get_node_ip.remote())
-            port = rt.get(self._workers[0].find_free_port.remote())
-            coordinator = f"{ip}:{port}"
-            rank_zero_info("rlt coordinator at %s", coordinator)
-            counts = rt.get(
-                [
-                    w.init_distributed.remote(coordinator, n, i)
-                    for i, w in enumerate(self._workers)
-                ]
-            )
-            if len(set(counts)) != 1:
-                raise RuntimeError(f"workers disagree on device count: {counts}")
+            with obs.span("boot/init_distributed", workers=n):
+                # coordinator = worker-0 IP + free port (reference :85-87)
+                ip = rt.get(self._workers[0].get_node_ip.remote())
+                port = rt.get(self._workers[0].find_free_port.remote())
+                coordinator = f"{ip}:{port}"
+                rank_zero_info("rlt coordinator at %s", coordinator)
+                counts = rt.get(
+                    [
+                        w.init_distributed.remote(coordinator, n, i)
+                        for i, w in enumerate(self._workers)
+                    ]
+                )
+                if len(set(counts)) != 1:
+                    raise RuntimeError(
+                        f"workers disagree on device count: {counts}"
+                    )
             if strategy.debug_collectives:
                 sums = rt.get([w.psum_smoke_test.remote() for w in self._workers])
                 rank_zero_info("collective smoke test: %s", sums)
@@ -503,9 +539,12 @@ class RayLauncher:
             self._tune_queue = rt.make_queue(cross_host=self._any_remote)
 
         self._group_killed = False
-        if getattr(strategy, "hang_timeout", None):
-            # heartbeat channel for the hang watchdog; without hang_timeout
-            # no ticks are emitted and no supervisor runs
+        if getattr(strategy, "hang_timeout", None) or getattr(
+            strategy, "telemetry", False
+        ):
+            # heartbeat channel for the hang watchdog and/or the telemetry
+            # transport (payloads piggyback on beats — no new connections);
+            # with neither knob no ticks are emitted and no supervisor runs
             self._hb_queue = rt.make_queue(cross_host=self._any_remote)
 
     @staticmethod
@@ -541,7 +580,8 @@ class RayLauncher:
 
         queue_handle = self._tune_queue.handle() if self._tune_queue else None
         hb_handle = self._hb_queue.handle() if self._hb_queue else None
-        supervisor = self._make_supervisor()
+        aggregator = self._make_aggregator(trainer, fn_name)
+        supervisor = self._make_supervisor(aggregator)
         try:
             futures = [
                 w.execute.remote(
@@ -561,6 +601,23 @@ class RayLauncher:
         finally:
             if supervisor is not None:
                 supervisor.stop()
+                # the final forced beats (flush_telemetry) may still sit in
+                # the queue after the thread stops — drain them here so the
+                # aggregator's last view includes every rank's full snapshot
+                if self._hb_queue is not None:
+                    try:
+                        for beat in self._hb_queue.get_all():
+                            supervisor.ingest(beat)
+                    except Exception:
+                        pass
+            if aggregator is not None:
+                aggregator.record_event("run_finished", fn=fn_name)
+                rec = obs.get_recorder()
+                out_dir = aggregator.finalize(
+                    driver_events=rec.drain() if rec is not None else None
+                )
+                if out_dir:
+                    rank_zero_info("telemetry written to %s", out_dir)
             # free the trainer+params shm segment once workers have consumed
             # it (repeated fit/tune launches would otherwise exhaust /dev/shm)
             if not isinstance(payload_ref, bytes):
@@ -569,22 +626,48 @@ class RayLauncher:
         return output
 
     # ------------------------------------------------------------------ #
-    # health supervision
+    # health supervision + telemetry aggregation
     # ------------------------------------------------------------------ #
-    def _make_supervisor(self):
-        hang_timeout = getattr(self._strategy, "hang_timeout", None)
-        if not hang_timeout or self._hb_queue is None:
+    def _make_aggregator(self, trainer, fn_name: str):
+        """Driver-side collector over the heartbeat channel. Exists whenever
+        the channel does; ``full`` (trace/metrics outputs) only with the
+        telemetry knob — otherwise it is the always-on JSONL flight record
+        for supervisor verdicts."""
+        if self._hb_queue is None:
+            return None
+        from ray_lightning_tpu.observability.aggregator import (
+            DriverAggregator,
+            telemetry_dir,
+        )
+
+        root = getattr(trainer, "default_root_dir", None) if trainer else None
+        aggregator = DriverAggregator(
+            telemetry_dir(root),
+            num_workers=self._strategy.num_workers,
+            full=getattr(self._strategy, "telemetry", False),
+        )
+        aggregator.record_event(
+            "run_started", fn=fn_name, workers=self._strategy.num_workers
+        )
+        self._aggregator = aggregator
+        return aggregator
+
+    def _make_supervisor(self, aggregator=None):
+        if self._hb_queue is None:
             return None
         from ray_lightning_tpu.runtime.supervisor import Supervisor
 
+        # hang_timeout=None -> monitor-only: the supervisor thread still
+        # pumps beats into the aggregator but never classifies or kills
         supervisor = Supervisor(
             num_workers=self._strategy.num_workers,
             drain=self._hb_queue.get_all,
-            hang_timeout=hang_timeout,
+            hang_timeout=getattr(self._strategy, "hang_timeout", None),
             heartbeat_interval=getattr(self._strategy, "heartbeat_interval", 1.0),
             kill_group=self._kill_worker_group,
             is_alive=self._worker_alive,
             label=f"worker group ({self._strategy.num_workers} ranks)",
+            aggregator=aggregator,
         )
         supervisor.start()
         return supervisor

@@ -67,6 +67,21 @@ class RayExecutor:
         import jax
 
         if num_processes > 1 and not self._distributed_initialized:
+            # read the platform pin WITHOUT jax.default_backend(): that
+            # would initialize the backend, which initialize() forbids
+            platforms = (
+                jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS") or ""
+            )
+            if "cpu" in str(platforms).split(","):
+                # the default CPU backend refuses multiprocess computations;
+                # gloo is the transport that makes cross-process CPU
+                # collectives real (the test-path stand-in for ICI/DCN)
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo"
+                    )
+                except Exception:  # older jaxlib without the option
+                    pass
             jax.distributed.initialize(
                 coordinator_address=coordinator,
                 num_processes=num_processes,

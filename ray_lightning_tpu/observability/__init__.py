@@ -1,0 +1,90 @@
+"""Distributed flight recorder: spans, metrics, and driver aggregation.
+
+Public surface (everything off-by-default-cheap):
+
+- :func:`span` / :func:`event` — trace API, no-op unless enabled.
+- :func:`registry` — the process metrics registry, ``None`` unless enabled
+  (call sites hold the ``None`` check as their only disabled-path cost).
+- :func:`enable` / :func:`maybe_enable_from_env` — flip telemetry on
+  (``RLT_TELEMETRY=1`` or ``telemetry=True`` on a strategy).
+- :func:`collect_beat_payload` — drain pending trace events + metric
+  deltas for piggybacking on a heartbeat (``None`` when disabled/empty).
+
+Driver-side pieces (:class:`~.aggregator.DriverAggregator`,
+:func:`~.aggregator.render_top`) live in :mod:`.aggregator`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from . import metrics, trace
+from .trace import (  # noqa: F401  (re-exported API)
+    DRIVER,
+    NOOP_SPAN,
+    TraceRecorder,
+    disable,
+    enable,
+    enabled,
+    env_enabled,
+    estimate_skew,
+    event,
+    get_recorder,
+    maybe_enable_from_env,
+    merge_traces,
+    span,
+)
+
+__all__ = [
+    "DRIVER",
+    "NOOP_SPAN",
+    "TraceRecorder",
+    "collect_beat_payload",
+    "disable",
+    "enable",
+    "enabled",
+    "env_enabled",
+    "estimate_skew",
+    "event",
+    "get_recorder",
+    "maybe_enable_from_env",
+    "merge_traces",
+    "metrics",
+    "registry",
+    "reset",
+    "span",
+    "trace",
+]
+
+
+def registry() -> Optional[metrics.MetricsRegistry]:
+    """The process-local metrics registry, or ``None`` when telemetry is
+    disabled — call sites gate their record path on this single check."""
+    if trace.enabled():
+        return metrics.get_registry()
+    return None
+
+
+def collect_beat_payload(final: bool = False) -> Optional[Dict[str, Any]]:
+    """Drain telemetry for shipping on a heartbeat.
+
+    Returns ``{"m": <metrics delta snapshot>, "t": <trace events>}`` or
+    ``None`` when telemetry is disabled or (unless ``final``) there is
+    nothing new to ship. ``final=True`` forces a full cumulative metrics
+    snapshot so the driver's last view is complete even if some earlier
+    delta beats were dropped.
+    """
+    rec = trace.get_recorder()
+    if rec is None:
+        return None
+    events = rec.drain()
+    reg = metrics.get_registry()
+    snap = reg.snapshot(delta=not final)
+    if not final and not events and reg.is_empty_snapshot(snap):
+        return None
+    return {"m": snap, "t": events}
+
+
+def reset() -> None:
+    """Disable telemetry and drop all recorded state (test isolation)."""
+    trace.disable()
+    metrics.reset_registry()

@@ -1,0 +1,435 @@
+"""Driver-side telemetry aggregation.
+
+The :class:`DriverAggregator` sits behind the supervisor's heartbeat drain
+loop — every worker beat (optionally carrying a telemetry payload of
+metric snapshots + drained trace events, see ``session.py``) flows through
+:meth:`on_beat`. No new connections: the heartbeat queue built for hang
+detection *is* the telemetry transport.
+
+It maintains:
+
+- per-rank clock-skew estimates from beat ``(send_wall, recv_wall)`` pairs,
+- per-rank trace-event buffers merged into one Chrome ``trace.json``,
+- a driver-side :class:`~.metrics.MetricsRegistry` with every worker series
+  relabelled ``rank=N`` (JSON + Prometheus text exporters),
+- per-rank step-time sample streams -> straggler percentiles and cross-rank
+  skew,
+- an **always-on** JSONL flight record (``events.jsonl``) of supervisor
+  verdicts and run lifecycle, written even when full telemetry is off.
+
+``render_top`` implements the ``rlt top``-style live summary consumed by
+``python -m ray_lightning_tpu.cli top`` — it re-reads the throttled
+``summary.json`` the aggregator drops next to the trace.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import metrics as _metrics
+from . import trace as _trace
+
+TRACE_FILE = "trace.json"
+METRICS_FILE = "metrics.json"
+PROM_FILE = "metrics.prom"
+EVENTS_FILE = "events.jsonl"
+SUMMARY_FILE = "summary.json"
+
+DIR_ENV = "RLT_TELEMETRY_DIR"
+
+# caps so a long run cannot grow driver memory unboundedly
+MAX_EVENTS_PER_RANK = 50_000
+MAX_SKEW_SAMPLES = 512
+MAX_STEP_SAMPLES = 8192
+
+STEP_TIME_METRIC = "rlt_step_time_seconds"
+
+
+def telemetry_dir(default_root_dir: Optional[str] = None) -> str:
+    """Resolve the output directory: RLT_TELEMETRY_DIR wins, else
+    ``<default_root_dir>/telemetry``, else ``./telemetry``."""
+    env = os.environ.get(DIR_ENV)
+    if env:
+        return env
+    root = default_root_dir or os.getcwd()
+    return os.path.join(root, "telemetry")
+
+
+def step_time_stats(samples_by_rank: Dict[Any, List[float]]) -> Dict[str, float]:
+    """Straggler statistics over per-rank step-time samples (seconds).
+
+    ``step_time_max_skew`` is the spread between the slowest and fastest
+    rank's median step time — the quantity that predicts multi-worker
+    throughput cliffs. With a single rank it degrades to the in-rank
+    max-min spread so bench rows still capture variance.
+    """
+    pooled: List[float] = []
+    medians: List[float] = []
+    for samples in samples_by_rank.values():
+        if samples:
+            pooled.extend(samples)
+            medians.append(_metrics.percentile(samples, 50))
+    if not pooled:
+        return {}
+    if len(medians) > 1:
+        skew = max(medians) - min(medians)
+    else:
+        skew = max(pooled) - min(pooled)
+    return {
+        "step_time_p50": round(_metrics.percentile(pooled, 50), 6),
+        "step_time_p90": round(_metrics.percentile(pooled, 90), 6),
+        "step_time_max_skew": round(skew, 6),
+    }
+
+
+class DriverAggregator:
+    """Collects worker telemetry off the heartbeat channel on the driver.
+
+    ``full=False`` (telemetry disabled) degrades to flight-record-only
+    mode: beats still update liveness gauges and verdicts still land in
+    ``events.jsonl``, but no trace/metrics files are produced.
+    """
+
+    def __init__(
+        self,
+        run_dir: str,
+        num_workers: int,
+        full: bool = True,
+        summary_interval: float = 2.0,
+    ):
+        self.run_dir = run_dir
+        self.num_workers = int(num_workers)
+        self.full = bool(full)
+        self.registry = _metrics.MetricsRegistry()
+        self._trace_by_rank: Dict[Any, deque] = {}
+        self._skew_samples: Dict[Any, deque] = {}
+        self._step_samples: Dict[Any, deque] = {}
+        self._last_step: Dict[Any, int] = {}
+        self._last_beat: Dict[Any, float] = {}
+        self._rank_gauges: Dict[Any, Dict[str, float]] = {}
+        self._events_fh = None
+        self._summary_interval = float(summary_interval)
+        self._summary_written = 0.0
+        self._finalized = False
+        os.makedirs(run_dir, exist_ok=True)
+
+    # ----------------------------------------------------------------- #
+    # ingestion (called from the supervisor thread)
+    # ----------------------------------------------------------------- #
+    def on_beat(
+        self,
+        rank: int,
+        step: int,
+        send_wall: float,
+        payload: Optional[dict] = None,
+        recv_wall: Optional[float] = None,
+    ) -> None:
+        recv = time.time() if recv_wall is None else recv_wall
+        self._last_step[rank] = int(step)
+        self._last_beat[rank] = recv
+        self._skew_samples.setdefault(rank, deque(maxlen=MAX_SKEW_SAMPLES)).append(
+            (send_wall, recv)
+        )
+        reg = self.registry
+        reg.gauge("rlt_heartbeat_latency_seconds", rank=rank).set(recv - send_wall)
+        reg.gauge("rlt_worker_step", rank=rank).set(step)
+        if payload:
+            self.ingest_payload(rank, payload)
+        self._maybe_write_summary(recv)
+
+    def ingest_payload(self, rank: int, payload: dict) -> None:
+        events = payload.get("t")
+        if events:
+            buf = self._trace_by_rank.setdefault(
+                rank, deque(maxlen=MAX_EVENTS_PER_RANK)
+            )
+            buf.extend(events)
+        snap = payload.get("m")
+        if snap:
+            self.registry.merge_snapshot(snap, extra_labels={"rank": rank})
+            gauges = self._rank_gauges.setdefault(rank, {})
+            for name, labels, value in snap.get("gauges", ()):
+                if not labels:
+                    gauges[name] = value
+            for name, labels, h in snap.get("histograms", ()):
+                if name == STEP_TIME_METRIC:
+                    self._step_samples.setdefault(
+                        rank, deque(maxlen=MAX_STEP_SAMPLES)
+                    ).extend(h.get("samples", ()))
+
+    def heartbeat_age(self, rank: int, age: float) -> None:
+        """Supervisor-reported time since a rank's last beat."""
+        self.registry.gauge("rlt_heartbeat_age_seconds", rank=rank).set(age)
+
+    def record_event(self, kind: str, **fields) -> None:
+        """Append one line to the JSONL flight record (always on) and
+        mirror it as an instant event on the driver's trace track."""
+        line = {"ts": time.time(), "event": kind}
+        line.update(fields)
+        try:
+            if self._events_fh is None:
+                self._events_fh = open(
+                    os.path.join(self.run_dir, EVENTS_FILE), "a"
+                )
+            self._events_fh.write(json.dumps(line, default=str) + "\n")
+            self._events_fh.flush()
+        except OSError:  # pragma: no cover - telemetry must never kill a run
+            pass
+        _trace.event(f"verdict/{kind}" if kind in (
+            "crash", "hang", "straggler") else kind, **fields)
+
+    # ----------------------------------------------------------------- #
+    # aggregation
+    # ----------------------------------------------------------------- #
+    def skew_by_rank(self) -> Dict[Any, float]:
+        return {
+            rank: _trace.estimate_skew(list(samples))
+            for rank, samples in self._skew_samples.items()
+        }
+
+    def step_samples_by_rank(self) -> Dict[Any, List[float]]:
+        return {r: list(s) for r, s in self._step_samples.items()}
+
+    def summary(self) -> Dict[str, Any]:
+        now = time.time()
+        skews = self.skew_by_rank()
+        per_rank: Dict[str, Any] = {}
+        samples_total = 0.0
+        mfus: List[float] = []
+        for rank in sorted(
+            set(self._last_step) | set(self._step_samples), key=str
+        ):
+            samples = list(self._step_samples.get(rank, ()))
+            gauges = self._rank_gauges.get(rank, {})
+            info: Dict[str, Any] = {
+                "step": self._last_step.get(rank),
+                "clock_skew_s": round(skews.get(rank, 0.0), 6),
+                "heartbeat_age_s": round(
+                    now - self._last_beat[rank], 3
+                ) if rank in self._last_beat else None,
+                "n_step_samples": len(samples),
+            }
+            if samples:
+                info["step_time_p50"] = round(_metrics.percentile(samples, 50), 6)
+                info["step_time_p90"] = round(_metrics.percentile(samples, 90), 6)
+            for name, key in (
+                ("rlt_samples_per_sec", "samples_per_sec"),
+                ("rlt_train_mfu", "mfu"),
+                ("rlt_tokens_per_sec_per_chip", "tokens_per_sec_per_chip"),
+            ):
+                if name in gauges:
+                    info[key] = round(gauges[name], 6)
+            samples_total += info.get("samples_per_sec", 0.0) or 0.0
+            if "mfu" in info:
+                mfus.append(info["mfu"])
+            per_rank[str(rank)] = info
+        cluster: Dict[str, Any] = dict(
+            step_time_stats(self.step_samples_by_rank())
+        )
+        if samples_total:
+            cluster["samples_per_sec"] = round(samples_total, 3)
+        if mfus:
+            cluster["mfu"] = round(sum(mfus) / len(mfus), 6)
+        steps = [s for s in self._last_step.values() if s is not None]
+        if steps:
+            cluster["steps_min"] = min(steps)
+            cluster["steps_max"] = max(steps)
+        return {
+            "ts": now,
+            "num_workers": self.num_workers,
+            "telemetry": self.full,
+            "per_rank": per_rank,
+            "cluster": cluster,
+        }
+
+    # ----------------------------------------------------------------- #
+    # outputs
+    # ----------------------------------------------------------------- #
+    def _maybe_write_summary(self, now: float) -> None:
+        if not self.full or now - self._summary_written < self._summary_interval:
+            return
+        self._summary_written = now
+        self._write_json(SUMMARY_FILE, self.summary())
+
+    def _write_json(self, filename: str, obj: Any) -> None:
+        path = os.path.join(self.run_dir, filename)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(obj, f, default=str)
+            os.replace(tmp, path)
+        except OSError:  # pragma: no cover
+            pass
+
+    def per_rank_histograms(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for (name, labels), m in self.registry.items():
+            if isinstance(m, _metrics.Histogram):
+                out.setdefault(name, {})[_metrics._format_labels(labels) or "{}"] = {
+                    "bounds": list(m.bounds),
+                    "counts": list(m.counts),
+                    "sum": m.sum,
+                    "count": m.count,
+                }
+        return out
+
+    def finalize(
+        self, driver_events: Optional[List[_trace.TraceTuple]] = None
+    ) -> Optional[str]:
+        """Write trace.json / metrics.json / metrics.prom (full mode) and
+        close the flight record. Returns the run dir when outputs exist."""
+        if self._finalized:
+            return self.run_dir if self.full else None
+        self._finalized = True
+        if self.full:
+            events_by_rank: Dict[Any, List[_trace.TraceTuple]] = {
+                r: list(buf) for r, buf in self._trace_by_rank.items()
+            }
+            if driver_events:
+                events_by_rank[_trace.DRIVER] = list(driver_events)
+            merged = _trace.merge_traces(events_by_rank, self.skew_by_rank())
+            self._write_json(TRACE_FILE, merged)
+            self._write_json(
+                METRICS_FILE,
+                {
+                    "summary": self.summary(),
+                    "per_rank_histograms": self.per_rank_histograms(),
+                },
+            )
+            self._write_json(SUMMARY_FILE, self.summary())
+            try:
+                with open(os.path.join(self.run_dir, PROM_FILE), "w") as f:
+                    f.write(self.registry.prometheus_text())
+            except OSError:  # pragma: no cover
+                pass
+        if self._events_fh is not None:
+            try:
+                self._events_fh.close()
+            except OSError:  # pragma: no cover
+                pass
+            self._events_fh = None
+        return self.run_dir if self.full else None
+
+
+def write_local_dump(
+    run_dir: str,
+    recorder: Optional[_trace.TraceRecorder],
+    registry: Optional[_metrics.MetricsRegistry],
+    rank: int = 0,
+) -> str:
+    """Dump a single process's telemetry (no launcher / in-process
+    strategies): same file set as the driver aggregator, one rank track."""
+    agg = DriverAggregator(run_dir, num_workers=1, full=True)
+    if registry is not None:
+        agg.ingest_payload(rank, {"m": registry.snapshot(delta=False)})
+    if recorder is not None:
+        agg.ingest_payload(rank, {"t": recorder.drain()})
+    agg.finalize()
+    return run_dir
+
+
+# --------------------------------------------------------------------- #
+# `rlt top` style live summary
+# --------------------------------------------------------------------- #
+def format_summary(summary: Dict[str, Any], events: List[dict]) -> str:
+    lines: List[str] = []
+    cl = summary.get("cluster", {})
+    age = time.time() - summary.get("ts", time.time())
+    lines.append(
+        f"rlt top — {summary.get('num_workers', '?')} worker(s), "
+        f"summary age {age:.1f}s"
+    )
+    cl_bits = []
+    for key, fmt in (
+        ("step_time_p50", "step p50 {:.4f}s"),
+        ("step_time_p90", "p90 {:.4f}s"),
+        ("step_time_max_skew", "skew {:.4f}s"),
+        ("samples_per_sec", "{:.1f} samples/s"),
+        ("mfu", "MFU {:.3f}"),
+    ):
+        if key in cl:
+            cl_bits.append(fmt.format(cl[key]))
+    if cl_bits:
+        lines.append("cluster: " + " · ".join(cl_bits))
+    header = f"{'rank':>5} {'step':>8} {'p50(s)':>9} {'p90(s)':>9} " \
+             f"{'sps':>9} {'mfu':>7} {'beat age':>9} {'skew(s)':>9}"
+    lines.append(header)
+    for rank, info in sorted(summary.get("per_rank", {}).items(), key=lambda kv: kv[0]):
+        def _f(key, spec, default="-"):
+            v = info.get(key)
+            return spec.format(v) if v is not None else default
+
+        lines.append(
+            f"{rank:>5} {_f('step', '{:d}'):>8} "
+            f"{_f('step_time_p50', '{:.4f}'):>9} "
+            f"{_f('step_time_p90', '{:.4f}'):>9} "
+            f"{_f('samples_per_sec', '{:.1f}'):>9} "
+            f"{_f('mfu', '{:.3f}'):>7} "
+            f"{_f('heartbeat_age_s', '{:.1f}'):>9} "
+            f"{_f('clock_skew_s', '{:.4f}'):>9}"
+        )
+    if events:
+        lines.append("recent events:")
+        for ev in events[-5:]:
+            ts = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0)))
+            rest = {k: v for k, v in ev.items() if k not in ("ts", "event")}
+            lines.append(f"  {ts} {ev.get('event', '?')} {rest if rest else ''}")
+    return "\n".join(lines)
+
+
+def _read_summary(run_dir: str) -> Optional[Dict[str, Any]]:
+    for fname in (SUMMARY_FILE, METRICS_FILE):
+        path = os.path.join(run_dir, fname)
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+        except (OSError, ValueError):
+            continue
+        return obj.get("summary", obj) if fname == METRICS_FILE else obj
+    return None
+
+
+def _read_events(run_dir: str, limit: int = 32) -> List[dict]:
+    path = os.path.join(run_dir, EVENTS_FILE)
+    try:
+        with open(path) as f:
+            lines = f.readlines()[-limit:]
+    except OSError:
+        return []
+    out = []
+    for line in lines:
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue
+    return out
+
+
+def render_top(
+    run_dir: str,
+    follow: bool = False,
+    interval: float = 2.0,
+    _print=print,
+) -> int:
+    """Render the live summary for ``run_dir``; with ``follow`` keep
+    refreshing until interrupted. Returns a process exit code."""
+    while True:
+        summary = _read_summary(run_dir)
+        if summary is None:
+            _print(f"no telemetry summary found under {run_dir} "
+                   f"(is RLT_TELEMETRY=1 set on the run?)")
+            if not follow:
+                return 1
+        else:
+            if follow:
+                _print("\x1b[2J\x1b[H", end="")
+            _print(format_summary(summary, _read_events(run_dir)))
+        if not follow:
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:  # pragma: no cover
+            return 0

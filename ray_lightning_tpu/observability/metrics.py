@@ -1,0 +1,266 @@
+"""Process-local metrics registry: counters, gauges, histograms.
+
+Record paths are deliberately cheap — metric handles are looked up once
+and cached by the call site (or fetched via :meth:`MetricsRegistry.counter`
+etc., a dict get), after which ``inc``/``set``/``observe`` are a couple of
+float ops. There is no background thread and no locking on the record
+path; the GIL makes the individual mutations atomic enough for telemetry.
+
+Serialization is snapshot-based: :meth:`MetricsRegistry.snapshot` returns
+a plain-dict structure safe to ship over the heartbeat channel. Histograms
+keep a bounded list of raw *pending* samples that is drained on each delta
+snapshot, so the driver-side aggregator can rebuild true per-rank sample
+distributions (percentiles, skew) instead of being stuck with bucket
+resolution.
+"""
+from __future__ import annotations
+
+from bisect import bisect_left
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+LabelKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+# Tuned for step/IO latencies in seconds: 100 µs .. 60 s.
+DEFAULT_BOUNDS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+# Cap on raw samples buffered between two delta snapshots.
+PENDING_CAP = 4096
+
+
+def _key(name: str, labels: Dict[str, Any]) -> LabelKey:
+    return name, tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _format_labels(labels: Sequence[Tuple[str, str]], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    kind = "counter"
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    kind = "gauge"
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+
+class Histogram:
+    """Fixed-bucket histogram plus a bounded buffer of raw samples.
+
+    ``counts``/``sum``/``count`` are cumulative (Prometheus semantics,
+    with a +Inf overflow bucket at the end). ``pending`` holds samples
+    recorded since the last delta snapshot; ``recent`` is a ring used for
+    local percentile queries.
+    """
+
+    __slots__ = ("bounds", "counts", "sum", "count", "pending", "recent")
+
+    kind = "histogram"
+
+    def __init__(self, bounds: Sequence[float] = DEFAULT_BOUNDS):
+        self.bounds: Tuple[float, ...] = tuple(bounds)
+        self.counts: List[int] = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self.pending: List[float] = []
+        self.recent: deque = deque(maxlen=1024)
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.counts[bisect_left(self.bounds, value)] += 1
+        self.sum += value
+        self.count += 1
+        if len(self.pending) < PENDING_CAP:
+            self.pending.append(value)
+        self.recent.append(value)
+
+    def load(self, counts: Sequence[int], total: float, count: int) -> None:
+        """Overwrite cumulative state (driver rebuilding a worker histogram)."""
+        if len(counts) == len(self.counts):
+            self.counts = list(counts)
+        self.sum = float(total)
+        self.count = int(count)
+
+    def percentile(self, q: float) -> Optional[float]:
+        if not self.recent:
+            return None
+        return percentile(list(self.recent), q)
+
+    def drain_pending(self) -> List[float]:
+        out, self.pending = self.pending, []
+        return out
+
+
+def percentile(samples: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile of an unsorted sample list; q in [0, 100]."""
+    s = sorted(samples)
+    if not s:
+        raise ValueError("percentile of empty sample list")
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[idx]
+
+
+class MetricsRegistry:
+    """Keyed (name, labels) metric store with snapshot/delta serialization."""
+
+    def __init__(self):
+        self._metrics: Dict[LabelKey, Any] = {}
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    def _get(self, cls, name: str, labels: Dict[str, Any], **kwargs):
+        key = _key(name, labels)
+        m = self._metrics.get(key)
+        if m is None:
+            m = self._metrics[key] = cls(**kwargs)
+        elif not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"requested {cls.kind}"
+            )
+        return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(
+        self, name: str, bounds: Sequence[float] = DEFAULT_BOUNDS, **labels
+    ) -> Histogram:
+        return self._get(Histogram, name, labels, bounds=bounds)
+
+    def get(self, name: str, **labels):
+        return self._metrics.get(_key(name, labels))
+
+    def items(self):
+        return self._metrics.items()
+
+    # ----------------------------------------------------------------- #
+    # serialization
+    # ----------------------------------------------------------------- #
+    def snapshot(self, delta: bool = False) -> Dict[str, Any]:
+        """Plain-dict snapshot: counters/gauges are cumulative values;
+        histograms carry cumulative buckets plus raw samples. With
+        ``delta=True`` the histogram sample buffers are drained, so a
+        sequence of delta snapshots partitions the sample stream."""
+        counters: List[Any] = []
+        gauges: List[Any] = []
+        hists: List[Any] = []
+        for (name, labels), m in self._metrics.items():
+            if isinstance(m, Counter):
+                counters.append([name, list(labels), m.value])
+            elif isinstance(m, Gauge):
+                gauges.append([name, list(labels), m.value])
+            else:
+                samples = m.drain_pending() if delta else list(m.recent)
+                hists.append(
+                    [name, list(labels), {
+                        "bounds": list(m.bounds),
+                        "counts": list(m.counts),
+                        "sum": m.sum,
+                        "count": m.count,
+                        "samples": samples,
+                    }]
+                )
+        return {"counters": counters, "gauges": gauges, "histograms": hists}
+
+    def is_empty_snapshot(self, snap: Dict[str, Any]) -> bool:
+        return not (snap["counters"] or snap["gauges"] or snap["histograms"])
+
+    def merge_snapshot(
+        self, snap: Dict[str, Any], extra_labels: Optional[Dict[str, Any]] = None
+    ) -> None:
+        """Fold a (worker) snapshot into this registry, optionally adding
+        labels (the driver adds ``rank=N``). Counter/gauge values and
+        histogram cumulative state are overwritten (they are cumulative at
+        the source); histogram samples are appended to the local buffers."""
+        extra = extra_labels or {}
+
+        def _merged(labels):
+            d = dict(labels)
+            d.update(extra)
+            return d
+
+        for name, labels, value in snap.get("counters", ()):
+            self.counter(name, **_merged(labels)).value = value
+        for name, labels, value in snap.get("gauges", ()):
+            self.gauge(name, **_merged(labels)).set(value)
+        for name, labels, h in snap.get("histograms", ()):
+            m = self.histogram(name, bounds=h["bounds"], **_merged(labels))
+            m.load(h["counts"], h["sum"], h["count"])
+            for v in h.get("samples", ()):
+                if len(m.pending) < PENDING_CAP:
+                    m.pending.append(v)
+                m.recent.append(v)
+
+    # ----------------------------------------------------------------- #
+    # exposition
+    # ----------------------------------------------------------------- #
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (one line per series)."""
+        lines: List[str] = []
+        seen_type: Dict[str, str] = {}
+        for (name, labels), m in sorted(self._metrics.items()):
+            if seen_type.get(name) != m.kind:
+                lines.append(f"# TYPE {name} {m.kind}")
+                seen_type[name] = m.kind
+            if isinstance(m, (Counter, Gauge)):
+                lines.append(f"{name}{_format_labels(labels)} {_num(m.value)}")
+            else:
+                cum = 0
+                for bound, c in zip(m.bounds, m.counts):
+                    cum += c
+                    le = _format_labels(labels, f'le="{_num(bound)}"')
+                    lines.append(f"{name}_bucket{le} {cum}")
+                cum += m.counts[-1]
+                le = _format_labels(labels, 'le="+Inf"')
+                lines.append(f"{name}_bucket{le} {cum}")
+                lines.append(f"{name}_sum{_format_labels(labels)} {_num(m.sum)}")
+                lines.append(f"{name}_count{_format_labels(labels)} {m.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _num(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _registry
+
+
+def reset_registry() -> MetricsRegistry:
+    """Replace the global registry (test isolation)."""
+    global _registry
+    _registry = MetricsRegistry()
+    return _registry

@@ -31,7 +31,7 @@ train step; ``bench.py``'s dcn sweep).
 """
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Sequence, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -135,6 +135,21 @@ def payload_bytes(
         else:
             compressed += size * itemsize
     return uncompressed, compressed
+
+
+def compression_summary(
+    tree: Any,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    min_size: int = MIN_COMPRESS_SIZE,
+) -> Dict[str, float]:
+    """One-shot wire-size report for telemetry: uncompressed vs. compressed
+    bytes of a gradient payload and the resulting ratio (>1 = savings)."""
+    uncompressed, compressed = payload_bytes(tree, block_size, min_size)
+    return {
+        "uncompressed_bytes": int(uncompressed),
+        "compressed_bytes": int(compressed),
+        "ratio": round(uncompressed / compressed, 4) if compressed else 0.0,
+    }
 
 
 # --------------------------------------------------------------------- #

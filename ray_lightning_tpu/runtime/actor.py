@@ -30,12 +30,15 @@ import secrets
 import socket
 import struct
 import threading
+import time
 import traceback
 from concurrent.futures import Future
 from typing import Any, Dict, Optional, Tuple
 
 import cloudpickle
 from concurrent.futures import TimeoutError as _FuturesTimeout
+
+from ray_lightning_tpu import observability as _obs
 
 _LEN = struct.Struct("!Q")
 
@@ -191,10 +194,19 @@ class CallFuture:
         self._fut = fut
         self.actor = actor
         self.method = method
+        # telemetry-off cost: one enabled() check at dispatch
+        self._t0 = time.perf_counter() if _obs.enabled() else None
 
     def result(self, timeout: Optional[float] = None) -> Any:
         try:
             status, value = self._fut.result(timeout)
+            if self._t0 is not None:
+                reg = _obs.registry()
+                if reg is not None:
+                    reg.histogram(
+                        "rlt_actor_call_seconds", method=self.method
+                    ).observe(time.perf_counter() - self._t0)
+                self._t0 = None  # polled result(): count the call once
         except (_FuturesTimeout, TimeoutError):
             # the underlying future is untouched by an expired wait, so the
             # call remains poll-able with a later result(timeout)

@@ -26,17 +26,29 @@ from typing import Any, List, Optional
 
 import cloudpickle
 
+from ray_lightning_tpu import observability as _obs
 from ray_lightning_tpu.runtime import api, native
 from ray_lightning_tpu.runtime.actor import ActorError, ActorTimeout
 
 Full = _queue_mod.Full
 
 
+def _record_put_wait(impl: str, seconds: float) -> None:
+    """Telemetry tap for queue back-pressure; no-op (one None check at the
+    call site) when the flight recorder is off."""
+    reg = _obs.registry()
+    if reg is not None:
+        reg.histogram("rlt_queue_put_wait_seconds", impl=impl).observe(seconds)
+
+
 def _actor_put(actor, item: Any, timeout: Optional[float]) -> None:
     """Bounded put against a queue actor: every failure mode names the
     queue so a worker stuck reporting can be diagnosed from the traceback."""
+    t0 = time.perf_counter() if _obs.enabled() else None
     try:
         ok = actor.call("put", item).result(timeout=timeout)
+        if t0 is not None:
+            _record_put_wait("actor", time.perf_counter() - t0)
     except ActorTimeout:
         raise Full(
             f"queue actor {actor.name!r}: put got no reply within {timeout}s "
@@ -187,9 +199,12 @@ class _ShmQueueBase:
                 raise Full("queue slot too small even for a spill ref")
         buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
         deadline = None if timeout is None else time.monotonic() + timeout
+        t0 = time.perf_counter() if _obs.enabled() else None
         while True:
             rc = lib.rlt_queue_push(self._queue, buf, len(payload))
             if rc == 0:
+                if t0 is not None:
+                    _record_put_wait("shm", time.perf_counter() - t0)
                 return
             if rc == -11:  # -EAGAIN: ring full
                 if deadline is not None and time.monotonic() < deadline:
@@ -254,6 +269,12 @@ class ShmQueue(_ShmQueueBase):
                 item = cloudpickle.loads(api.get(ref))
                 api.delete(ref)  # free the spilled segment (consumer-side)
             items.append(item)
+        if items:
+            reg = _obs.registry()
+            if reg is not None:
+                reg.counter("rlt_queue_get_items_total", impl="shm").inc(
+                    len(items)
+                )
         return items
 
     def empty(self) -> bool:

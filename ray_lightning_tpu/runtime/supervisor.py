@@ -27,6 +27,17 @@ A rank only arms its watchdog AFTER its first heartbeat: bring-up work
 (spawn, jax.distributed handshake, first XLA compile) has unbounded
 latency and must not trip the hang detector. Startup itself can be bounded
 separately via ``startup_timeout`` (disabled by default).
+
+The supervisor doubles as the telemetry tap on the heartbeat channel:
+beats may carry a 4th payload element (metric snapshots + trace events,
+see ``session.py``) which is forwarded to an attached
+``observability.aggregator.DriverAggregator`` along with heartbeat
+one-way latency and last-beat-age gauges, and crash/hang/straggler
+verdicts are appended to its JSONL flight record even when full
+telemetry is off. With ``hang_timeout=None`` the supervisor runs in
+monitor-only mode: it pumps beats and gauges but never classifies or
+kills — this is how a telemetry-only run (no hang detection requested)
+still gets driver-side aggregation over the existing channel.
 """
 from __future__ import annotations
 
@@ -108,22 +119,29 @@ class Supervisor:
         self,
         num_workers: int,
         drain: Callable[[], List[Tuple[int, int, float]]],
-        hang_timeout: float,
+        hang_timeout: Optional[float],
         heartbeat_interval: float = 1.0,
         kill_group: Optional[Callable[[], None]] = None,
         is_alive: Optional[Callable[[int], bool]] = None,
         startup_timeout: Optional[float] = None,
         label: str = "workers",
+        aggregator: Optional[object] = None,
     ):
         # a timeout below a couple of heartbeat periods would flag healthy
-        # workers; clamp rather than error so the knobs stay independent
-        self.hang_timeout = max(float(hang_timeout), 2.0 * heartbeat_interval)
+        # workers; clamp rather than error so the knobs stay independent.
+        # None/0 => monitor-only mode: no classification, no kills.
+        self.hang_timeout = (
+            max(float(hang_timeout), 2.0 * heartbeat_interval)
+            if hang_timeout
+            else None
+        )
         self.heartbeat_interval = float(heartbeat_interval)
         self.startup_timeout = startup_timeout
         self._drain = drain
         self._kill_group = kill_group
         self._is_alive = is_alive
         self._label = label
+        self._aggregator = aggregator
         self.health: Dict[int, WorkerHealth] = {
             r: WorkerHealth(rank=r) for r in range(num_workers)
         }
@@ -150,7 +168,27 @@ class Supervisor:
     # ------------------------------------------------------------------ #
     # observation
     # ------------------------------------------------------------------ #
-    def observe(self, rank: int, step: int, wall_time: float) -> None:
+    def ingest(self, beat) -> None:
+        """Parse one drained beat — ``(rank, step, wall)`` or the
+        telemetry-carrying ``(rank, step, wall, payload)`` — and feed it
+        to :meth:`observe`. Malformed beats are dropped."""
+        payload = None
+        try:
+            if len(beat) == 4:
+                rank, step, wall, payload = beat
+            else:
+                rank, step, wall = beat
+        except (TypeError, ValueError):
+            return
+        self.observe(rank, step, wall, payload=payload)
+
+    def observe(
+        self,
+        rank: int,
+        step: int,
+        wall_time: float,
+        payload: Optional[dict] = None,
+    ) -> None:
         """Ingest one heartbeat (exposed for unit tests; the thread calls
         this from drained queue batches)."""
         h = self.health.get(rank)
@@ -159,26 +197,59 @@ class Supervisor:
         h.last_beat = time.monotonic()
         h.last_step = max(h.last_step, int(step))
         h.warned_slow = False  # a fresh tick ends the incident
+        agg = self._aggregator
+        if agg is not None:
+            try:
+                agg.on_beat(rank, step, wall_time, payload)
+            except Exception:  # telemetry must never break supervision
+                logger.debug("aggregator.on_beat failed", exc_info=True)
 
     def check(self, now: Optional[float] = None) -> Dict[int, str]:
         """Classify every rank; logs straggler warnings, returns verdicts.
-        (Also exposed for unit tests — drives the same logic as the thread.)"""
+        (Also exposed for unit tests — drives the same logic as the thread.)
+        Monitor-only supervisors (``hang_timeout=None``) report every rank
+        OK but still publish last-beat-age gauges."""
         now = time.monotonic() if now is None else now
         out: Dict[int, str] = {}
+        agg = self._aggregator
         for rank, h in self.health.items():
+            if agg is not None and h.last_beat is not None:
+                try:
+                    agg.heartbeat_age(rank, now - h.last_beat)
+                except Exception:
+                    pass
+            if self.hang_timeout is None:
+                out[rank] = OK
+                continue
             verdict = classify(h, now, self.hang_timeout, self.startup_timeout)
             if verdict == SLOW and not h.warned_slow:
                 h.warned_slow = True
+                silent = now - (h.last_beat or h.started)
                 logger.warning(
                     "rank %d is straggling: no heartbeat for %.1fs "
                     "(last step %d, hang_timeout %.1fs)",
                     rank,
-                    now - (h.last_beat or h.started),
+                    silent,
                     h.last_step,
                     self.hang_timeout,
                 )
+                self._record_event(
+                    "straggler",
+                    rank=rank,
+                    silent_s=round(silent, 3),
+                    last_step=h.last_step,
+                    hang_timeout=self.hang_timeout,
+                )
             out[rank] = verdict
         return out
+
+    def _record_event(self, kind: str, **fields) -> None:
+        agg = self._aggregator
+        if agg is not None:
+            try:
+                agg.record_event(kind, label=self._label, **fields)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------ #
     # verdict
@@ -202,11 +273,7 @@ class Supervisor:
         while not self._stop.wait(self._poll_interval):
             try:
                 for beat in self._drain() or []:
-                    try:
-                        rank, step, wall = beat
-                    except (TypeError, ValueError):
-                        continue
-                    self.observe(rank, step, wall)
+                    self.ingest(beat)
             except Exception:
                 # the hb queue dying mid-teardown must not kill the thread;
                 # silence simply ages the ranks out
@@ -239,6 +306,12 @@ class Supervisor:
             f"killing the worker group"
         )
         logger.error(msg)
+        self._record_event(
+            "hang",
+            ranks=hung,
+            last_steps={r: self.health[r].last_step for r in hung},
+            hang_timeout=self.hang_timeout,
+        )
         # verdict BEFORE the kill: once workers start dying their futures
         # settle as generic connection_lost, and the poller must already
         # see the hang classification instead of racing against it

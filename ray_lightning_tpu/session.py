@@ -8,12 +8,19 @@ process. On top of that the session owns the worker side of health
 supervision: ``heartbeat(step)`` publishes ``(rank, step, wall_time)``
 ticks (throttled to ``heartbeat_interval``) that the driver's
 ``runtime.supervisor`` consumes to tell live workers from hung ones.
+
+When telemetry is enabled (``RLT_TELEMETRY=1`` / ``telemetry=True``)
+beats grow a fourth element — a dict of drained trace events and metric
+snapshot deltas (``observability.collect_beat_payload``) — so the
+driver-side aggregator gets its data over the channel that already
+exists. The supervisor accepts both the 3- and 4-tuple forms.
 """
 from __future__ import annotations
 
 import time
 from typing import Any, Callable, Optional
 
+from ray_lightning_tpu import observability as _obs
 from ray_lightning_tpu.runtime import faults
 
 # how long a worker will wait to deliver a report before giving up with a
@@ -72,8 +79,32 @@ class RayLightningSession:
         if faults.heartbeats_dropped(step):
             return
         self._last_beat = now
+        payload = _obs.collect_beat_payload()
+        beat = (
+            (self._rank, int(step), time.time())
+            if payload is None
+            else (self._rank, int(step), time.time(), payload)
+        )
         try:
-            self._heartbeat.put((self._rank, int(step), time.time()), timeout=1.0)
+            self._heartbeat.put(beat, timeout=1.0)
+        except Exception:
+            pass
+
+    def flush_telemetry(self, step: int) -> None:
+        """Force one final beat carrying a full telemetry payload (ring
+        drain + cumulative metrics snapshot). Called when a worker's
+        trainer entry point returns, so short runs that never crossed a
+        heartbeat interval still reach the driver aggregator. Best-effort
+        like every other beat."""
+        if self._heartbeat is None:
+            return
+        payload = _obs.collect_beat_payload(final=True)
+        if payload is None:
+            return
+        try:
+            self._heartbeat.put(
+                (self._rank, int(step), time.time(), payload), timeout=2.0
+            )
         except Exception:
             pass
 
@@ -130,3 +161,10 @@ def emit_heartbeat(step: int, force: bool = False) -> None:
     session (in-process strategies) or no heartbeat channel is configured."""
     if _session is not None:
         _session.heartbeat(step, force=force)
+
+
+def flush_telemetry(step: int = 0) -> None:
+    """Ship any pending telemetry on a final forced beat; no-op without a
+    session, a heartbeat channel, or enabled telemetry."""
+    if _session is not None:
+        _session.flush_telemetry(step)

@@ -44,12 +44,14 @@ class Strategy:
         dcn_grad_compression: Optional[str] = None,
         heartbeat_interval: Optional[float] = None,
         hang_timeout: Optional[float] = None,
+        telemetry: Optional[bool] = None,
     ):
         self.mesh_spec = mesh_spec or MeshSpec.data_parallel()
         self.sharding_policy = sharding_policy or ShardingPolicy.ddp()
         self._dcn_grad_compression = dcn_grad_compression
         self._heartbeat_interval = heartbeat_interval
         self._hang_timeout = hang_timeout
+        self._telemetry = telemetry
         self._mesh: Optional[Mesh] = None
         self._trainer = None
         self._module = None
@@ -109,6 +111,19 @@ class Strategy:
                 f"hang_timeout (RLT_HANG_TIMEOUT) must be >= 0, got {value}"
             )
         return value or None
+
+    @property
+    def telemetry(self) -> bool:
+        """Whether the distributed flight recorder is on (spans + metrics
+        shipped to the driver aggregator over the heartbeat channel; see
+        ``observability/``). Off by default — instrumented paths reduce to
+        a single attribute check. Constructor argument wins; otherwise the
+        ``RLT_TELEMETRY`` env var (``1``/``true``/``yes``/``on``)."""
+        if self._telemetry is not None:
+            return bool(self._telemetry)
+        from ray_lightning_tpu.observability import env_enabled
+
+        return env_enabled()
 
     # ------------------------------------------------------------------ #
     # wiring
@@ -290,6 +305,7 @@ class XLAStrategy(Strategy):
         dcn_grad_compression: Optional[str] = None,
         heartbeat_interval: Optional[float] = None,
         hang_timeout: Optional[float] = None,
+        telemetry: Optional[bool] = None,
     ):
         super().__init__(
             mesh_spec,
@@ -297,6 +313,7 @@ class XLAStrategy(Strategy):
             dcn_grad_compression=dcn_grad_compression,
             heartbeat_interval=heartbeat_interval,
             hang_timeout=hang_timeout,
+            telemetry=telemetry,
         )
         self._num_devices = devices
 

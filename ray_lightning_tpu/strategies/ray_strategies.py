@@ -60,6 +60,7 @@ class RayStrategy(XLAStrategy):
         max_failures: int = 0,
         heartbeat_interval: Optional[float] = None,
         hang_timeout: Optional[float] = None,
+        telemetry: Optional[bool] = None,
         **kwargs: Any,
     ):
         super().__init__(
@@ -68,6 +69,7 @@ class RayStrategy(XLAStrategy):
             dcn_grad_compression=dcn_grad_compression,
             heartbeat_interval=heartbeat_interval,
             hang_timeout=hang_timeout,
+            telemetry=telemetry,
         )
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
@@ -150,6 +152,10 @@ class RayStrategy(XLAStrategy):
         elif self.platform:
             env["JAX_PLATFORMS"] = self.platform
         # else: inherit (workers grab the TPU; driver should stay off it)
+        # the telemetry verdict must be explicit in the child: a ctor-only
+        # telemetry=True would otherwise be invisible to the worker's boot
+        # phase (spans start before the strategy payload is unpickled)
+        env["RLT_TELEMETRY"] = "1" if self.telemetry else "0"
         return env
 
     # ------------------------------------------------------------------ #

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Flight-recorder demo: a tiny 2-worker CPU fit with RLT_TELEMETRY=1,
+# then the aggregated cluster summary. Artifacts (trace.json for
+# ui.perfetto.dev, metrics.json/.prom, events.jsonl) land in the printed
+# telemetry directory. See docs/observability.md.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export RLT_TELEMETRY=1
+# CPU is logical scheduling bookkeeping (same default as tests/conftest.py);
+# cramped containers would otherwise refuse to place two workers
+export RLT_NUM_CPUS="${RLT_NUM_CPUS:-64}"
+
+ROOT="${1:-$(mktemp -d /tmp/rlt_obs_demo.XXXXXX)}"
+
+python - "$ROOT" <<'EOF'
+import sys
+
+import ray_lightning_tpu as rlt
+from tests.utils import BoringModel, get_trainer
+
+root = sys.argv[1]
+strategy = rlt.RayStrategy(
+    num_workers=2,
+    platform="cpu",
+    devices_per_worker=2,
+    heartbeat_interval=0.1,
+)
+trainer = get_trainer(root, strategy=strategy, limit_train_batches=8)
+trainer.fit(BoringModel())
+print(f"\ntelemetry artifacts in {root}/telemetry:")
+EOF
+
+ls -l "$ROOT/telemetry"
+echo
+python -m ray_lightning_tpu.cli top --dir "$ROOT/telemetry"
