@@ -41,6 +41,17 @@ probe fails (the tunnel is known to wedge for long stretches), the
 cached real-TPU result is reported — flagged detail.cached=true with
 the live error — instead of a CPU fallback. scripts/bench_prober.py
 retries in a loop with backoff to populate the cache during a round.
+A failed probe also caches its NEGATIVE verdict (system temp dir,
+short TTL via RLT_BENCH_PROBE_TTL, default 900s) so follow-up
+invocations skip the probe timeout entirely; explicit
+``--platform native`` bypasses the verdict and probes live.
+
+Input-pipeline sweep: every successful measurement also attaches
+detail.input_pipeline — a CPU-pinned sync-vs-async feeding comparison
+(AsyncLoader 2 workers + DevicePrefetcher depth 2) with
+RLT_BENCH_SLOW_LOADER ms (default 10) of emulated host-loading latency
+— and detail.input_starved_ms, the async path's residual starvation.
+RLT_BENCH_INPUT_SWEEP=0 disables.
 
 Honesty contract: vs_baseline measures MFU against the 40% target on
 REAL silicon only. Any run whose platform is not tpu/axon reports
@@ -54,6 +65,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 
@@ -552,6 +564,135 @@ def _attach_dcn_sweep(result: dict, here: str, env: dict) -> None:
         }
 
 
+def _input_microbench(
+    delay_ms: float = 0.0,
+    num_workers: int = 0,
+    prefetch_depth: int = 0,
+    steps: int = 24,
+    batch: int = 8,
+    dim: int = 256,
+) -> dict:
+    """Time a small jitted step fed through the input pipeline.
+
+    ``delay_ms`` is injected into collate to emulate a slow host loader
+    (decode/IO); ``num_workers=0, prefetch_depth=0`` is the synchronous
+    baseline, anything else routes through AsyncLoader + DevicePrefetcher.
+    Importable so tests can run the comparison in-process. Returns
+    {"steps", "steps_per_sec", "input_starved_ms"}.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.core.data import DataLoader, RandomDataset, default_collate
+    from ray_lightning_tpu.core.prefetch import AsyncLoader, DevicePrefetcher
+
+    delay_s = max(0.0, float(delay_ms)) / 1e3
+
+    def collate(items):
+        if delay_s:
+            time.sleep(delay_s)
+        return default_collate(items)
+
+    @jax.jit
+    def step(w, x):
+        for _ in range(8):
+            x = jnp.tanh(x @ w)
+        return w + 1e-4 * jnp.mean(x) * jnp.eye(w.shape[0], dtype=w.dtype), x
+
+    dataset = RandomDataset(dim, steps * batch)
+    loader = DataLoader(
+        dataset, batch_size=batch, collate_fn=collate, drop_last=True
+    )
+    w = jnp.eye(dim, dtype=jnp.float32)
+    w, out = step(w, jnp.asarray(dataset.data[:batch]))  # compile outside timing
+    jax.block_until_ready(out)
+
+    src = (
+        AsyncLoader(loader, num_workers=num_workers, prefetch_factor=2)
+        if num_workers > 0
+        else loader
+    )
+    pf = DevicePrefetcher(jax.device_put, depth=prefetch_depth)
+    n = 0
+    t0 = time.perf_counter()
+    for _idx, _host, device_batch in pf.iterate(src):
+        w, out = step(w, device_batch)
+        n += 1
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return {
+        "steps": n,
+        "steps_per_sec": round(n / max(dt, 1e-9), 2),
+        "input_starved_ms": round(pf.starved_s * 1e3, 2),
+    }
+
+
+def _input_sweep(args: argparse.Namespace) -> int:
+    """Child: the async-input-pipeline sweep (--_input_sweep).
+
+    Runs the microbench twice — synchronous loading vs AsyncLoader(2
+    workers) + DevicePrefetcher(depth 2) — with RLT_BENCH_SLOW_LOADER ms
+    of emulated host-loading latency per batch (default 10), and reports
+    the speedup plus the starvation counter both ways. CPU-pinned: this
+    measures pipeline overlap, not chip FLOPs.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    delay_ms = _env_timeout("RLT_BENCH_SLOW_LOADER", 10.0)
+    sync = _input_microbench(delay_ms, num_workers=0, prefetch_depth=0)
+    pipelined = _input_microbench(delay_ms, num_workers=2, prefetch_depth=2)
+    print(
+        json.dumps(
+            {
+                "platform": "cpu",
+                "slow_loader_ms": round(delay_ms, 2),
+                "num_workers": 2,
+                "prefetch_depth": 2,
+                "steps_per_sec": {
+                    "sync": sync["steps_per_sec"],
+                    "async": pipelined["steps_per_sec"],
+                },
+                "speedup": round(
+                    pipelined["steps_per_sec"] / max(sync["steps_per_sec"], 1e-9), 2
+                ),
+                "input_starved_ms": {
+                    "sync": sync["input_starved_ms"],
+                    "async": pipelined["input_starved_ms"],
+                },
+            }
+        )
+    )
+    return 0
+
+
+def _attach_input_sweep(result: dict, here: str, env: dict) -> None:
+    """Attach detail.input_pipeline (sync vs async input feeding) and the
+    headline detail.input_starved_ms to a fresh measurement. Like the DCN
+    sweep the child is CPU-pinned — it never acquires the chip.
+    RLT_BENCH_INPUT_SWEEP=0 disables; RLT_BENCH_SLOW_LOADER sets the
+    emulated per-batch host latency in ms."""
+    if os.environ.get("RLT_BENCH_INPUT_SWEEP", "1") == "0":
+        return
+    sweep_env = dict(env)
+    sweep_env["JAX_PLATFORMS"] = "cpu"
+    ok, sweep, serr = _run(
+        [sys.executable, here, "--_input_sweep"],
+        _env_timeout("RLT_BENCH_INPUT_TIMEOUT", 300.0),
+        sweep_env,
+    )
+    detail = result.setdefault("detail", {})
+    if ok and isinstance(sweep, dict) and "steps_per_sec" in sweep:
+        detail["input_pipeline"] = sweep
+        detail["input_starved_ms"] = sweep["input_starved_ms"]["async"]
+    else:
+        detail["input_pipeline"] = {
+            "error": (sweep or {}).get("error")
+            or serr
+            or "sweep produced no JSON"
+        }
+
+
 def _last_json_dict(stdout: str):
     for line in reversed((stdout or "").strip().splitlines()):
         try:
@@ -633,6 +774,55 @@ def _cache_path(preset: str) -> str:
     if preset == "mini":
         return os.path.join(_CACHE_DIR, ".bench_tpu_cache.json")
     return os.path.join(_CACHE_DIR, f".bench_tpu_cache_{preset}.json")
+
+
+# negative probe-verdict cache: when the native backend just failed to
+# probe, every subsequent bare invocation inside the TTL would otherwise
+# re-pay the full probe timeout (default 600s) before reaching the same
+# CPU-fallback conclusion. Lives under the system temp dir (per-uid), NOT
+# next to the repo: it is transient machine state, and round snapshots
+# must never carry a "TPU is down" verdict forward.
+_PROBE_CACHE_DIR = tempfile.gettempdir()
+
+
+def _probe_cache_path() -> str:
+    uid = getattr(os, "getuid", lambda: 0)()
+    return os.path.join(_PROBE_CACHE_DIR, f"rlt_bench_probe_verdict_{uid}.json")
+
+
+def _load_probe_verdict():
+    """Return (error, age_s) for a fresh cached NEGATIVE probe verdict,
+    else (None, None). TTL is short (RLT_BENCH_PROBE_TTL, default 900s):
+    the tunnel does come back, and a stale verdict must not keep a healthy
+    chip on the CPU path."""
+    try:
+        with open(_probe_cache_path()) as f:
+            payload = json.load(f)
+        err = payload.get("error")
+        age = time.time() - float(payload.get("saved_at") or 0)
+        if err and 0 <= age < _env_timeout("RLT_BENCH_PROBE_TTL", 900.0):
+            return str(err), age
+    except (OSError, ValueError, TypeError):
+        pass
+    return None, None
+
+
+def _save_probe_verdict(error: str) -> None:
+    try:
+        path = _probe_cache_path()
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"saved_at": time.time(), "error": str(error)}, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def _clear_probe_verdict() -> None:
+    try:
+        os.unlink(_probe_cache_path())
+    except OSError:
+        pass
 
 
 def _is_on_chip(result: dict) -> bool:
@@ -729,6 +919,7 @@ def main() -> int:
     parser.add_argument("--_probe", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_dcn_sweep", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--_input_sweep", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
 
     if args._probe:
@@ -737,6 +928,8 @@ def main() -> int:
         return _child(args)
     if args._dcn_sweep:
         return _dcn_sweep(args)
+    if args._input_sweep:
+        return _input_sweep(args)
 
     probe_timeout = _env_timeout("RLT_BENCH_PROBE_TIMEOUT", 600.0)
     bench_timeout = _env_timeout("RLT_BENCH_TIMEOUT", 1800.0)
@@ -787,25 +980,43 @@ def main() -> int:
         args.platform != "native" and _env_demands_cpu(env.get("JAX_PLATFORMS"))
     )
     if not force_cpu:
-        ok, probe_res, perr = _run(
-            [sys.executable, here, "--_probe"], probe_timeout, env
+        # a fresh cached NEGATIVE probe verdict skips straight to the
+        # fallback ladder — the probe timeout is 600s by default, and
+        # re-paying it for every invocation while the tunnel is known-down
+        # starves the round. An explicit --platform native always probes
+        # live: that is the prober's (and an operator's) "is it back?"
+        # question, which a cached "no" would answer wrongly forever.
+        verdict, verdict_age = (
+            (None, None) if args.platform == "native" else _load_probe_verdict()
         )
-        if ok:
-            # all on-chip work (flash autotune, ceiling, measurement)
-            # happens inside ONE child — see module docstring
-            ok, result, berr = _run(
-                [sys.executable, here, "--_child"] + passthrough,
-                bench_timeout, env,
+        if verdict is not None:
+            error = (
+                f"native backend probe failed ({verdict}; cached verdict, "
+                f"age {verdict_age:.0f}s; --platform native re-probes live)"
+            )
+        else:
+            ok, probe_res, perr = _run(
+                [sys.executable, here, "--_probe"], probe_timeout, env
             )
             if ok:
-                _attach_dcn_sweep(result, here, env)
-                if _is_on_chip(result):
-                    _save_tpu_cache(result, _args_key(args))
-                print(json.dumps(result))
-                return 0
-            error = f"native bench failed ({berr})"
-        else:
-            error = f"native backend probe failed ({perr})"
+                _clear_probe_verdict()
+                # all on-chip work (flash autotune, ceiling, measurement)
+                # happens inside ONE child — see module docstring
+                ok, result, berr = _run(
+                    [sys.executable, here, "--_child"] + passthrough,
+                    bench_timeout, env,
+                )
+                if ok:
+                    _attach_dcn_sweep(result, here, env)
+                    _attach_input_sweep(result, here, env)
+                    if _is_on_chip(result):
+                        _save_tpu_cache(result, _args_key(args))
+                    print(json.dumps(result))
+                    return 0
+                error = f"native bench failed ({berr})"
+            else:
+                error = f"native backend probe failed ({perr})"
+                _save_probe_verdict(perr)
         # a real measurement captured earlier in the round beats any
         # fallback: the tunnel wedges for long stretches, and losing a
         # number that was already taken on silicon forfeits the perf axis.
@@ -840,6 +1051,7 @@ def main() -> int:
         result = _fail_result({"cpu_error": cerr})
     else:
         _attach_dcn_sweep(result, here, env)
+        _attach_input_sweep(result, here, env)
     if error:
         result.setdefault("detail", {})["error"] = error
     print(json.dumps(result))

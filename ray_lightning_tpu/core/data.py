@@ -235,6 +235,8 @@ class DataLoader:
         collate_fn: Optional[Callable] = None,
         seed: int = 0,
         sampler: Optional[DistributedSampler] = None,
+        num_workers: int = 0,
+        prefetch_factor: int = 2,
     ):
         self.dataset = dataset
         self.batch_size = int(batch_size)
@@ -243,6 +245,12 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate
         self.seed = seed
         self.sampler = sampler
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = int(prefetch_factor)
+        if self.num_workers < 0:
+            raise ValueError(f"num_workers must be >= 0, got {num_workers}")
+        if self.prefetch_factor < 1:
+            raise ValueError(f"prefetch_factor must be >= 1, got {prefetch_factor}")
         self._epoch = 0
 
     # the strategy re-wraps loaders with a rank-sharding sampler
@@ -255,6 +263,8 @@ class DataLoader:
             collate_fn=self.collate_fn,
             seed=self.seed,
             sampler=sampler,
+            num_workers=self.num_workers,
+            prefetch_factor=self.prefetch_factor,
         )
 
     def set_epoch(self, epoch: int) -> None:
@@ -268,7 +278,10 @@ class DataLoader:
             return n // self.batch_size
         return math.ceil(n / self.batch_size)
 
-    def __iter__(self):
+    # split iteration protocol, consumed by prefetch.AsyncLoader: the plan
+    # (index chunks) is cheap and ordered, the assembly (__getitem__ +
+    # collate + numpy conversion) is the parallelizable work
+    def _batch_plan(self):
         if self.sampler is not None:
             indices = list(self.sampler)
         elif self.shuffle:
@@ -282,7 +295,21 @@ class DataLoader:
             chunk = indices[start : start + bs]
             if self.drop_last and len(chunk) < bs:
                 break
-            yield _to_numpy_tree(self.collate_fn([self.dataset[i] for i in chunk]))
+            yield chunk
+
+    def _assemble(self, chunk):
+        return _to_numpy_tree(self.collate_fn([self.dataset[i] for i in chunk]))
+
+    def __iter__(self):
+        if self.num_workers > 0:
+            # torch-parity: num_workers>0 moves assembly off the calling
+            # thread (threads, not processes — the work is numpy/IO bound)
+            from ray_lightning_tpu.core.prefetch import AsyncLoader
+
+            yield from AsyncLoader(self)
+            return
+        for chunk in self._batch_plan():
+            yield self._assemble(chunk)
 
 
 class _ForeignLoader:

@@ -1,0 +1,312 @@
+"""Asynchronous input feeding: host-side batch assembly off the training
+thread (:class:`AsyncLoader`) and an N-deep device-transfer lookahead
+(:class:`DevicePrefetcher`).
+
+Why two layers: the host pipeline (index -> ``dataset[i]`` -> collate ->
+``_to_numpy_tree``) is Python/numpy work that can overlap step *dispatch*,
+and the host->device copy is an async jax transfer that can overlap step
+*compute*. ``AsyncLoader`` moves the first off the training thread into a
+bounded queue; ``DevicePrefetcher`` keeps up to ``depth`` sharded batches
+resident so XLA's transfer engine runs ahead of the compute stream. Both
+preserve the synchronous loop's observable semantics: batches arrive in
+order, and a batch that fails to assemble or shard surfaces its exception
+at the same step the inline loop would have raised it — after every
+earlier (good) batch has been yielded.
+
+Shutdown is tied to iterator lifetime: breaking out of a ``for`` loop
+closes the generator, which stops the feeder thread, drains the queue,
+cancels in-flight work and joins the pool — no leaked threads on a
+``max_steps`` early exit.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, Optional
+
+__all__ = ["AsyncLoader", "DevicePrefetcher", "ensure_async"]
+
+# queue item kinds: a future to resolve, a ready value, a forwarded
+# exception, or end-of-epoch
+_FUTURE, _VALUE, _ERROR, _END = 0, 1, 2, 3
+
+_THREAD_PREFIX = "rlt-input"
+
+
+def _put(q: "queue.Queue", item, stop: threading.Event) -> bool:
+    """Bounded put that gives up once the consumer has gone away, so an
+    abandoned feeder can never deadlock on a full queue."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.05)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+class AsyncLoader:
+    """Iterate a loader on background threads into a bounded queue.
+
+    Two feeding modes, picked per underlying loader:
+
+    - loaders exposing the split protocol (``_batch_plan()`` yielding index
+      chunks + ``_assemble(chunk)`` building one batch — this package's
+      :class:`~ray_lightning_tpu.core.data.DataLoader`) get ``num_workers``
+      pool threads assembling batches concurrently, with queue order pinned
+      to plan order because the queue carries futures in submission order;
+    - arbitrary iterables (``_ForeignLoader``-wrapped torch loaders, plain
+      generators) are inherently serial, so one feeder thread runs the
+      iteration itself and enqueues ready batches.
+
+    The queue holds ``num_workers * prefetch_factor`` slots, bounding
+    resident host batches. ``set_epoch``/``__len__`` forward to the inner
+    loader; each ``__iter__`` spawns fresh threads and tears them down when
+    the epoch ends or the consumer abandons the iterator.
+    """
+
+    def __init__(
+        self,
+        loader: Iterable,
+        num_workers: Optional[int] = None,
+        prefetch_factor: Optional[int] = None,
+    ):
+        self.loader = loader
+        if num_workers is None:
+            num_workers = getattr(loader, "num_workers", None)
+        self.num_workers = max(1, int(num_workers)) if num_workers else 1
+        if prefetch_factor is None:
+            prefetch_factor = getattr(loader, "prefetch_factor", None)
+        self.prefetch_factor = max(1, int(prefetch_factor)) if prefetch_factor else 2
+        self._q: Optional[queue.Queue] = None
+
+    # ------------------------------------------------------------------ #
+    # loader API forwarding
+    # ------------------------------------------------------------------ #
+    def set_epoch(self, epoch: int) -> None:
+        inner = getattr(self.loader, "set_epoch", None)
+        if callable(inner):
+            inner(epoch)
+
+    def __len__(self):
+        return len(self.loader)
+
+    def qsize(self) -> int:
+        """Current prefetch-queue depth (0 outside an active iteration)."""
+        q = self._q
+        return q.qsize() if q is not None else 0
+
+    # ------------------------------------------------------------------ #
+    # iteration
+    # ------------------------------------------------------------------ #
+    def __iter__(self):
+        plan = getattr(self.loader, "_batch_plan", None)
+        assemble = getattr(self.loader, "_assemble", None)
+        if callable(plan) and callable(assemble):
+            return self._iter_pooled(plan, assemble)
+        return self._iter_serial()
+
+    def _iter_pooled(self, plan: Callable, assemble: Callable):
+        q: "queue.Queue" = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
+        stop = threading.Event()
+        pool = ThreadPoolExecutor(
+            max_workers=self.num_workers, thread_name_prefix=f"{_THREAD_PREFIX}-pool"
+        )
+
+        def feed():
+            try:
+                for chunk in plan():
+                    if stop.is_set():
+                        return
+                    if not _put(q, (_FUTURE, pool.submit(assemble, chunk)), stop):
+                        return
+            except BaseException as exc:  # forward plan errors in order
+                _put(q, (_ERROR, exc), stop)
+            finally:
+                _put(q, (_END, None), stop)
+
+        feeder = threading.Thread(
+            target=feed, name=f"{_THREAD_PREFIX}-feed", daemon=True
+        )
+        self._q = q
+        feeder.start()
+        try:
+            while True:
+                kind, payload = q.get()
+                if kind == _END:
+                    return
+                if kind == _ERROR:
+                    raise payload
+                yield payload.result()
+        finally:
+            self._shutdown(q, stop, feeder)
+            pool.shutdown(wait=True, cancel_futures=True)
+
+    def _iter_serial(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
+        stop = threading.Event()
+
+        def feed():
+            try:
+                for batch in self.loader:
+                    if stop.is_set():
+                        return
+                    if not _put(q, (_VALUE, batch), stop):
+                        return
+            except BaseException as exc:
+                _put(q, (_ERROR, exc), stop)
+            finally:
+                _put(q, (_END, None), stop)
+
+        feeder = threading.Thread(
+            target=feed, name=f"{_THREAD_PREFIX}-feed", daemon=True
+        )
+        self._q = q
+        feeder.start()
+        try:
+            while True:
+                kind, payload = q.get()
+                if kind == _END:
+                    return
+                if kind == _ERROR:
+                    raise payload
+                yield payload
+        finally:
+            self._shutdown(q, stop, feeder)
+
+    def _shutdown(self, q: "queue.Queue", stop: threading.Event, feeder) -> None:
+        stop.set()
+        self._q = None
+        # unblock a feeder stuck on a full queue, cancel queued work
+        while True:
+            try:
+                kind, payload = q.get_nowait()
+            except queue.Empty:
+                break
+            if kind == _FUTURE:
+                payload.cancel()
+        feeder.join(timeout=10.0)
+
+
+def ensure_async(
+    loader: Iterable,
+    num_workers: Optional[int] = None,
+    prefetch_factor: Optional[int] = None,
+) -> AsyncLoader:
+    """Wrap ``loader`` in an :class:`AsyncLoader` unless it already is one."""
+    if isinstance(loader, AsyncLoader):
+        return loader
+    return AsyncLoader(loader, num_workers=num_workers, prefetch_factor=prefetch_factor)
+
+
+class DevicePrefetcher:
+    """N-deep device-side input lookahead.
+
+    Generalizes the trainer's historical one-slot prefetch: up to ``depth``
+    batches beyond the one being trained are sharded (their host->device
+    transfers dispatched — jax transfers are async) while the caller runs
+    the current step on the compute stream. ``depth=0`` is the synchronous
+    path; ``depth=1`` reproduces the old single-slot behavior. Costs
+    ``depth`` extra resident batches on device.
+
+    Error contract (matches the synchronous loop): a batch that fails to
+    load or shard must not swallow already-sharded good batches — they are
+    yielded first, then the exception surfaces at the step the inline loop
+    would have raised it.
+
+    The wall-clock the *training thread* spends blocked waiting on the host
+    loader accumulates in ``starved_s`` (always, it is two clock reads per
+    batch); with a flight recorder attached it is also published as the
+    ``rlt_input_starved_seconds`` counter, the ``rlt_prefetch_queue_depth``
+    gauge and per-batch ``host_batch``/``h2d`` spans.
+    """
+
+    def __init__(
+        self,
+        shard_fn: Callable[[Any], Any],
+        depth: int = 2,
+        recorder: Any = None,
+    ):
+        self.shard_fn = shard_fn
+        self.depth = max(0, int(depth))
+        self.recorder = recorder
+        self.starved_s = 0.0
+        self.batches = 0
+
+    def iterate(self, loader: Iterable, limit: Optional[int] = None):
+        """Yield ``(idx, host_batch, device_batch)`` with the lookahead."""
+        rec = self.recorder
+        starved_c = depth_g = None
+        if rec is not None:
+            from ray_lightning_tpu.observability import metrics as _metrics
+
+            reg = _metrics.get_registry()
+            starved_c = reg.counter("rlt_input_starved_seconds")
+            depth_g = reg.gauge("rlt_prefetch_queue_depth")
+        qsize = getattr(loader, "qsize", None)
+
+        it = iter(loader)
+        pending: deque = deque()
+        error: Optional[BaseException] = None
+        exhausted = False
+        next_idx = 0
+        try:
+            while True:
+                # keep the window at depth+1: one batch to yield now plus
+                # ``depth`` transfers in flight behind it
+                while (
+                    not exhausted
+                    and len(pending) <= self.depth
+                    and (limit is None or next_idx < limit)
+                ):
+                    t0 = time.perf_counter()
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    except BaseException as exc:
+                        error = exc
+                        exhausted = True
+                        break
+                    wait = time.perf_counter() - t0
+                    self.starved_s += wait
+                    if rec is not None:
+                        starved_c.inc(wait)
+                        rec.add_span(
+                            "host_batch", time.time() - wait, wait, step=next_idx
+                        )
+                        if qsize is not None:
+                            depth_g.set(qsize())
+                    try:
+                        if rec is not None:
+                            _wall, _t1 = time.time(), time.perf_counter()
+                            device_batch = self.shard_fn(batch)
+                            rec.add_span(
+                                "h2d",
+                                _wall,
+                                time.perf_counter() - _t1,
+                                step=next_idx,
+                            )
+                        else:
+                            device_batch = self.shard_fn(batch)
+                    except BaseException as exc:
+                        error = exc
+                        exhausted = True
+                        break
+                    pending.append((next_idx, batch, device_batch))
+                    next_idx += 1
+                    self.batches += 1
+                if pending:
+                    yield pending.popleft()
+                    continue
+                if error is not None:
+                    raise error
+                return
+        finally:
+            close = getattr(it, "close", None)
+            if callable(close):
+                close()

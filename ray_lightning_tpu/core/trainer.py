@@ -842,6 +842,9 @@ class Trainer:
             obs.enable()
         self._obs = obs.get_recorder()
         self._first_step_dispatched = False
+        self._step_log_buffer = []
+        self._input_prefetcher = None
+        self._input_stats = {"starved_s": 0.0, "batches": 0}
         _setup_wall, _setup_t0 = time.time(), time.perf_counter()
         seed = seed_everything(self.seed)
         self._datamodule = datamodule
@@ -976,6 +979,13 @@ class Trainer:
                 if self.should_stop and self.current_epoch < self.min_epochs:
                     self.should_stop = False
         finally:
+            # an epoch aborted by an exception skips its own drain/fold;
+            # settle both before the logger closes
+            self._drain_step_logs()
+            if self._input_prefetcher is not None:
+                self._input_stats["starved_s"] += self._input_prefetcher.starved_s
+                self._input_stats["batches"] += self._input_prefetcher.batches
+                self._input_prefetcher = None
             self._hook("on_train_end")
             self._hook("on_fit_end")
             if self.logger is not None:
@@ -1034,30 +1044,27 @@ class Trainer:
         obs.event("dcn_compression", step=self.global_step, **summary)
 
     def _prefetch_shard(self, loader, limit):
-        """Yield ``(idx, host_batch, device_batch)`` with a ONE-slot
-        device prefetch: batch N+1 is sharded (its host->device transfer
-        dispatched — jax transfers are async) while the caller runs step N
-        on the compute stream, hiding input-copy latency behind the step.
-        Costs one extra resident batch on device."""
-        prev = None
-        for batch_idx, batch in enumerate(loader):
-            if limit is not None and batch_idx >= limit:
-                break
-            try:
-                cur = (batch_idx, batch, self.strategy.shard_batch(batch))
-            except Exception:
-                # a bad LOOKAHEAD batch (e.g. a ragged final batch failing
-                # the divisibility check) must not swallow the good batch
-                # already sharded: train it, then surface the error at the
-                # same step the non-prefetching loop would have
-                if prev is not None:
-                    yield prev
-                raise
-            if prev is not None:
-                yield prev
-            prev = cur
-        if prev is not None:
-            yield prev
+        """Yield ``(idx, host_batch, device_batch)`` through the async input
+        pipeline: host batch assembly runs on background threads
+        (``AsyncLoader``) and up to ``strategy.prefetch_depth`` batches have
+        their host->device transfers dispatched ahead of the step being
+        trained (``DevicePrefetcher``) — jax transfers are async, so input
+        copies overlap step compute at the cost of ``depth`` extra resident
+        batches. ``strategy.loader_num_workers=0`` (``RLT_LOADER_WORKERS=0``)
+        keeps host loading synchronous on this thread; both layers preserve
+        the inline loop's error step (a bad batch never swallows the good
+        batches sharded before it)."""
+        from ray_lightning_tpu.core.prefetch import AsyncLoader, DevicePrefetcher
+
+        num_workers = self.strategy.loader_num_workers
+        if not isinstance(loader, AsyncLoader) and num_workers != 0:
+            loader = AsyncLoader(loader, num_workers=num_workers)
+        self._input_prefetcher = DevicePrefetcher(
+            self.strategy.shard_batch,
+            depth=self.strategy.prefetch_depth,
+            recorder=self._obs,
+        )
+        return self._input_prefetcher.iterate(loader, limit)
 
     def _health_tick(self, train: bool) -> None:
         """Per-batch liveness tick: fire any scripted fault for this rank at
@@ -1180,6 +1187,16 @@ class Trainer:
             # the epoch marked partial so epoch-end saves resume correctly
             self._epoch_ended = True
 
+        # off the critical path now: flush deferred step metrics, then fold
+        # the epoch's input-pipeline stats into the run totals (the
+        # prefetcher itself is dropped — it holds the recorder and a bound
+        # shard_fn, neither of which should ride a trainer pickle)
+        self._drain_step_logs()
+        if self._input_prefetcher is not None:
+            self._input_stats["starved_s"] += self._input_prefetcher.starved_s
+            self._input_stats["batches"] += self._input_prefetcher.batches
+            self._input_prefetcher = None
+
         # epoch-level train metrics
         epoch_metrics = aggregator.reduce(self._module._log_meta.get)
         epoch_out: Dict[str, np.ndarray] = {}
@@ -1205,10 +1222,14 @@ class Trainer:
 
         if self.enable_progress_bar and self.is_global_zero:
             dt = time.perf_counter() - t_epoch
-            shown = {
-                k: f"{float(np.asarray(v)):.4f}"
-                for k, v in list(self.callback_metrics.items())[:6]
-            }
+            # one batched readback at epoch end (callbacks may have stored
+            # device arrays); non-scalar entries are skipped, not crashed on
+            head = dict(list(self.callback_metrics.items())[:6])
+            shown = {}
+            for k, v in jax.device_get(head).items():
+                v = np.asarray(v)
+                if v.size == 1:
+                    shown[k] = f"{float(v):.4f}"
             print(
                 f"[epoch {self.current_epoch}] {n_batches} steps in {dt:.1f}s {shown}",
                 flush=True,
@@ -1236,15 +1257,42 @@ class Trainer:
             and self.log_every_n_steps
             and self.global_step % self.log_every_n_steps == 0
         ):
+            # deferred: hold the (fresh, non-donated) device scalars and
+            # resolve them in one device_get at the next drain point —
+            # epoch end, validation, or fit teardown — so the hot loop
+            # never blocks on a host readback
             step_metrics = {
-                k: float(np.asarray(jax.device_get(v)))
+                k: v
                 for k, v in self.logged_metrics.items()
                 if not isinstance(v, np.ndarray)
             }
             if step_metrics:
-                self.logger.log_metrics(step_metrics, step=self.global_step)
+                self._step_log_buffer.append((self.global_step, step_metrics))
+
+    def _drain_step_logs(self) -> None:
+        """Resolve and emit the step metrics deferred by
+        ``_record_train_logs``: one batched ``jax.device_get`` for the whole
+        buffer, off the critical path. Non-scalar values are dropped (the
+        logger row format is scalar-only)."""
+        if not self._step_log_buffer:
+            return
+        buffered, self._step_log_buffer = self._step_log_buffer, []
+        if self.logger is None or not self.is_global_zero:
+            return
+        resolved = jax.device_get([metrics for _, metrics in buffered])
+        for (step, _), metrics in zip(buffered, resolved):
+            row = {}
+            for name, value in metrics.items():
+                value = np.asarray(value)
+                if value.size == 1:
+                    row[name] = float(value)
+            if row:
+                self.logger.log_metrics(row, step=step)
 
     def _run_validation(self, val_loader, val_step):
+        # validation is a logger flush point: deferred step rows land
+        # before the val rows so the CSV stays step-ordered
+        self._drain_step_logs()
         with obs.span("validate", step=self.global_step):
             self._hook("on_validation_epoch_start")
             self._cb("on_validation_start")

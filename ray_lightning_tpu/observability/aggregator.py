@@ -154,6 +154,11 @@ class DriverAggregator:
             for name, labels, value in snap.get("gauges", ()):
                 if not labels:
                     gauges[name] = value
+            # counters are cumulative at the source, so latest-wins like
+            # gauges; the input-starved total feeds the summary/top view
+            for name, labels, value in snap.get("counters", ()):
+                if not labels:
+                    gauges[name] = value
             for name, labels, h in snap.get("histograms", ()):
                 if name == STEP_TIME_METRIC:
                     self._step_samples.setdefault(
@@ -219,6 +224,8 @@ class DriverAggregator:
                 ("rlt_samples_per_sec", "samples_per_sec"),
                 ("rlt_train_mfu", "mfu"),
                 ("rlt_tokens_per_sec_per_chip", "tokens_per_sec_per_chip"),
+                ("rlt_input_starved_seconds", "input_starved_s"),
+                ("rlt_prefetch_queue_depth", "prefetch_queue_depth"),
             ):
                 if name in gauges:
                     info[key] = round(gauges[name], 6)
@@ -233,6 +240,13 @@ class DriverAggregator:
             cluster["samples_per_sec"] = round(samples_total, 3)
         if mfus:
             cluster["mfu"] = round(sum(mfus) / len(mfus), 6)
+        starved = [
+            info["input_starved_s"]
+            for info in per_rank.values()
+            if "input_starved_s" in info
+        ]
+        if starved:
+            cluster["input_starved_s"] = round(max(starved), 6)
         steps = [s for s in self._last_step.values() if s is not None]
         if steps:
             cluster["steps_min"] = min(steps)
@@ -349,13 +363,15 @@ def format_summary(summary: Dict[str, Any], events: List[dict]) -> str:
         ("step_time_max_skew", "skew {:.4f}s"),
         ("samples_per_sec", "{:.1f} samples/s"),
         ("mfu", "MFU {:.3f}"),
+        ("input_starved_s", "input starved {:.2f}s"),
     ):
         if key in cl:
             cl_bits.append(fmt.format(cl[key]))
     if cl_bits:
         lines.append("cluster: " + " · ".join(cl_bits))
     header = f"{'rank':>5} {'step':>8} {'p50(s)':>9} {'p90(s)':>9} " \
-             f"{'sps':>9} {'mfu':>7} {'beat age':>9} {'skew(s)':>9}"
+             f"{'sps':>9} {'mfu':>7} {'starve(s)':>9} {'beat age':>9} " \
+             f"{'skew(s)':>9}"
     lines.append(header)
     for rank, info in sorted(summary.get("per_rank", {}).items(), key=lambda kv: kv[0]):
         def _f(key, spec, default="-"):
@@ -368,6 +384,7 @@ def format_summary(summary: Dict[str, Any], events: List[dict]) -> str:
             f"{_f('step_time_p90', '{:.4f}'):>9} "
             f"{_f('samples_per_sec', '{:.1f}'):>9} "
             f"{_f('mfu', '{:.3f}'):>7} "
+            f"{_f('input_starved_s', '{:.2f}'):>9} "
             f"{_f('heartbeat_age_s', '{:.1f}'):>9} "
             f"{_f('clock_skew_s', '{:.4f}'):>9}"
         )

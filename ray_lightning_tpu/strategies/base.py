@@ -45,6 +45,8 @@ class Strategy:
         heartbeat_interval: Optional[float] = None,
         hang_timeout: Optional[float] = None,
         telemetry: Optional[bool] = None,
+        prefetch_depth: Optional[int] = None,
+        loader_num_workers: Optional[int] = None,
     ):
         self.mesh_spec = mesh_spec or MeshSpec.data_parallel()
         self.sharding_policy = sharding_policy or ShardingPolicy.ddp()
@@ -52,6 +54,8 @@ class Strategy:
         self._heartbeat_interval = heartbeat_interval
         self._hang_timeout = hang_timeout
         self._telemetry = telemetry
+        self._prefetch_depth = prefetch_depth
+        self._loader_num_workers = loader_num_workers
         self._mesh: Optional[Mesh] = None
         self._trainer = None
         self._module = None
@@ -111,6 +115,45 @@ class Strategy:
                 f"hang_timeout (RLT_HANG_TIMEOUT) must be >= 0, got {value}"
             )
         return value or None
+
+    @property
+    def prefetch_depth(self) -> int:
+        """Device-side input lookahead: how many batches beyond the one
+        being trained have their host->device transfers dispatched (see
+        ``core/prefetch.DevicePrefetcher``). Costs that many extra resident
+        batches on device; ``0`` is the fully synchronous path. Constructor
+        argument wins; otherwise ``RLT_PREFETCH_DEPTH``; default 2."""
+        value = self._prefetch_depth
+        if value is None:
+            value = os.environ.get("RLT_PREFETCH_DEPTH")
+        if value in (None, ""):
+            return 2
+        value = int(value)
+        if value < 0:
+            raise ValueError(
+                f"prefetch_depth (RLT_PREFETCH_DEPTH) must be >= 0, got {value}"
+            )
+        return value
+
+    @property
+    def loader_num_workers(self) -> Optional[int]:
+        """Background threads assembling host batches for the train loop
+        (see ``core/prefetch.AsyncLoader``). ``None`` (default) defers to
+        the dataloader's own ``num_workers`` hint (else one feeder thread);
+        ``0`` keeps host loading synchronous on the training thread.
+        Constructor argument wins; otherwise ``RLT_LOADER_WORKERS``."""
+        value = self._loader_num_workers
+        if value is None:
+            value = os.environ.get("RLT_LOADER_WORKERS")
+        if value in (None, ""):
+            return None
+        value = int(value)
+        if value < 0:
+            raise ValueError(
+                f"loader_num_workers (RLT_LOADER_WORKERS) must be >= 0, "
+                f"got {value}"
+            )
+        return value
 
     @property
     def telemetry(self) -> bool:
@@ -306,6 +349,8 @@ class XLAStrategy(Strategy):
         heartbeat_interval: Optional[float] = None,
         hang_timeout: Optional[float] = None,
         telemetry: Optional[bool] = None,
+        prefetch_depth: Optional[int] = None,
+        loader_num_workers: Optional[int] = None,
     ):
         super().__init__(
             mesh_spec,
@@ -314,6 +359,8 @@ class XLAStrategy(Strategy):
             heartbeat_interval=heartbeat_interval,
             hang_timeout=hang_timeout,
             telemetry=telemetry,
+            prefetch_depth=prefetch_depth,
+            loader_num_workers=loader_num_workers,
         )
         self._num_devices = devices
 

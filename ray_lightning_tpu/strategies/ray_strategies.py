@@ -61,6 +61,8 @@ class RayStrategy(XLAStrategy):
         heartbeat_interval: Optional[float] = None,
         hang_timeout: Optional[float] = None,
         telemetry: Optional[bool] = None,
+        prefetch_depth: Optional[int] = None,
+        loader_num_workers: Optional[int] = None,
         **kwargs: Any,
     ):
         super().__init__(
@@ -70,6 +72,8 @@ class RayStrategy(XLAStrategy):
             heartbeat_interval=heartbeat_interval,
             hang_timeout=hang_timeout,
             telemetry=telemetry,
+            prefetch_depth=prefetch_depth,
+            loader_num_workers=loader_num_workers,
         )
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")

@@ -24,9 +24,14 @@ def _isolated_cache_dir(tmp_path, monkeypatch):
     these tests observe (e.g. the wedged-probe test would serve the
     cached result instead of the CPU fallback)."""
     monkeypatch.setattr(bench, "_CACHE_DIR", str(tmp_path))
-    # the dcn-compression sweep is opt-in per test: the orchestrator tests
+    # same isolation for the negative probe-verdict cache (lives in the
+    # system temp dir in production): a verdict left by a real run — or
+    # by another test — must not decide whether these tests probe
+    monkeypatch.setattr(bench, "_PROBE_CACHE_DIR", str(tmp_path))
+    # the dcn/input sweeps are opt-in per test: the orchestrator tests
     # assert the exact probe/child spawn sequence
     monkeypatch.setenv("RLT_BENCH_DCN_SWEEP", "0")
+    monkeypatch.setenv("RLT_BENCH_INPUT_SWEEP", "0")
 
 
 def _result(value, **detail):
@@ -341,6 +346,127 @@ def test_dcn_sweep_failure_is_reported_not_fatal(monkeypatch, capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["value"] == 42.0
     assert "timeout" in out["detail"]["dcn_compression"]["error"]
+
+
+def test_input_sweep_attaches_detail(monkeypatch, capsys):
+    """The input-pipeline sweep child's JSON lands in detail.input_pipeline
+    with the async starvation promoted to detail.input_starved_ms, and its
+    spawn is CPU-pinned (never the chip)."""
+    monkeypatch.setenv("RLT_BENCH_INPUT_SWEEP", "1")
+    sweep = {
+        "platform": "cpu",
+        "slow_loader_ms": 10.0,
+        "steps_per_sec": {"sync": 90.0, "async": 180.0},
+        "speedup": 2.0,
+        "input_starved_ms": {"sync": 240.0, "async": 80.0},
+    }
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append(list(cmd))
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        if "--_input_sweep" in cmd:
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            return True, dict(sweep), None
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    assert any("--_input_sweep" in c for c in calls)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 42.0
+    assert out["detail"]["input_pipeline"]["speedup"] == 2.0
+    assert out["detail"]["input_starved_ms"] == 80.0
+
+
+def test_input_sweep_failure_is_reported_not_fatal(monkeypatch, capsys):
+    """A failed input sweep must not cost the measurement."""
+    monkeypatch.setenv("RLT_BENCH_INPUT_SWEEP", "1")
+
+    def fake_run(cmd, timeout, env):
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        if "--_input_sweep" in cmd:
+            return False, None, "timeout after 300s"
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 42.0
+    assert "timeout" in out["detail"]["input_pipeline"]["error"]
+    assert "input_starved_ms" not in out["detail"]
+
+
+def test_probe_failure_caches_negative_verdict(monkeypatch, capsys):
+    """A failed probe saves its verdict; the NEXT bare invocation skips
+    the probe entirely (the 600s timeout is the whole point) and goes
+    straight to the fallback ladder with the cached error disclosed."""
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append(list(cmd))
+        if "--_probe" in cmd:
+            return False, None, "timeout after 600s"
+        return True, _result(10.0, platform="cpu"), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+    assert bench.main() == 0  # run 1: probes live, fails, saves verdict
+    assert any("--_probe" in c for c in calls)
+    verdict, age = bench._load_probe_verdict()
+    assert verdict == "timeout after 600s" and age is not None
+
+    calls.clear()
+    capsys.readouterr()
+    assert bench.main() == 0  # run 2: cached verdict, no probe spawn
+    assert not any("--_probe" in c for c in calls)
+    assert calls and "--_child" in calls[0]
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "cached verdict" in out["detail"]["error"]
+
+
+def test_platform_native_bypasses_cached_verdict(monkeypatch, capsys):
+    """--platform native is the 'is it back?' question: it must probe
+    live even under a fresh negative verdict, and a probe success must
+    clear the verdict so bare invocations probe again too."""
+    bench._save_probe_verdict("timeout after 600s")
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append(list(cmd))
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--platform", "native"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    assert any("--_probe" in c for c in calls), "native pin skipped the probe"
+    assert bench._load_probe_verdict() == (None, None), "success left verdict"
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 42.0
+
+
+def test_probe_verdict_expires_by_ttl(monkeypatch):
+    """The verdict is transient by design: past RLT_BENCH_PROBE_TTL it
+    stops applying (the tunnel does come back)."""
+    bench._save_probe_verdict("timeout after 600s")
+    assert bench._load_probe_verdict()[0] == "timeout after 600s"
+    monkeypatch.setenv("RLT_BENCH_PROBE_TTL", "0")
+    assert bench._load_probe_verdict() == (None, None)
+    monkeypatch.delenv("RLT_BENCH_PROBE_TTL")
+    assert bench._load_probe_verdict()[0] == "timeout after 600s"
+    bench._clear_probe_verdict()
+    assert bench._load_probe_verdict() == (None, None)
 
 
 def _import_prober():

@@ -1051,6 +1051,52 @@ def test_transformer_encoder_trains_through_trainer(tmp_root):
     assert trainer.state.status == "finished"
 
 
+@pytest.mark.pipeline
+def test_torch_dataloader_through_async_loader():
+    """A real torch DataLoader feeds through ensure_loader ->
+    _ForeignLoader -> AsyncLoader (the serial feeding mode): batches
+    arrive as numpy, in order, equal to direct iteration, and the feeder
+    thread is torn down when the epoch ends."""
+    import threading
+    import time as _time
+
+    from torch.utils.data import DataLoader as TorchLoader
+    from torch.utils.data import TensorDataset as TorchTensorDataset
+
+    from ray_lightning_tpu.core.data import _ForeignLoader, ensure_loader
+    from ray_lightning_tpu.core.prefetch import (
+        _THREAD_PREFIX,
+        AsyncLoader,
+        ensure_async,
+    )
+
+    xs = torch.arange(48, dtype=torch.float32).reshape(12, 4)
+    torch_loader = TorchLoader(TorchTensorDataset(xs), batch_size=4)
+    wrapped = ensure_loader(torch_loader)
+    assert isinstance(wrapped, _ForeignLoader)
+    sync = [b[0].copy() for b in wrapped]
+
+    async_loader = ensure_async(wrapped, prefetch_factor=2)
+    assert isinstance(async_loader, AsyncLoader)
+    for _ in range(2):  # reiterable: fresh feeder thread per epoch
+        got = list(async_loader)
+        assert len(got) == len(sync) == 3
+        for s, g in zip(sync, got):
+            assert isinstance(g[0], np.ndarray)  # numpy at the boundary
+            np.testing.assert_array_equal(s, g[0])
+
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline:
+        leaked = [
+            t for t in threading.enumerate()
+            if t.name.startswith(_THREAD_PREFIX)
+        ]
+        if not leaked:
+            break
+        _time.sleep(0.02)
+    assert not leaked, f"leaked input threads: {leaked}"
+
+
 def test_torch_module_trains_through_trainer(tmp_root):
     """The headline: an unmodified torch pl-style module fit on a GSPMD
     dp mesh through the real Trainer; loss decreases; trained weights
