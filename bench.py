@@ -693,6 +693,148 @@ def _attach_input_sweep(result: dict, here: str, env: dict) -> None:
         }
 
 
+def _serve_microbench(
+    engine,
+    rate_rps: float,
+    num_requests: int,
+    max_new_tokens: int,
+    vocab: int,
+    seed: int = 0,
+) -> dict:
+    """Offer ``num_requests`` at ``rate_rps`` to a RUNNING engine and
+    report throughput/latency/utilization for that load level.
+
+    Arrival is a fixed 1/rate interarrival (deterministic, so runs are
+    comparable); TTFT comes from the engine's own per-completion clock.
+    Importable so tests can drive the ramp in-process.
+    """
+    import numpy as np
+
+    from ray_lightning_tpu.observability.metrics import percentile
+
+    rng = np.random.default_rng(seed)
+    interarrival = 1.0 / max(rate_rps, 1e-9)
+    decode0 = engine.stats["decode_steps"]
+    busy0 = engine.stats["busy_slot_steps"]
+    completions = []
+    t0 = time.perf_counter()
+    for i in range(num_requests):
+        target = t0 + i * interarrival
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        plen = int(rng.integers(3, engine.engine_config.max_prompt_len + 1))
+        prompt = [int(t) for t in rng.integers(1, vocab, size=plen)]
+        completions.append(
+            engine.submit(prompt, max_new_tokens=max_new_tokens)
+        )
+    for c in completions:
+        c.result(timeout=120)
+    wall = time.perf_counter() - t0
+    ttfts = [c.ttft_s for c in completions if c.ttft_s is not None]
+    tokens = sum(len(c.tokens) for c in completions)
+    decode_steps = engine.stats["decode_steps"] - decode0
+    busy = engine.stats["busy_slot_steps"] - busy0
+    num_slots = engine.pool.num_slots
+    return {
+        "offered_rps": rate_rps,
+        "requests": num_requests,
+        "tokens_per_sec": round(tokens / max(wall, 1e-9), 2),
+        "ttft_p50_ms": round(percentile(ttfts, 50) * 1e3, 2) if ttfts else None,
+        "ttft_p95_ms": round(percentile(ttfts, 95) * 1e3, 2) if ttfts else None,
+        "slot_utilization": round(
+            busy / max(decode_steps * num_slots, 1), 4
+        ),
+    }
+
+
+def _serve_sweep(args: argparse.Namespace) -> int:
+    """Child: the continuous-batching serving sweep (--_serve_sweep).
+
+    Stands up a tiny float32 engine (4 slots) and ramps offered load
+    across RLT_BENCH_SERVE_RATES (default "4,16,64" req/s), reporting
+    tokens/s, TTFT p50/p95 and slot utilization at each level. CPU-pinned
+    like the other sweeps — this measures the batching/scheduling path,
+    not chip FLOPs.
+    """
+    import dataclasses
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.llama import LlamaConfig, init_params
+    from ray_lightning_tpu.serving import EngineConfig, InferenceEngine
+
+    rates = [
+        float(r)
+        for r in os.environ.get("RLT_BENCH_SERVE_RATES", "4,64,512").split(",")
+        if r.strip()
+    ]
+    num_requests = int(os.environ.get("RLT_BENCH_SERVE_REQUESTS", "12"))
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(
+        params,
+        cfg,
+        EngineConfig(num_slots=4, max_prompt_len=8, max_len=32),
+    )
+    engine.start()
+    try:
+        # warmup: compile both programs off the clock
+        engine.submit([1, 2, 3], max_new_tokens=2).result(timeout=120)
+        levels = [
+            _serve_microbench(
+                engine, rate, num_requests,
+                max_new_tokens=8, vocab=cfg.vocab_size, seed=i,
+            )
+            for i, rate in enumerate(rates)
+        ]
+        compiles = engine.compile_stats()
+    finally:
+        engine.shutdown(drain=False)
+    print(
+        json.dumps(
+            {
+                "platform": "cpu",
+                "num_slots": 4,
+                "levels": levels,
+                "peak_tokens_per_sec": max(
+                    lvl["tokens_per_sec"] for lvl in levels
+                ),
+                "compile_stats": compiles,
+            }
+        )
+    )
+    return 0
+
+
+def _attach_serve_sweep(result: dict, here: str, env: dict) -> None:
+    """Attach detail.serving (the continuous-batching offered-load ramp)
+    to a fresh measurement. CPU-pinned like the DCN/input sweeps — the
+    child never acquires the chip. RLT_BENCH_SERVE_SWEEP=0 disables;
+    RLT_BENCH_SERVE_RATES / RLT_BENCH_SERVE_REQUESTS shape the ramp."""
+    if os.environ.get("RLT_BENCH_SERVE_SWEEP", "1") == "0":
+        return
+    sweep_env = dict(env)
+    sweep_env["JAX_PLATFORMS"] = "cpu"
+    ok, sweep, serr = _run(
+        [sys.executable, here, "--_serve_sweep"],
+        _env_timeout("RLT_BENCH_SERVE_TIMEOUT", 300.0),
+        sweep_env,
+    )
+    detail = result.setdefault("detail", {})
+    if ok and isinstance(sweep, dict) and "levels" in sweep:
+        detail["serving"] = sweep
+    else:
+        detail["serving"] = {
+            "error": (sweep or {}).get("error")
+            or serr
+            or "sweep produced no JSON"
+        }
+
+
 def _last_json_dict(stdout: str):
     for line in reversed((stdout or "").strip().splitlines()):
         try:
@@ -920,6 +1062,7 @@ def main() -> int:
     parser.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_dcn_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_input_sweep", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--_serve_sweep", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
 
     if args._probe:
@@ -930,6 +1073,8 @@ def main() -> int:
         return _dcn_sweep(args)
     if args._input_sweep:
         return _input_sweep(args)
+    if args._serve_sweep:
+        return _serve_sweep(args)
 
     probe_timeout = _env_timeout("RLT_BENCH_PROBE_TIMEOUT", 600.0)
     bench_timeout = _env_timeout("RLT_BENCH_TIMEOUT", 1800.0)
@@ -1009,6 +1154,7 @@ def main() -> int:
                 if ok:
                     _attach_dcn_sweep(result, here, env)
                     _attach_input_sweep(result, here, env)
+                    _attach_serve_sweep(result, here, env)
                     if _is_on_chip(result):
                         _save_tpu_cache(result, _args_key(args))
                     print(json.dumps(result))
@@ -1052,6 +1198,7 @@ def main() -> int:
     else:
         _attach_dcn_sweep(result, here, env)
         _attach_input_sweep(result, here, env)
+        _attach_serve_sweep(result, here, env)
     if error:
         result.setdefault("detail", {})["error"] = error
     print(json.dumps(result))

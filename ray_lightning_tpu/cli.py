@@ -174,10 +174,118 @@ class LightningCLI:
 # --------------------------------------------------------------------- #
 # operational subcommands
 # --------------------------------------------------------------------- #
+def _parse_prompt(spec: str) -> list:
+    """``"1,2,3"`` -> [1, 2, 3] (the repo has no tokenizer — prompts are
+    token ids, same contract as ``models.generation.generate``)."""
+    try:
+        tokens = [int(t) for t in spec.replace(" ", "").split(",") if t != ""]
+    except ValueError:
+        raise SystemExit(f"--prompt wants comma-separated token ids, got {spec!r}")
+    if not tokens:
+        raise SystemExit("--prompt must contain at least one token id")
+    return tokens
+
+
+def _cmd_serve(args) -> int:
+    """Stand up a continuous-batching engine on random-init tiny/small
+    params and serve token-id prompts (demo + smoke path for the serving
+    subsystem; see docs/serving.md)."""
+    import dataclasses
+    import json
+    import time as _time
+
+    from ray_lightning_tpu import observability as _obs
+
+    if args.telemetry:
+        _obs.enable()
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.llama import LlamaConfig, init_params
+    from ray_lightning_tpu.serving import EngineConfig, InferenceEngine
+
+    preset = getattr(LlamaConfig, args.preset, None)
+    if preset is None:
+        raise SystemExit(f"unknown --preset {args.preset!r} (try: tiny, small)")
+    cfg = preset()
+    if args.fp32:
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+
+    prompts = [_parse_prompt(p) for p in (args.prompt or [])]
+    if args.random_requests:
+        import numpy as np
+
+        rng = np.random.default_rng(args.seed)
+        for _ in range(args.random_requests):
+            plen = int(rng.integers(1, args.max_prompt_len + 1))
+            prompts.append(
+                [int(t) for t in rng.integers(1, cfg.vocab_size, size=plen)]
+            )
+    if not prompts:
+        raise SystemExit("nothing to serve: pass --prompt and/or --random-requests")
+    too_long = [i for i, p in enumerate(prompts) if len(p) > args.max_prompt_len]
+    if too_long:
+        raise SystemExit(
+            f"prompt(s) {too_long} exceed --max-prompt-len {args.max_prompt_len}"
+        )
+
+    params = init_params(jax.random.PRNGKey(args.seed), cfg)
+    engine = InferenceEngine(
+        params,
+        cfg,
+        EngineConfig(
+            num_slots=args.num_slots,
+            max_prompt_len=args.max_prompt_len,
+            max_len=args.max_len,
+            temperature=args.temperature,
+            eos_id=args.eos_id,
+            seed=args.seed,
+        ),
+    )
+    t0 = _time.perf_counter()
+    completions = [
+        engine.submit(p, max_new_tokens=args.max_new_tokens) for p in prompts
+    ]
+    engine.run_until_idle()
+    wall = _time.perf_counter() - t0
+
+    for c in completions:
+        print(
+            json.dumps(
+                {
+                    "request_id": c.request_id,
+                    "finish_reason": c.finish_reason,
+                    "ttft_s": round(c.ttft_s, 6) if c.ttft_s else None,
+                    "tokens": list(c.tokens),
+                }
+            )
+        )
+    total_tokens = sum(len(c.tokens) for c in completions)
+    summary = {
+        "requests": len(completions),
+        "generated_tokens": total_tokens,
+        "wall_s": round(wall, 3),
+        "tokens_per_sec": round(total_tokens / wall, 2) if wall > 0 else None,
+        "slot_utilization": round(engine.slot_utilization(), 4),
+        "compile_stats": engine.compile_stats(),
+        "pool": engine.pool.stats(),
+    }
+    print(json.dumps({"summary": summary}))
+    if args.telemetry:
+        reg = _obs.registry()
+        if reg is not None:
+            print(reg.prometheus_text())
+    engine.shutdown(drain=False)
+    return 0
+
+
 def main(argv: Optional[list] = None) -> int:
-    """``rlt``-style tool dispatch. Currently: ``top`` — live view of a
-    run's telemetry directory (summary.json + events.jsonl, written by the
-    driver aggregator when ``RLT_TELEMETRY=1``)."""
+    """``rlt``-style tool dispatch: ``top`` — live view of a run's
+    telemetry directory (summary.json + events.jsonl, written by the
+    driver aggregator when ``RLT_TELEMETRY=1``); ``serve`` — stand up a
+    continuous-batching inference engine on random-init params and serve
+    token-id prompts (docs/serving.md)."""
     parser = argparse.ArgumentParser(prog="rlt")
     sub = parser.add_subparsers(dest="command")
     top = sub.add_parser(
@@ -197,11 +305,44 @@ def main(argv: Optional[list] = None) -> int:
     top.add_argument(
         "--interval", type=float, default=2.0, help="refresh period seconds"
     )
+    serve = sub.add_parser(
+        "serve",
+        help="continuous-batching inference demo on random-init params",
+    )
+    serve.add_argument(
+        "--prompt",
+        action="append",
+        help='token-id prompt, e.g. --prompt "1,2,3" (repeatable)',
+    )
+    serve.add_argument(
+        "--random-requests",
+        type=int,
+        default=0,
+        help="additionally submit N random prompts",
+    )
+    serve.add_argument("--preset", default="tiny", help="LlamaConfig preset")
+    serve.add_argument("--num-slots", type=int, default=4)
+    serve.add_argument("--max-prompt-len", type=int, default=64)
+    serve.add_argument("--max-len", type=int, default=256)
+    serve.add_argument("--max-new-tokens", type=int, default=16)
+    serve.add_argument("--temperature", type=float, default=0.0)
+    serve.add_argument("--eos-id", type=int, default=None)
+    serve.add_argument("--seed", type=int, default=0)
+    serve.add_argument(
+        "--fp32", action="store_true", help="force float32 params/activations"
+    )
+    serve.add_argument(
+        "--telemetry",
+        action="store_true",
+        help="enable spans/metrics and dump the Prometheus text exposition",
+    )
     args = parser.parse_args(argv)
     if args.command == "top":
         from ray_lightning_tpu.observability.aggregator import render_top
 
         return render_top(args.dir, follow=args.follow, interval=args.interval)
+    if args.command == "serve":
+        return _cmd_serve(args)
     parser.print_help()
     return 2
 

@@ -102,6 +102,17 @@ def _apply_rope_one(x: jnp.ndarray, c: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarr
     return out.astype(dtype)
 
 
+def _apply_rope_rows(x: jnp.ndarray, c: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """x: [B, H, hd], each row at its OWN position; c/s: [B, hd/2]."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    c = c[:, None, :]
+    s = s[:, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)
+
+
 def prefill(
     params: Dict[str, Any],
     prompt: jnp.ndarray,
@@ -303,6 +314,106 @@ def decode_step(
     return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
 
 
+def decode_step_ragged(
+    params: Dict[str, Any],
+    cache: Dict[str, jnp.ndarray],
+    token: jnp.ndarray,
+    pos: jnp.ndarray,
+    cfg: LlamaConfig,
+    rope_table: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """One decode step with PER-ROW positions. token: [B] int32; pos: [B]
+    int32 — every batch row advances independently. This is the primitive
+    the continuous-batching serving engine steps: the rows of one cache
+    are SLOTS holding unrelated requests at different depths, so a single
+    scalar position (``decode_step``) cannot describe the batch.
+
+    Same math as ``decode_step`` with the scalar position lifted to a
+    vector: rope rows are gathered per row (``cos[pos]``), the cache
+    update is a per-row scatter at ``slot_b = pos_b % C``, and the
+    validity mask compares each row's cache slots against its own
+    position. Rows therefore never see each other's keys — isolation
+    between slots is structural, not masked in.
+
+    Returns (logits [B, V] fp32, updated cache).
+    """
+    hd = cfg.head_dim
+    C = cache["k"].shape[3]
+    if rope_table is None:
+        rope_table = _default_table_or_raise(cfg, max(C, cfg.max_seq))
+    # identical soundness constraint to decode_step: a cache strictly
+    # between the window and the served position range wraps its slots
+    # while the band mask compares absolute positions
+    total = int(rope_table[0].shape[0])
+    if cfg.sliding_window and cfg.sliding_window < C < total:
+        raise ValueError(
+            f"cache length {C} is between sliding_window "
+            f"{cfg.sliding_window} and the served position range {total}: "
+            "size the cache to the window (rolling) or to the full "
+            "position range (see decode_step)"
+        )
+    cos, sin = rope_table
+    c = cos[pos]  # [B, hd/2]
+    s = sin[pos]
+    B = token.shape[0]
+    x = params["embed"][token]  # [B, D]
+
+    slot = pos % C  # [B]
+    rows = jnp.arange(B)
+    positions = jnp.arange(C)
+    keep = positions[None, :] <= pos[:, None]  # [B, C]
+    if cfg.sliding_window and C > cfg.sliding_window:
+        keep &= positions[None, :] > pos[:, None] - cfg.sliding_window
+    valid = keep[:, None, None, :]  # [B, 1, 1, C]
+
+    def layer_fn(x, inputs):
+        lp, k_cache, v_cache = inputs  # k/v: [B, Hkv, C, hd]
+        nh = lp["wq"].shape[-1] // hd
+        nkv = lp["wk"].shape[-1] // hd
+        group = nh // nkv
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if "bq" in lp:  # Qwen2-family qkv bias
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(B, nh, hd)
+        k = k.reshape(B, nkv, hd)
+        v = v.reshape(B, nkv, hd)
+        q = _apply_rope_rows(q, c, s)
+        k = _apply_rope_rows(k, c, s)
+        k_cache = k_cache.at[rows, :, slot, :].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[rows, :, slot, :].set(v.astype(v_cache.dtype))
+        qf = q.reshape(B, nkv, group, hd).astype(jnp.float32)
+        logits = jnp.einsum(
+            "bhgd,bhtd->bhgt", qf, k_cache.astype(jnp.float32)
+        ) / jnp.sqrt(jnp.float32(hd))
+        logits = jnp.where(valid, logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        att = jnp.einsum("bhgt,bhtd->bhgd", probs, v_cache.astype(jnp.float32))
+        att = att.reshape(B, nh * hd).astype(x.dtype)
+        x = x + att @ lp["wo"]
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        if cfg.n_experts and "moe" in lp:
+            from ray_lightning_tpu.parallel.moe import moe_ffn_lossless
+
+            moe_out = moe_ffn_lossless(
+                lp["moe"], h2[:, None, :], top_k=cfg.expert_top_k
+            )
+            x = x + moe_out[:, 0]
+        else:
+            gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
+            x = x + gated @ lp["w_down"]
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"]
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
 def _sample_logits(logits, key, temperature, top_k, top_p):
     """One sampling step over [B, V] logits, jit/scan-safe (static shapes).
 
@@ -389,13 +500,27 @@ def generate(
 
     def step(carry, t):
         cache, tok, rng, done = carry
-        logits, cache = decode_step(params, cache, tok, t, cfg, table)
         rng, sub = jax.random.split(rng)
-        nxt = sample(logits, sub).astype(prompt.dtype)
-        if eos_id is not None:
-            # finished rows keep emitting eos (static shapes; no early exit)
-            nxt = jnp.where(done, jnp.asarray(eos_id, prompt.dtype), nxt)
-            done = done | (nxt == eos_id)
+        if eos_id is None:
+            logits, cache = decode_step(params, cache, tok, t, cfg, table)
+            nxt = sample(logits, sub).astype(prompt.dtype)
+            return (cache, nxt, rng, done), nxt
+
+        # early-stop masking: once EVERY row has finished, the remaining
+        # scan iterations skip the decoder entirely (lax.cond selects the
+        # cheap branch at runtime) — shapes stay static, but a batch that
+        # finishes early stops paying per-layer matmuls for the tail
+        def live(cache):
+            logits, cache = decode_step(params, cache, tok, t, cfg, table)
+            return cache, sample(logits, sub).astype(prompt.dtype)
+
+        def finished(cache):
+            return cache, jnp.full(tok.shape, eos_id, prompt.dtype)
+
+        cache, nxt = jax.lax.cond(jnp.all(done), finished, live, cache)
+        # finished rows keep emitting eos (static shapes; no early exit)
+        nxt = jnp.where(done, jnp.asarray(eos_id, prompt.dtype), nxt)
+        done = done | (nxt == eos_id)
         return (cache, nxt, rng, done), nxt
 
     (_, _, _, _), toks = jax.lax.scan(

@@ -1,0 +1,52 @@
+"""Continuous-batching inference serving.
+
+Four layers, bottom-up:
+
+- :mod:`.kv_pool` — slot-based KV-cache pool: one device allocation
+  whose batch rows are request slots, recycled on EOS/max-tokens.
+- :mod:`.scheduler` — bounded admission queue + prefill/decode
+  interleave policy (pure host logic).
+- :mod:`.engine` — single-replica loop: one jitted prefill + one jitted
+  ragged decode step, streaming callbacks, drain/shutdown. Zero
+  steady-state recompiles by construction (fixed shapes everywhere).
+- :mod:`.replica` — multi-replica front door over the actor runtime
+  with least-loaded routing and heartbeat-driven relaunch.
+"""
+from ray_lightning_tpu.serving.engine import (  # noqa: F401
+    Completion,
+    EngineClosed,
+    EngineConfig,
+    InferenceEngine,
+)
+from ray_lightning_tpu.serving.kv_pool import KVSlotPool, Slot  # noqa: F401
+from ray_lightning_tpu.serving.replica import (  # noqa: F401
+    ReplicaGroup,
+    ServeFuture,
+    ServeReplicaActor,
+    needs_relaunch,
+    pick_least_loaded,
+)
+from ray_lightning_tpu.serving.scheduler import (  # noqa: F401
+    ContinuousBatchScheduler,
+    Plan,
+    Request,
+    RequestQueueFull,
+)
+
+__all__ = [
+    "Completion",
+    "ContinuousBatchScheduler",
+    "EngineClosed",
+    "EngineConfig",
+    "InferenceEngine",
+    "KVSlotPool",
+    "Plan",
+    "ReplicaGroup",
+    "Request",
+    "RequestQueueFull",
+    "ServeFuture",
+    "ServeReplicaActor",
+    "Slot",
+    "needs_relaunch",
+    "pick_least_loaded",
+]

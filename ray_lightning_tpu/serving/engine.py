@@ -1,0 +1,504 @@
+"""Single-replica continuous-batching inference engine.
+
+Two compiled programs, full stop:
+
+- ``_prefill_fn`` — one jitted prefill at the FIXED shape
+  [1, max_prompt_len]. Prompts are right-padded to that length; the pad
+  positions write garbage (k, v) at positions >= the real length, but
+  the per-row validity mask in ``decode_step_ragged`` only ever exposes
+  positions <= the row's current position, and decode overwrites each
+  garbage position before advancing past it — so padding is free
+  correctness-wise and buys shape stability. Causality means the REAL
+  positions' cache entries are identical to an unpadded prefill.
+- ``_decode_fn`` — one jitted ``decode_step_ragged`` + sampler over the
+  whole pool ([num_slots] tokens at [num_slots] positions). Free slots
+  ride along with dummy inputs (their outputs are ignored and their
+  rows are garbage until the next prefill overwrites them).
+
+After warmup (one prefill + one decode compile) the jit caches are
+flat: admission, recycling, mixed prompt lengths, EOS — none of it
+changes a device shape. ``compile_stats()`` exposes the cache sizes so
+tests (and the bench sweep) can assert zero steady-state recompiles.
+
+The first sampled token of a request comes from the first DECODE step
+after its prefill (re-running the last prompt token at position P-1 —
+idempotent cache write, same logits as prefill's last position), which
+is what lets prefill skip its logits head and keeps "first token" and
+"every other token" the same compiled program.
+
+Threading: ``submit`` is callable from any thread; ``start()`` spawns
+the loop thread, or call ``step()`` yourself for deterministic
+single-threaded driving (tests, bench). ``drain()`` stops admission and
+finishes in-flight work; ``shutdown(drain=False)`` fails queued work
+immediately.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_lightning_tpu import observability as _obs
+from ray_lightning_tpu.serving.kv_pool import KVSlotPool
+from ray_lightning_tpu.serving.scheduler import (
+    ContinuousBatchScheduler,
+    Request,
+    RequestQueueFull,
+)
+
+__all__ = [
+    "Completion",
+    "EngineConfig",
+    "EngineClosed",
+    "InferenceEngine",
+    "RequestQueueFull",
+]
+
+# TTFT/ITL land in seconds; the default step/IO bounds start at 100 µs
+# which is too coarse-grained at the fast end for tiny-model decode
+LATENCY_BOUNDS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class EngineClosed(RuntimeError):
+    """submit() after drain/shutdown."""
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Serving knobs (see docs/serving.md for the tuning guide).
+
+    ``max_prompt_len`` is the single compiled prefill shape — prompts
+    longer than it are rejected at submit. ``max_len`` is each slot's
+    cache length: ``prompt_len + max_new_tokens <= max_len`` per
+    request. Sampling knobs are ENGINE-level (static in the compiled
+    sampler); per-request temperatures would be a recompile per value.
+    """
+
+    num_slots: int = 4
+    max_prompt_len: int = 64
+    max_len: int = 256
+    max_queue: int = 256
+    max_prefills_per_tick: int = 1
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_id: Optional[int] = None  # default per-request eos
+    seed: int = 0
+
+    def validate(self) -> None:
+        if self.max_prompt_len < 1:
+            raise ValueError("max_prompt_len must be >= 1")
+        if self.max_prompt_len >= self.max_len:
+            raise ValueError(
+                f"max_prompt_len ({self.max_prompt_len}) must be < max_len "
+                f"({self.max_len}): a full-length prompt still needs room "
+                "for at least one generated token"
+            )
+
+
+class Completion:
+    """Caller-facing handle: collected tokens + a done event.
+
+    ``tokens`` excludes the prompt. ``finish_reason`` is one of
+    ``"eos"`` / ``"length"`` / ``"error"`` / ``"cancelled"``. Streaming:
+    pass ``on_token`` at submit — called as ``on_token(request_id,
+    token)`` from the engine loop thread for every sampled token.
+    """
+
+    __slots__ = (
+        "request_id", "tokens", "finish_reason", "error",
+        "ttft_s", "_done", "submitted_at",
+    )
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self.tokens: List[int] = []
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self.ttft_s: Optional[float] = None
+        self.submitted_at = time.perf_counter()
+        self._done = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until finished; returns the generated tokens (no prompt)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id!r} not finished within {timeout}s"
+            )
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+    def _finish(self, reason: str, error: Optional[BaseException] = None):
+        self.finish_reason = reason
+        self.error = error
+        self._done.set()
+
+
+class InferenceEngine:
+    """Continuous batching over one model replica (one process, one set
+    of params). See the module docstring for the two-program design."""
+
+    def __init__(self, params, cfg, engine_config: Optional[EngineConfig] = None):
+        import jax
+
+        ecfg = engine_config or EngineConfig()
+        ecfg.validate()
+        self.cfg = cfg
+        self.engine_config = ecfg
+        self.params = params
+        self.pool = KVSlotPool(cfg, ecfg.num_slots, ecfg.max_len)
+        self.scheduler = ContinuousBatchScheduler(
+            self.pool,
+            max_queue=ecfg.max_queue,
+            max_prefills_per_tick=ecfg.max_prefills_per_tick,
+        )
+        self._completions: Dict[str, Completion] = {}
+        self._on_token: Dict[str, Callable[[str, int], Any]] = {}
+        self._rng = jax.random.key(ecfg.seed)
+        self._req_counter = itertools.count()
+        self._state_lock = threading.Lock()
+        self._work = threading.Condition(self._state_lock)
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._stop_when_idle = False
+        # throughput/utilization accounting (host side, always on)
+        self.stats: Dict[str, float] = {
+            "decode_steps": 0,
+            "prefills": 0,
+            "tokens_out": 0,
+            "busy_slot_steps": 0,
+            "completed": 0,
+        }
+        self._build_compiled()
+
+    # ------------------------------------------------------------------ #
+    # compiled programs
+    # ------------------------------------------------------------------ #
+    def _build_compiled(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ray_lightning_tpu.models.generation import (
+            _sample_logits,
+            decode_step_ragged,
+            init_kv_cache,
+            prefill,
+        )
+        from ray_lightning_tpu.ops.rope import rope_angles
+
+        cfg = self.cfg
+        ecfg = self.engine_config
+        # one table covering every position a slot can reach, shared by
+        # prefill and decode so rope factors cannot diverge between them
+        table = rope_angles(
+            ecfg.max_len, cfg.head_dim, cfg.rope_theta, scaling=cfg.rope_scaling
+        )
+
+        def prefill_into(params, cache_k, cache_v, prompt_row, slot_index):
+            # [1, max_prompt_len] through the batched prefill into a
+            # single-row scratch cache, then one dynamic_update_slice
+            # drops the row into the pool at slot_index. The scratch row
+            # is length max_len so shapes line up with the pool rows.
+            row = init_kv_cache(cfg, 1, ecfg.max_len)
+            _, row = prefill(params, prompt_row, cfg, row, table)
+            cache_k = jax.lax.dynamic_update_slice(
+                cache_k, row["k"], (0, slot_index, 0, 0, 0)
+            )
+            cache_v = jax.lax.dynamic_update_slice(
+                cache_v, row["v"], (0, slot_index, 0, 0, 0)
+            )
+            return cache_k, cache_v
+
+        def decode(params, cache_k, cache_v, token, pos, key):
+            logits, cache = decode_step_ragged(
+                params, {"k": cache_k, "v": cache_v}, token, pos, cfg, table
+            )
+            sampled = _sample_logits(
+                logits, key, ecfg.temperature, ecfg.top_k, ecfg.top_p
+            )
+            return sampled.astype(jnp.int32), cache["k"], cache["v"]
+
+        self._prefill_fn = jax.jit(prefill_into)
+        self._decode_fn = jax.jit(decode)
+
+    def compile_stats(self) -> Dict[str, int]:
+        """jit cache sizes — flat after warmup is the zero-steady-state-
+        recompile contract the tests assert."""
+
+        def size(fn):
+            try:
+                return int(fn._cache_size())
+            except Exception:
+                return -1
+
+        return {
+            "prefill_compiles": size(self._prefill_fn),
+            "decode_compiles": size(self._decode_fn),
+        }
+
+    # ------------------------------------------------------------------ #
+    # submission
+    # ------------------------------------------------------------------ #
+    def submit(
+        self,
+        prompt_tokens: Sequence[int],
+        max_new_tokens: int = 16,
+        request_id: Optional[str] = None,
+        eos_id: Any = "__default__",
+        on_token: Optional[Callable[[str, int], Any]] = None,
+    ) -> Completion:
+        """Enqueue one request; returns its :class:`Completion` handle.
+
+        Raises :class:`RequestQueueFull` (bounded queue back-pressure),
+        :class:`EngineClosed` after drain/shutdown, and ``ValueError``
+        for prompts that do not fit the compiled shapes.
+        """
+        tokens = tuple(int(t) for t in prompt_tokens)
+        if not tokens:
+            raise ValueError("prompt_tokens must be non-empty")
+        if len(tokens) > self.engine_config.max_prompt_len:
+            raise ValueError(
+                f"prompt length {len(tokens)} exceeds max_prompt_len="
+                f"{self.engine_config.max_prompt_len} (the single compiled "
+                "prefill shape; raise it at engine construction)"
+            )
+        if eos_id == "__default__":
+            eos_id = self.engine_config.eos_id
+        rid = request_id or f"req-{next(self._req_counter)}"
+        completion = Completion(rid)
+        req = Request(
+            request_id=rid,
+            tokens=tokens,
+            max_new_tokens=int(max_new_tokens),
+            eos_id=eos_id,
+            on_token=on_token,
+        )
+        with self._work:
+            if self._closed:
+                raise EngineClosed(
+                    "engine is draining/shut down; no new requests"
+                )
+            if rid in self._completions:
+                raise ValueError(f"duplicate request_id {rid!r}")
+            # scheduler.submit validates lengths + bounded queue
+            self.scheduler.submit(req)
+            self._completions[rid] = completion
+            if on_token is not None:
+                self._on_token[rid] = on_token
+            self._work.notify_all()
+        reg = _obs.registry()
+        if reg is not None:
+            reg.counter("rlt_serve_requests_total").inc()
+        return completion
+
+    # ------------------------------------------------------------------ #
+    # one iteration
+    # ------------------------------------------------------------------ #
+    def step(self) -> Dict[str, Any]:
+        """Run one scheduler tick: up to N prefills + one batched decode.
+
+        Returns ``{"prefills": int, "decoded": int, "completed": [ids]}``.
+        Call from a single thread only (the loop thread, or the test)."""
+        import jax
+        import jax.numpy as jnp
+
+        plan = self.scheduler.tick()
+        ecfg = self.engine_config
+        ck, cv = self.pool.cache["k"], self.pool.cache["v"]
+
+        for req, slot in plan.prefills:
+            padded = np.zeros((1, ecfg.max_prompt_len), np.int32)
+            padded[0, : req.prompt_len] = req.tokens
+            with _obs.span("serve_prefill", prompt_len=req.prompt_len):
+                ck, cv = self._prefill_fn(
+                    self.params, ck, cv, jnp.asarray(padded),
+                    jnp.int32(slot.index),
+                )
+            slot.pos = req.prompt_len - 1
+            slot.pending_token = req.tokens[-1]
+            self.stats["prefills"] += 1
+
+        completed: List[str] = []
+        if plan.decode_slots:
+            token = np.zeros((self.pool.num_slots,), np.int32)
+            pos = np.zeros((self.pool.num_slots,), np.int32)
+            for slot in plan.decode_slots:
+                token[slot.index] = slot.pending_token
+                pos[slot.index] = slot.pos
+            self._rng, sub = jax.random.split(self._rng)
+            with _obs.span("serve_decode"):
+                sampled, ck, cv = self._decode_fn(
+                    self.params, ck, cv, jnp.asarray(token),
+                    jnp.asarray(pos), sub,
+                )
+                sampled_host = np.asarray(sampled)  # the per-step sync point
+            now = time.perf_counter()
+            reg = _obs.registry()
+            for slot in plan.decode_slots:
+                tok = int(sampled_host[slot.index])
+                completion = self._completions.get(slot.request_id)
+                if completion is not None:
+                    completion.tokens.append(tok)
+                    if completion.ttft_s is None:
+                        completion.ttft_s = now - completion.submitted_at
+                        if reg is not None:
+                            reg.histogram(
+                                "rlt_serve_ttft_seconds",
+                                bounds=LATENCY_BOUNDS,
+                            ).observe(completion.ttft_s)
+                    elif reg is not None and slot.last_token_at is not None:
+                        reg.histogram(
+                            "rlt_serve_itl_seconds", bounds=LATENCY_BOUNDS
+                        ).observe(now - slot.last_token_at)
+                cb = self._on_token.get(slot.request_id)
+                if cb is not None:
+                    try:
+                        cb(slot.request_id, tok)
+                    except Exception:
+                        pass  # a broken stream consumer must not stall decode
+                if slot.first_token_at is None:
+                    slot.first_token_at = now
+                slot.last_token_at = now
+                slot.generated += 1
+                slot.pos += 1
+                slot.pending_token = tok
+                self.stats["tokens_out"] += 1
+                if reg is not None:
+                    reg.counter("rlt_serve_tokens_total").inc()
+                reason = None
+                if slot.eos_id is not None and tok == slot.eos_id:
+                    reason = "eos"
+                elif slot.generated >= slot.max_new_tokens:
+                    reason = "length"
+                if reason is not None:
+                    completed.append(slot.request_id)
+                    self._finish(slot.request_id, reason)
+                    self.pool.release(slot.index)
+            self.stats["decode_steps"] += 1
+            self.stats["busy_slot_steps"] += len(plan.decode_slots)
+
+        self.pool.cache = {"k": ck, "v": cv}
+        return {
+            "prefills": len(plan.prefills),
+            "decoded": len(plan.decode_slots),
+            "completed": completed,
+        }
+
+    def _finish(
+        self,
+        request_id: str,
+        reason: str,
+        error: Optional[BaseException] = None,
+    ) -> None:
+        completion = self._completions.pop(request_id, None)
+        self._on_token.pop(request_id, None)
+        if completion is not None:
+            completion._finish(reason, error)
+        self.stats["completed"] += 1
+        reg = _obs.registry()
+        if reg is not None:
+            reg.counter("rlt_serve_completions_total", reason=reason).inc()
+
+    # ------------------------------------------------------------------ #
+    # loop thread + lifecycle
+    # ------------------------------------------------------------------ #
+    def start(self) -> None:
+        """Spawn the serving loop thread (idempotent)."""
+        with self._work:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="rlt-serve-engine"
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._work:
+                while not self.scheduler.has_work():
+                    if self._stop_when_idle:
+                        return
+                    self._work.wait(timeout=0.05)
+            try:
+                self.step()
+            except Exception as e:  # fail every in-flight request loudly
+                self._fail_all(e)
+                return
+
+    def _fail_all(self, error: BaseException) -> None:
+        for req in self.scheduler.drain_queue():
+            self._finish(req.request_id, "error", error)
+        for slot in self.pool.active_slots():
+            self._finish(slot.request_id, "error", error)
+            self.pool.release(slot.index)
+
+    def run_until_idle(self, max_steps: int = 100_000) -> None:
+        """Single-threaded drive: step until queue and pool are empty."""
+        for _ in range(max_steps):
+            if not self.scheduler.has_work():
+                return
+            self.step()
+        raise RuntimeError(f"still busy after {max_steps} steps")
+
+    def drain(self, timeout: Optional[float] = 60.0) -> None:
+        """Stop admitting; finish in-flight + queued work; stop the loop."""
+        with self._work:
+            self._closed = True
+            self._stop_when_idle = True
+            thread = self._thread
+            self._work.notify_all()
+        if thread is not None:
+            thread.join(timeout)
+        else:
+            self.run_until_idle()
+
+    def shutdown(self, drain: bool = True) -> None:
+        """``drain=False`` cancels queued requests and fails in-flight
+        ones instead of finishing them."""
+        if drain:
+            self.drain()
+            return
+        with self._work:
+            self._closed = True
+            self._stop_when_idle = True
+            thread = self._thread
+            self._work.notify_all()
+        self._fail_all(EngineClosed("engine shut down without drain"))
+        if thread is not None:
+            thread.join(5.0)
+
+    # ------------------------------------------------------------------ #
+    # views
+    # ------------------------------------------------------------------ #
+    def load(self) -> Dict[str, int]:
+        """Routing signal for the replica front door."""
+        return {
+            "queue_depth": self.scheduler.queue_depth,
+            "active": self.pool.occupancy,
+        }
+
+    def slot_utilization(self) -> float:
+        steps = self.stats["decode_steps"]
+        if not steps:
+            return 0.0
+        return self.stats["busy_slot_steps"] / (steps * self.pool.num_slots)
+
+    def describe(self) -> Dict[str, Any]:
+        out = dict(self.stats)
+        out.update(self.pool.stats())
+        out.update(self.compile_stats())
+        out["slot_utilization"] = round(self.slot_utilization(), 4)
+        out["queue_depth"] = self.scheduler.queue_depth
+        return out

@@ -1,0 +1,466 @@
+"""Multi-replica serving front door over the actor runtime.
+
+One :class:`InferenceEngine` per replica ACTOR (own process, own params,
+own jit caches — on TPU, own chip via the runtime's env control), all
+launched through ``runtime.create_actors`` exactly like training
+workers. The group:
+
+- routes each request to the least-loaded replica (queue depth + active
+  slots, reported over the heartbeat channel; round-robin tiebreak);
+- rides the EXISTING supervisor heartbeat machinery for health: each
+  replica publishes ``(replica_index, decode_steps, wall, {"load": ...})``
+  beats into a runtime queue, and a monitor-mode
+  :class:`~ray_lightning_tpu.runtime.supervisor.Supervisor` pumps them
+  — the same channel, skew correction, and aggregator tap training
+  uses. Serving differs from training in the POLICY, not the plumbing:
+  a training hang kills the whole group (survivors are wedged in
+  collectives), while a serving replica is independent, so
+  :meth:`ReplicaGroup.check` relaunches just the silent/dead replica
+  and the rest keep serving.
+
+Actor calls are executed by a single actor thread (FIFO), so the actor
+surface is non-blocking: ``submit`` returns a request id immediately
+(the engine's own loop thread does the work) and ``poll`` reports
+completion — a blocking result() inside the actor would starve every
+later call.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_lightning_tpu import observability as _obs
+
+__all__ = [
+    "ReplicaGroup",
+    "ServeFuture",
+    "ServeReplicaActor",
+    "needs_relaunch",
+    "pick_least_loaded",
+]
+
+
+# --------------------------------------------------------------------- #
+# pure routing/health policy (unit-testable without actors)
+# --------------------------------------------------------------------- #
+def pick_least_loaded(
+    loads: Dict[int, Dict[str, float]],
+    num_replicas: int,
+    rr_counter: int,
+) -> int:
+    """Pick a replica index: min (queue_depth + active); replicas with no
+    load report yet count as load 0 (fresh replicas attract traffic).
+    Ties break round-robin on ``rr_counter`` so equal replicas share
+    load instead of replica 0 absorbing everything."""
+    if num_replicas < 1:
+        raise ValueError("num_replicas must be >= 1")
+
+    def load_of(i: int) -> float:
+        entry = loads.get(i) or {}
+        return float(entry.get("queue_depth", 0)) + float(entry.get("active", 0))
+
+    best = min(load_of(i) for i in range(num_replicas))
+    candidates = [i for i in range(num_replicas) if load_of(i) == best]
+    return candidates[rr_counter % len(candidates)]
+
+
+def needs_relaunch(
+    last_beat: Optional[float],
+    started: float,
+    now: float,
+    hang_timeout: Optional[float],
+    startup_timeout: Optional[float] = None,
+) -> bool:
+    """Per-replica relaunch verdict from heartbeat ages (monotonic
+    seconds). Mirrors the supervisor's classify(): pre-first-beat
+    silence is tolerated unless ``startup_timeout`` bounds it; after
+    that, silence past ``hang_timeout`` condemns the replica. With
+    ``hang_timeout=None`` nothing is ever condemned (monitor only)."""
+    if hang_timeout is None:
+        return False
+    if last_beat is None:
+        return (
+            startup_timeout is not None and now - started > startup_timeout
+        )
+    return now - last_beat > hang_timeout
+
+
+class _LoadTap:
+    """Aggregator-protocol shim the Supervisor forwards beats into: keeps
+    the latest load report per replica for the router. Duck-typed to the
+    DriverAggregator surface the supervisor calls (on_beat /
+    heartbeat_age / record_event)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.loads: Dict[int, Dict[str, float]] = {}
+        self.ages: Dict[int, float] = {}
+        self.events: List[Tuple[str, dict]] = []
+
+    def on_beat(self, rank, step, wall_time, payload) -> None:
+        if isinstance(payload, dict) and "load" in payload:
+            with self._lock:
+                self.loads[int(rank)] = dict(payload["load"])
+
+    def heartbeat_age(self, rank, age) -> None:
+        with self._lock:
+            self.ages[int(rank)] = float(age)
+
+    def record_event(self, kind, **fields) -> None:
+        with self._lock:
+            self.events.append((kind, fields))
+
+    def snapshot(self) -> Dict[int, Dict[str, float]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self.loads.items()}
+
+
+# --------------------------------------------------------------------- #
+# the per-replica actor
+# --------------------------------------------------------------------- #
+class ServeReplicaActor:
+    """One engine in one actor process.
+
+    ``builder`` is a cloudpickled zero-arg callable returning
+    ``(params, cfg)`` — built INSIDE the actor so multi-GB params never
+    transit the driver, and each replica initializes on its own device.
+    """
+
+    def __init__(
+        self,
+        builder: Callable[[], Tuple[Any, Any]],
+        engine_kwargs: Optional[Dict[str, Any]] = None,
+        replica_index: int = 0,
+        heartbeat: Optional[Any] = None,
+        heartbeat_interval: float = 0.5,
+        telemetry: bool = False,
+    ):
+        from ray_lightning_tpu.serving.engine import EngineConfig, InferenceEngine
+
+        if telemetry:
+            _obs.enable()
+        params, cfg = builder()
+        self.replica_index = int(replica_index)
+        self.engine = InferenceEngine(
+            params, cfg, EngineConfig(**(engine_kwargs or {}))
+        )
+        self._finished: Dict[str, Dict[str, Any]] = {}
+        self._install_finish_hook()
+        self.engine.start()
+        self._hb = heartbeat
+        self._hb_interval = max(float(heartbeat_interval), 0.05)
+        self._hb_stop = threading.Event()
+        if heartbeat is not None:
+            threading.Thread(
+                target=self._beat_loop, daemon=True, name="rlt-serve-hb"
+            ).start()
+
+    def _beat_loop(self) -> None:
+        while not self._hb_stop.wait(self._hb_interval):
+            payload: Dict[str, Any] = {"load": self.engine.load()}
+            telemetry = _obs.collect_beat_payload()
+            if telemetry is not None:
+                payload.update(telemetry)
+            try:
+                self._hb.put(
+                    (
+                        self.replica_index,
+                        int(self.engine.stats["decode_steps"]),
+                        time.time(),
+                        payload,
+                    ),
+                    timeout=1.0,
+                )
+            except Exception:
+                pass  # a wedged driver queue must not kill serving
+
+    # ---------------- actor surface (single executor thread) ---------- #
+    def submit(
+        self,
+        prompt_tokens: Sequence[int],
+        max_new_tokens: int = 16,
+        eos_id: Any = "__default__",
+    ) -> str:
+        completion = self.engine.submit(
+            prompt_tokens, max_new_tokens=max_new_tokens, eos_id=eos_id
+        )
+        return completion.request_id
+
+    def poll(self, request_id: str) -> Dict[str, Any]:
+        completion = self.engine._completions.get(request_id)
+        if completion is None:
+            done = self._finished.get(request_id)
+            if done is None:
+                raise KeyError(f"unknown request {request_id!r}")
+            return done
+        return {"done": False, "tokens": list(completion.tokens)}
+
+    def load(self) -> Dict[str, int]:
+        return self.engine.load()
+
+    def describe(self) -> Dict[str, Any]:
+        return self.engine.describe()
+
+    def ping(self) -> bool:
+        return True
+
+    def drain(self) -> None:
+        self._hb_stop.set()
+        self.engine.drain()
+
+    def _install_finish_hook(self) -> None:
+        # park finished results so poll() can serve them after the engine
+        # forgets the completion (the engine loop thread calls _finish)
+        cache = self._finished
+        engine_finish = self.engine._finish
+
+        def finish_and_park(request_id, reason, error=None):
+            completion = self.engine._completions.get(request_id)
+            if completion is not None:
+                cache[request_id] = {
+                    "done": True,
+                    "tokens": list(completion.tokens),
+                    "finish_reason": reason,
+                    "error": repr(error) if error else None,
+                }
+                if len(cache) > 4096:  # bounded result parking
+                    cache.pop(next(iter(cache)))
+            engine_finish(request_id, reason, error)
+
+        self.engine._finish = finish_and_park
+
+
+# --------------------------------------------------------------------- #
+# driver-side future + group
+# --------------------------------------------------------------------- #
+class ServeFuture:
+    """Driver handle for a routed request: polls the owning replica."""
+
+    def __init__(self, group: "ReplicaGroup", replica: int, request_id: str):
+        self.replica = replica
+        self.request_id = request_id
+        self._group = group
+
+    def result(
+        self, timeout: Optional[float] = 120.0, poll_interval: float = 0.05
+    ) -> List[int]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            state = self._group._poll(self.replica, self.request_id)
+            if state.get("done"):
+                if state.get("error"):
+                    raise RuntimeError(
+                        f"request {self.request_id!r} failed on replica "
+                        f"{self.replica}: {state['error']}"
+                    )
+                return list(state["tokens"])
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"request {self.request_id!r} not finished within "
+                    f"{timeout}s (replica {self.replica})"
+                )
+            time.sleep(poll_interval)
+
+
+class ReplicaGroup:
+    """Launches N :class:`ServeReplicaActor` processes and fronts them.
+
+    ``hang_timeout`` arms the per-replica relaunch policy (None =
+    monitor only); the underlying Supervisor always runs monitor-mode —
+    group-wide teardown is a training semantic, not a serving one.
+    """
+
+    def __init__(
+        self,
+        builder: Callable[[], Tuple[Any, Any]],
+        engine_kwargs: Optional[Dict[str, Any]] = None,
+        num_replicas: int = 2,
+        hang_timeout: Optional[float] = None,
+        startup_timeout: Optional[float] = None,
+        heartbeat_interval: float = 0.5,
+        env: Optional[Dict[str, str]] = None,
+        telemetry: bool = False,
+        actor_timeout: float = 180.0,
+    ):
+        if num_replicas < 1:
+            raise ValueError("num_replicas must be >= 1")
+        self._builder = builder
+        self._engine_kwargs = dict(engine_kwargs or {})
+        self.num_replicas = int(num_replicas)
+        self.hang_timeout = hang_timeout
+        self.startup_timeout = startup_timeout
+        self.heartbeat_interval = float(heartbeat_interval)
+        self._env = env
+        self._telemetry = telemetry
+        self._actor_timeout = float(actor_timeout)
+        self.handles: List[Any] = []
+        self.tap = _LoadTap()
+        self.relaunches_total = 0
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._queue = None
+        self._supervisor = None
+
+    # ------------------------------ lifecycle -------------------------- #
+    def start(self) -> "ReplicaGroup":
+        from ray_lightning_tpu.runtime import api as rt
+        from ray_lightning_tpu.runtime.queue import make_queue
+        from ray_lightning_tpu.runtime.supervisor import Supervisor
+
+        if self.handles:
+            return self
+        if not rt.is_initialized():
+            rt.init()
+        self._queue = make_queue()
+        self.handles = rt.create_actors(
+            [self._spec(i) for i in range(self.num_replicas)],
+            names=[self._name(i) for i in range(self.num_replicas)],
+            env=self._env,
+            timeout=self._actor_timeout,
+        )
+        # monitor-mode supervisor: pumps beats + ages into the tap; the
+        # RELAUNCH policy is ours (per replica), so no kill_group
+        self._supervisor = Supervisor(
+            num_workers=self.num_replicas,
+            drain=self._queue.get_all,
+            hang_timeout=None,
+            heartbeat_interval=self.heartbeat_interval,
+            label="serve-replicas",
+            aggregator=self.tap,
+        )
+        self._supervisor.start()
+        return self
+
+    def _spec(self, index: int):
+        return (
+            ServeReplicaActor,
+            (
+                self._builder,
+                self._engine_kwargs,
+                index,
+                self._queue.handle(),
+                self.heartbeat_interval,
+                self._telemetry,
+            ),
+            None,
+        )
+
+    def _name(self, index: int) -> str:
+        return f"serve-replica-{index}-gen{self.relaunches_total}"
+
+    def shutdown(self) -> None:
+        from ray_lightning_tpu.runtime import api as rt
+
+        if self._supervisor is not None:
+            self._supervisor.stop()
+            self._supervisor = None
+        for handle in self.handles:
+            try:
+                handle.drain.remote().result(timeout=30)
+            except Exception:
+                pass
+            try:
+                rt.kill(handle)
+            except Exception:
+                pass
+        self.handles = []
+        if self._queue is not None:
+            try:
+                self._queue.shutdown()
+            except Exception:
+                pass
+            self._queue = None
+
+    # ------------------------------ routing ---------------------------- #
+    def submit(
+        self,
+        prompt_tokens: Sequence[int],
+        max_new_tokens: int = 16,
+        eos_id: Any = "__default__",
+    ) -> ServeFuture:
+        if not self.handles:
+            raise RuntimeError("ReplicaGroup.start() first")
+        with self._lock:
+            replica = pick_least_loaded(
+                self.tap.snapshot(), self.num_replicas, self._rr
+            )
+            self._rr += 1
+            # count the routed request locally so a burst between two
+            # heartbeats does not all land on the same replica
+            entry = self.tap.loads.setdefault(replica, {})
+            entry["queue_depth"] = float(entry.get("queue_depth", 0)) + 1
+        rid = (
+            self.handles[replica]
+            .submit.remote(list(prompt_tokens), max_new_tokens, eos_id)
+            .result(timeout=30)
+        )
+        return ServeFuture(self, replica, rid)
+
+    def _poll(self, replica: int, request_id: str) -> Dict[str, Any]:
+        return (
+            self.handles[replica]
+            .poll.remote(request_id)
+            .result(timeout=30)
+        )
+
+    def loads(self) -> Dict[int, Dict[str, float]]:
+        return self.tap.snapshot()
+
+    # ------------------------------ health ----------------------------- #
+    def check(self) -> Dict[int, str]:
+        """Classify replicas from supervisor heartbeat state and relaunch
+        the condemned ones. Returns {index: "ok" | "relaunched"}."""
+        out: Dict[int, str] = {}
+        if self._supervisor is None:
+            return out
+        now = time.monotonic()
+        for index in range(self.num_replicas):
+            health = self._supervisor.health.get(index)
+            dead = not self._is_alive(index)
+            condemned = dead or needs_relaunch(
+                health.last_beat if health else None,
+                health.started if health else now,
+                now,
+                self.hang_timeout,
+                self.startup_timeout,
+            )
+            if condemned:
+                self._relaunch(index, reason="dead" if dead else "hung")
+                out[index] = "relaunched"
+            else:
+                out[index] = "ok"
+        return out
+
+    def _is_alive(self, index: int) -> bool:
+        try:
+            return bool(
+                self.handles[index].ping.remote().result(timeout=5.0)
+            )
+        except Exception:
+            return False
+
+    def _relaunch(self, index: int, reason: str) -> None:
+        from ray_lightning_tpu.runtime import api as rt
+
+        self.tap.record_event(
+            "serve_replica_relaunch", replica=index, reason=reason
+        )
+        try:
+            rt.kill(self.handles[index], force=True)
+        except Exception:
+            pass
+        self.relaunches_total += 1
+        self.handles[index] = rt.create_actors(
+            [self._spec(index)],
+            names=[self._name(index)],
+            env=self._env,
+            timeout=self._actor_timeout,
+        )[0]
+        # reset health bookkeeping so the fresh replica gets a fresh
+        # startup grace window
+        from ray_lightning_tpu.runtime.supervisor import WorkerHealth
+
+        self._supervisor.health[index] = WorkerHealth(rank=index)
+        with self.tap._lock:
+            self.tap.loads.pop(index, None)

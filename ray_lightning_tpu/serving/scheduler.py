@@ -1,0 +1,163 @@
+"""Continuous-batching scheduler: bounded admission queue -> KV slots.
+
+The scheduler is pure host logic (no device work, no jax import) so its
+policy is unit-testable without a model. Each engine iteration calls
+:meth:`ContinuousBatchScheduler.tick`, which returns a :class:`Plan`:
+
+- ``prefills`` — up to ``max_prefills_per_tick`` queued requests paired
+  with the free slots they were just admitted into. Bounding prefills
+  per tick is the prefill/decode interleave knob: each prefill is a
+  full-prompt forward that stalls every running stream for one
+  iteration, so admitting at most N per tick caps the inter-token
+  latency hit on in-flight requests while still draining the queue.
+- ``decode_slots`` — every occupied slot (including the just-admitted
+  ones: their first decode yields their first sampled token, so a
+  prefill and the request's first token land in the SAME iteration).
+
+Admission order is FIFO. The queue is bounded — a full queue raises
+:class:`RequestQueueFull` at submit time rather than buffering
+unboundedly, which is the back-pressure signal a front door needs to
+shed load instead of silently growing latency.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, List, Optional, Tuple
+
+from ray_lightning_tpu import observability as _obs
+from ray_lightning_tpu.serving.kv_pool import KVSlotPool, Slot
+
+
+class RequestQueueFull(RuntimeError):
+    """The admission queue is at capacity — shed load or retry later."""
+
+
+@dataclass
+class Request:
+    """One generation request (token ids in, token ids out)."""
+
+    request_id: str
+    tokens: Tuple[int, ...]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    on_token: Optional[Callable[[str, int], Any]] = None
+    submitted_at: float = field(default_factory=time.perf_counter)
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.tokens)
+
+
+@dataclass
+class Plan:
+    """What one engine iteration executes."""
+
+    prefills: List[Tuple[Request, Slot]]
+    decode_slots: List[Slot]
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.prefills or self.decode_slots)
+
+
+class ContinuousBatchScheduler:
+    """FIFO admission from a bounded queue into the slot pool."""
+
+    def __init__(
+        self,
+        pool: KVSlotPool,
+        max_queue: int = 256,
+        max_prefills_per_tick: int = 1,
+    ):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if max_prefills_per_tick < 1:
+            raise ValueError(
+                "max_prefills_per_tick must be >= 1, got "
+                f"{max_prefills_per_tick}"
+            )
+        self.pool = pool
+        self.max_queue = int(max_queue)
+        self.max_prefills_per_tick = int(max_prefills_per_tick)
+        self._queue: Deque[Request] = deque()
+        self._lock = threading.Lock()
+        self.queued_total = 0
+        self.rejected_total = 0
+
+    # ------------------------------------------------------------------ #
+    # producer side (any thread)
+    # ------------------------------------------------------------------ #
+    def submit(self, request: Request) -> None:
+        """Enqueue or raise :class:`RequestQueueFull` (bounded queue)."""
+        # validate against the pool NOW so an oversized request fails at
+        # the submitter, not inside the engine loop where nobody catches it
+        if request.prompt_len + request.max_new_tokens > self.pool.max_len:
+            raise ValueError(
+                f"request {request.request_id!r}: {request.prompt_len} "
+                f"prompt + {request.max_new_tokens} new tokens exceed the "
+                f"pool's max_len={self.pool.max_len}"
+            )
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                self.rejected_total += 1
+                raise RequestQueueFull(
+                    f"admission queue is full ({self.max_queue} waiting); "
+                    "add replicas, raise max_queue, or retry with backoff"
+                )
+            self._queue.append(request)
+            self.queued_total += 1
+            depth = len(self._queue)
+        self._publish_depth(depth)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # ------------------------------------------------------------------ #
+    # engine side (the loop thread)
+    # ------------------------------------------------------------------ #
+    def tick(self) -> Plan:
+        """Admit queued requests into free slots (bounded per tick) and
+        return the iteration plan."""
+        prefills: List[Tuple[Request, Slot]] = []
+        with self._lock:
+            while (
+                self._queue
+                and self.pool.free_count > 0
+                and len(prefills) < self.max_prefills_per_tick
+            ):
+                req = self._queue.popleft()
+                slot = self.pool.acquire(
+                    req.request_id,
+                    req.prompt_len,
+                    req.max_new_tokens,
+                    eos_id=req.eos_id,
+                )
+                assert slot is not None  # guarded by free_count above
+                prefills.append((req, slot))
+            depth = len(self._queue)
+        self._publish_depth(depth)
+        return Plan(prefills=prefills, decode_slots=self.pool.active_slots())
+
+    def has_work(self) -> bool:
+        with self._lock:
+            queued = bool(self._queue)
+        return queued or self.pool.occupancy > 0
+
+    def drain_queue(self) -> List[Request]:
+        """Remove and return every queued (not yet admitted) request —
+        shutdown path: their completions are failed, not silently lost."""
+        with self._lock:
+            out = list(self._queue)
+            self._queue.clear()
+        self._publish_depth(0)
+        return out
+
+    def _publish_depth(self, depth: int) -> None:
+        reg = _obs.registry()
+        if reg is not None:
+            reg.gauge("rlt_serve_queue_depth").set(depth)

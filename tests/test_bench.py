@@ -28,10 +28,11 @@ def _isolated_cache_dir(tmp_path, monkeypatch):
     # system temp dir in production): a verdict left by a real run — or
     # by another test — must not decide whether these tests probe
     monkeypatch.setattr(bench, "_PROBE_CACHE_DIR", str(tmp_path))
-    # the dcn/input sweeps are opt-in per test: the orchestrator tests
-    # assert the exact probe/child spawn sequence
+    # the dcn/input/serve sweeps are opt-in per test: the orchestrator
+    # tests assert the exact probe/child spawn sequence
     monkeypatch.setenv("RLT_BENCH_DCN_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_INPUT_SWEEP", "0")
+    monkeypatch.setenv("RLT_BENCH_SERVE_SWEEP", "0")
 
 
 def _result(value, **detail):
@@ -401,6 +402,84 @@ def test_input_sweep_failure_is_reported_not_fatal(monkeypatch, capsys):
     assert out["value"] == 42.0
     assert "timeout" in out["detail"]["input_pipeline"]["error"]
     assert "input_starved_ms" not in out["detail"]
+
+
+def test_serve_sweep_attaches_detail(monkeypatch, capsys):
+    """The continuous-batching serving sweep child's JSON lands in
+    detail.serving, and its spawn is CPU-pinned (never the chip)."""
+    monkeypatch.setenv("RLT_BENCH_SERVE_SWEEP", "1")
+    sweep = {
+        "platform": "cpu",
+        "num_slots": 4,
+        "levels": [
+            {"offered_rps": 4.0, "tokens_per_sec": 35.0,
+             "ttft_p50_ms": 2.5, "ttft_p95_ms": 3.1, "slot_utilization": 0.25},
+            {"offered_rps": 512.0, "tokens_per_sec": 2900.0,
+             "ttft_p50_ms": 4.2, "ttft_p95_ms": 5.2, "slot_utilization": 0.83},
+        ],
+        "peak_tokens_per_sec": 2900.0,
+        "compile_stats": {"prefill_compiles": 1, "decode_compiles": 1},
+    }
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append(list(cmd))
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        if "--_serve_sweep" in cmd:
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            return True, dict(sweep), None
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    assert any("--_serve_sweep" in c for c in calls)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 42.0
+    assert out["detail"]["serving"]["peak_tokens_per_sec"] == 2900.0
+    assert out["detail"]["serving"]["levels"][1]["slot_utilization"] == 0.83
+
+
+def test_serve_sweep_failure_is_reported_not_fatal(monkeypatch, capsys):
+    """A failed serving sweep must not cost the measurement."""
+    monkeypatch.setenv("RLT_BENCH_SERVE_SWEEP", "1")
+
+    def fake_run(cmd, timeout, env):
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        if "--_serve_sweep" in cmd:
+            return False, None, "timeout after 300s"
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 42.0
+    assert "timeout" in out["detail"]["serving"]["error"]
+
+
+def test_serve_sweep_skippable(monkeypatch, capsys):
+    """RLT_BENCH_SERVE_SWEEP=0 suppresses the sweep child entirely."""
+    monkeypatch.setenv("RLT_BENCH_SERVE_SWEEP", "0")
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append(list(cmd))
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    assert not any("--_serve_sweep" in c for c in calls)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "serving" not in out.get("detail", {})
 
 
 def test_probe_failure_caches_negative_verdict(monkeypatch, capsys):

@@ -104,6 +104,116 @@ def test_sampling_filters():
     assert int(_sample_logits(logits, keys[0], 0.0, 1, 0.01)[0]) == 0
 
 
+def test_top_p_boundary_always_keeps_one_token():
+    """The nucleus rule is ``cum - probs < top_p`` — the mass BEFORE a
+    token must still be under the threshold. At the boundary that keeps
+    the argmax even when its own probability exceeds top_p (an empty
+    support would sample from all -inf logits), and a token whose prefix
+    mass lands exactly ON top_p is excluded."""
+    from ray_lightning_tpu.models.generation import _sample_logits
+
+    keys = jax.random.split(jax.random.key(1), 150)
+
+    # argmax mass 0.9 >> top_p=0.05: support must still be {0}, not {}
+    logits = jnp.log(jnp.asarray([[0.9, 0.06, 0.04]]))
+    got = {int(_sample_logits(logits, k, 1.0, None, 0.05)[0]) for k in keys}
+    assert got == {0}, got
+
+    # exact boundary: probs [0.5, 0.3, 0.2]. Token 1's prefix mass is
+    # 0.5, NOT < 0.5 -> excluded at top_p=0.5, included just above it.
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.2]]))
+    got = {int(_sample_logits(logits, k, 1.0, None, 0.5)[0]) for k in keys}
+    assert got == {0}, got
+    got = {int(_sample_logits(logits, k, 1.0, None, 0.51)[0]) for k in keys}
+    assert got == {0, 1}, got
+
+
+def test_greedy_ignores_topk_topp():
+    """temperature=0 short-circuits to argmax over the FULL distribution:
+    even absurd top_k/top_p values must not perturb it (per batch row)."""
+    from ray_lightning_tpu.models.generation import _sample_logits
+
+    logits = jnp.log(jnp.asarray([
+        [0.1, 0.2, 0.6, 0.1],
+        [0.7, 0.1, 0.1, 0.1],
+    ]))
+    key = jax.random.key(0)
+    for top_k, top_p in ((1, 0.01), (None, 1e-6), (4, None), (2, 0.3)):
+        out = _sample_logits(logits, key, 0.0, top_k, top_p)
+        assert out.tolist() == [2, 0], (top_k, top_p, out.tolist())
+
+
+def test_top_k_top_p_composition():
+    """top-k filters FIRST, then nucleus applies over the renormalized
+    survivors — so the composed support can be strictly smaller than
+    either filter alone."""
+    from ray_lightning_tpu.models.generation import _sample_logits
+
+    # probs [0.35, 0.25, 0.2, 0.15, 0.05]
+    logits = jnp.log(jnp.asarray([[0.35, 0.25, 0.2, 0.15, 0.05]]))
+    keys = jax.random.split(jax.random.key(2), 300)
+
+    # top_p=0.99 alone keeps {0,1,2,3} (token 4's prefix mass 0.95 < 0.99
+    # keeps it too -> actually all five); top_k=2 first cuts to {0,1} and
+    # the generous top_p over the renormalized pair changes nothing
+    got = {int(_sample_logits(logits, k, 1.0, None, 0.99)[0]) for k in keys}
+    assert got == {0, 1, 2, 3, 4}, got
+    got = {int(_sample_logits(logits, k, 1.0, 2, 0.99)[0]) for k in keys}
+    assert got == {0, 1}, got
+
+    # top_k=3 renormalizes to [0.4375, 0.3125, 0.25]; top_p=0.5 then
+    # keeps {0, 1} (token 2's prefix mass 0.75 >= 0.5) — tighter than
+    # top_p=0.5 alone, which keeps {0, 1} of the ORIGINAL mass too, but
+    # looser than top_k=1; the point is both filters bit in sequence
+    got = {int(_sample_logits(logits, k, 1.0, 3, 0.5)[0]) for k in keys}
+    assert got == {0, 1}, got
+
+
+def test_ragged_decode_parity_with_prefill():
+    """decode_step_ragged at PER-ROW positions is the serving contract:
+    rows parked at different depths must each produce the same next-token
+    logits as a full prefill forward over their own prefix."""
+    from ray_lightning_tpu.models.generation import (
+        decode_step_ragged,
+        prefill,
+    )
+
+    cfg = _cfg()
+    params = init_params(jax.random.key(3), cfg)
+    C = 16
+    rng = np.random.default_rng(7)
+    # row 0 has a 6-token prefix, row 1 a 3-token prefix
+    lens = [6, 3]
+    rows = [
+        jnp.asarray(rng.integers(0, cfg.vocab_size, (1, n)), jnp.int32)
+        for n in lens
+    ]
+
+    # reference: per-row batched prefill logits (last-position, [B, V])
+    refs = []
+    for row in rows:
+        logits, _ = prefill(params, row, cfg, init_kv_cache(cfg, 1, C))
+        refs.append(np.asarray(logits[0], np.float32))
+
+    # ragged path: replay both prefixes through decode_step_ragged, each
+    # row advancing only while it still has prompt left (shorter row
+    # re-feeds its last token at a frozen position — idempotent rewrite)
+    cache = init_kv_cache(cfg, 2, C)
+    got = {}
+    for t in range(max(lens)):
+        tok = jnp.asarray(
+            [int(rows[b][0, min(t, lens[b] - 1)]) for b in range(2)], jnp.int32
+        )
+        pos = jnp.asarray([min(t, lens[b] - 1) for b in range(2)], jnp.int32)
+        logits, cache = decode_step_ragged(params, cache, tok, pos, cfg)
+        for b in range(2):
+            if t == lens[b] - 1:
+                got[b] = np.asarray(logits[b], np.float32)
+    for b in range(2):
+        err = float(np.max(np.abs(got[b] - refs[b])))
+        assert err < 1e-3, (b, err)
+
+
 def test_generate_eos_freezes_finished_rows():
     """Once a row emits eos_id, every later position repeats it — finished
     rows are frozen inside the static-shaped scan."""
