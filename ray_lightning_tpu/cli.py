@@ -241,6 +241,8 @@ def _cmd_serve(args) -> int:
             temperature=args.temperature,
             eos_id=args.eos_id,
             seed=args.seed,
+            kv_layout=args.kv_layout,
+            block_size=args.block_size,
         ),
     )
     t0 = _time.perf_counter()
@@ -267,10 +269,15 @@ def _cmd_serve(args) -> int:
         "generated_tokens": total_tokens,
         "wall_s": round(wall, 3),
         "tokens_per_sec": round(total_tokens / wall, 2) if wall > 0 else None,
+        "kv_layout": engine.kv_layout,
         "slot_utilization": round(engine.slot_utilization(), 4),
         "compile_stats": engine.compile_stats(),
         "pool": engine.pool.stats(),
     }
+    if engine.kv_layout == "paged":
+        summary["block_utilization"] = round(
+            engine.pool.block_utilization(), 4
+        )
     print(json.dumps({"summary": summary}))
     if args.telemetry:
         reg = _obs.registry()
@@ -325,6 +332,16 @@ def main(argv: Optional[list] = None) -> int:
     serve.add_argument("--max-prompt-len", type=int, default=64)
     serve.add_argument("--max-len", type=int, default=256)
     serve.add_argument("--max-new-tokens", type=int, default=16)
+    serve.add_argument(
+        "--kv-layout", choices=("slot", "paged"), default="slot",
+        help="KV cache layout: full row per request (slot) or block-paged "
+        "with shared-prefix reuse (paged)",
+    )
+    serve.add_argument(
+        "--block-size", type=int, default=None,
+        help="paged layout block size in tokens "
+        "(default: RLT_SERVE_BLOCK_SIZE or 16; must divide --max-len)",
+    )
     serve.add_argument("--temperature", type=float, default=0.0)
     serve.add_argument("--eos-id", type=int, default=None)
     serve.add_argument("--seed", type=int, default=0)

@@ -414,6 +414,127 @@ def decode_step_ragged(
     return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
 
 
+def decode_step_paged(
+    params: Dict[str, Any],
+    cache: Dict[str, jnp.ndarray],
+    token: jnp.ndarray,
+    pos: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    cfg: LlamaConfig,
+    rope_table: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """One decode step over a BLOCK-PAGED cache. token: [B] int32; pos:
+    [B] int32 (per-row positions, as in ``decode_step_ragged``);
+    block_tables: [B, max_blocks] int32 mapping each row's logical block
+    index to a physical block in the pool. cache k/v are
+    [L, num_blocks, Hkv, block_size, D] — ONE allocation shared by every
+    request, carved into fixed-size blocks by the serving allocator
+    (serving/paged_kv.py).
+
+    Logical position ``p`` of row ``b`` lives at physical cache slot
+    ``block_tables[b, p // block_size] * block_size + p % block_size``.
+    The write is a per-row scatter into (physical block, offset); the
+    read gathers each row's referenced blocks
+    (``k_cache[block_tables]``) and reshapes them back into logical
+    position order [B, Hkv, max_blocks * block_size, D], after which the
+    attention math — validity mask included — is IDENTICAL to
+    ``decode_step_ragged`` over a cache of length
+    ``max_blocks * block_size``. Rows sharing prefix blocks (refcounted
+    by the allocator) read the same physical (k, v) without copies;
+    writes only ever target private blocks (the allocator's
+    copy-on-write admission guarantees it), so sharing is invisible
+    here.
+
+    Shapes are fixed by ``block_tables.shape`` — growing a request's
+    table on the host mutates VALUES, not shapes, so steady-state decode
+    stays at zero recompiles.
+
+    Sliding-window configs are refused: block tables map positions 1:1
+    to cache slots, which is unsound for rolling buffers.
+    """
+    hd = cfg.head_dim
+    if cfg.sliding_window:
+        raise ValueError(
+            "decode_step_paged requires dense-causal configs: a rolling "
+            "sliding-window buffer wraps positions at pos % window, which "
+            "the 1:1 block-table position mapping cannot represent"
+        )
+    bs = cache["k"].shape[3]
+    C = block_tables.shape[1] * bs  # logical positions served
+    if rope_table is None:
+        rope_table = _default_table_or_raise(cfg, max(C, cfg.max_seq))
+    cos, sin = rope_table
+    c = cos[pos]  # [B, hd/2]
+    s = sin[pos]
+    B = token.shape[0]
+    x = params["embed"][token]  # [B, D]
+
+    phys = jnp.take_along_axis(
+        block_tables, (pos // bs)[:, None], axis=1
+    )[:, 0]  # [B] physical block holding each row's write position
+    off = pos % bs  # [B]
+    positions = jnp.arange(C)
+    valid = (positions[None, :] <= pos[:, None])[:, None, None, :]
+
+    def layer_fn(x, inputs):
+        lp, k_cache, v_cache = inputs  # k/v: [N, Hkv, bs, hd]
+        nh = lp["wq"].shape[-1] // hd
+        nkv = lp["wk"].shape[-1] // hd
+        group = nh // nkv
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if "bq" in lp:  # Qwen2-family qkv bias
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(B, nh, hd)
+        k = k.reshape(B, nkv, hd)
+        v = v.reshape(B, nkv, hd)
+        q = _apply_rope_rows(q, c, s)
+        k = _apply_rope_rows(k, c, s)
+        # per-row scatter into (physical block, offset); free slots all
+        # target the trash block — duplicate indices there are harmless
+        # because trash contents are never attendable
+        k_cache = k_cache.at[phys, :, off, :].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[phys, :, off, :].set(v.astype(v_cache.dtype))
+        # gather each row's blocks and lay them out in logical order:
+        # [B, max_blocks, Hkv, bs, hd] -> [B, Hkv, max_blocks * bs, hd]
+        kk = k_cache[block_tables].transpose(0, 2, 1, 3, 4).reshape(
+            B, nkv, C, hd
+        )
+        vv = v_cache[block_tables].transpose(0, 2, 1, 3, 4).reshape(
+            B, nkv, C, hd
+        )
+        qf = q.reshape(B, nkv, group, hd).astype(jnp.float32)
+        logits = jnp.einsum(
+            "bhgd,bhtd->bhgt", qf, kk.astype(jnp.float32)
+        ) / jnp.sqrt(jnp.float32(hd))
+        logits = jnp.where(valid, logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        att = jnp.einsum("bhgt,bhtd->bhgd", probs, vv.astype(jnp.float32))
+        att = att.reshape(B, nh * hd).astype(x.dtype)
+        x = x + att @ lp["wo"]
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        if cfg.n_experts and "moe" in lp:
+            from ray_lightning_tpu.parallel.moe import moe_ffn_lossless
+
+            moe_out = moe_ffn_lossless(
+                lp["moe"], h2[:, None, :], top_k=cfg.expert_top_k
+            )
+            x = x + moe_out[:, 0]
+        else:
+            gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
+            x = x + gated @ lp["w_down"]
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"]
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
 def _sample_logits(logits, key, temperature, top_k, top_p):
     """One sampling step over [B, V] logits, jit/scan-safe (static shapes).
 

@@ -1,16 +1,23 @@
 """Continuous-batching inference serving.
 
-Four layers, bottom-up:
+Five layers, bottom-up:
 
 - :mod:`.kv_pool` — slot-based KV-cache pool: one device allocation
-  whose batch rows are request slots, recycled on EOS/max-tokens.
+  whose batch rows are request slots, recycled on EOS/max-tokens. The
+  parity baseline for the paged layout.
+- :mod:`.paged_kv` — block-paged KV allocation: fixed-size blocks from
+  one shared pool, per-request block tables grown on demand, refcounted
+  shared-prefix reuse with LRU eviction, admission by block
+  availability.
 - :mod:`.scheduler` — bounded admission queue + prefill/decode
-  interleave policy (pure host logic).
+  interleave policy (pure host logic, peek-then-acquire back-pressure).
 - :mod:`.engine` — single-replica loop: one jitted prefill + one jitted
-  ragged decode step, streaming callbacks, drain/shutdown. Zero
+  decode step per KV layout, streaming callbacks, drain/shutdown. Zero
   steady-state recompiles by construction (fixed shapes everywhere).
-- :mod:`.replica` — multi-replica front door over the actor runtime
-  with least-loaded routing and heartbeat-driven relaunch.
+- :mod:`.replica` — elastic multi-replica front door over the actor
+  runtime: least-loaded routing, heartbeat-driven relaunch, and an
+  :class:`~.replica.Autoscaler` scaling the fleet on queue depth and
+  TTFT p95 with graceful drain on scale-down.
 """
 from ray_lightning_tpu.serving.engine import (  # noqa: F401
     Completion,
@@ -19,10 +26,19 @@ from ray_lightning_tpu.serving.engine import (  # noqa: F401
     InferenceEngine,
 )
 from ray_lightning_tpu.serving.kv_pool import KVSlotPool, Slot  # noqa: F401
+from ray_lightning_tpu.serving.paged_kv import (  # noqa: F401
+    BlockAllocation,
+    BlockAllocator,
+    OutOfBlocks,
+    PagedKVPool,
+)
 from ray_lightning_tpu.serving.replica import (  # noqa: F401
+    Autoscaler,
+    LocalReplicaFleet,
     ReplicaGroup,
     ServeFuture,
     ServeReplicaActor,
+    autoscale_decision,
     needs_relaunch,
     pick_least_loaded,
 )
@@ -34,12 +50,18 @@ from ray_lightning_tpu.serving.scheduler import (  # noqa: F401
 )
 
 __all__ = [
+    "Autoscaler",
+    "BlockAllocation",
+    "BlockAllocator",
     "Completion",
     "ContinuousBatchScheduler",
     "EngineClosed",
     "EngineConfig",
     "InferenceEngine",
     "KVSlotPool",
+    "LocalReplicaFleet",
+    "OutOfBlocks",
+    "PagedKVPool",
     "Plan",
     "ReplicaGroup",
     "Request",
@@ -47,6 +69,7 @@ __all__ = [
     "ServeFuture",
     "ServeReplicaActor",
     "Slot",
+    "autoscale_decision",
     "needs_relaunch",
     "pick_least_loaded",
 ]

@@ -15,6 +15,24 @@ Two compiled programs, full stop:
   ride along with dummy inputs (their outputs are ignored and their
   rows are garbage until the next prefill overwrites them).
 
+Two KV layouts behind the same two-program contract
+(``EngineConfig.kv_layout`` / ``InferenceEngine(kv_layout=...)``):
+
+- ``"slot"`` — every request owns a full-``max_len`` cache row
+  (``kv_pool.KVSlotPool``); the parity baseline.
+- ``"paged"`` — requests hold fixed-size BLOCKS from one shared pool
+  (``paged_kv.PagedKVPool``): prefill writes through a per-request
+  write-redirect table (shared-prefix blocks land in trash, written
+  exactly once by the first request), decode gathers (k, v) through the
+  fixed-shape [num_slots, max_blocks] block table
+  (``decode_step_paged``), and block tables GROW on demand as rows
+  cross block boundaries — a host-side value mutation, never a shape
+  change, so both layouts hold the zero-steady-state-recompile
+  contract. Admission is by block availability (scheduler back-
+  pressure), and common prompt prefixes are refcount-shared across
+  requests, which is what lifts resident concurrency past
+  ``num_slots × max_len`` HBM.
+
 After warmup (one prefill + one decode compile) the jit caches are
 flat: admission, recycling, mixed prompt lengths, EOS — none of it
 changes a device shape. ``compile_stats()`` exposes the cache sizes so
@@ -35,15 +53,18 @@ immediately.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
-from dataclasses import dataclass
+from collections import deque
+from dataclasses import dataclass, replace as _dc_replace
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ray_lightning_tpu import observability as _obs
 from ray_lightning_tpu.serving.kv_pool import KVSlotPool
+from ray_lightning_tpu.serving.paged_kv import PagedKVPool
 from ray_lightning_tpu.serving.scheduler import (
     ContinuousBatchScheduler,
     Request,
@@ -79,6 +100,14 @@ class EngineConfig:
     cache length: ``prompt_len + max_new_tokens <= max_len`` per
     request. Sampling knobs are ENGINE-level (static in the compiled
     sampler); per-request temperatures would be a recompile per value.
+
+    ``kv_layout``: ``"slot"`` (full row per request, the parity
+    baseline) or ``"paged"`` (block allocation + shared-prefix reuse;
+    see ``serving/paged_kv.py``). ``block_size`` (paged only) defaults
+    to env ``RLT_SERVE_BLOCK_SIZE`` or 16 and must divide ``max_len``;
+    ``num_kv_blocks`` sizes the block pool (default: the slot-
+    equivalent ``num_slots * max_len / block_size`` + trash);
+    ``prefix_cache`` toggles shared-prefix matching.
     """
 
     num_slots: int = 4
@@ -91,6 +120,18 @@ class EngineConfig:
     top_p: Optional[float] = None
     eos_id: Optional[int] = None  # default per-request eos
     seed: int = 0
+    kv_layout: str = "slot"
+    block_size: Optional[int] = None  # None -> RLT_SERVE_BLOCK_SIZE or 16
+    num_kv_blocks: Optional[int] = None
+    prefix_cache: bool = True
+
+    def resolved_block_size(self) -> int:
+        if self.block_size is not None:
+            return int(self.block_size)
+        try:
+            return int(os.environ.get("RLT_SERVE_BLOCK_SIZE", "16"))
+        except ValueError:
+            return 16
 
     def validate(self) -> None:
         if self.max_prompt_len < 1:
@@ -101,6 +142,20 @@ class EngineConfig:
                 f"({self.max_len}): a full-length prompt still needs room "
                 "for at least one generated token"
             )
+        if self.kv_layout not in ("slot", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'slot' or 'paged', got "
+                f"{self.kv_layout!r}"
+            )
+        if self.kv_layout == "paged":
+            bs = self.resolved_block_size()
+            if bs < 1:
+                raise ValueError(f"block_size must be >= 1, got {bs}")
+            if self.max_len % bs != 0:
+                raise ValueError(
+                    f"max_len ({self.max_len}) must be a multiple of "
+                    f"block_size ({bs}) for the paged layout"
+                )
 
 
 class Completion:
@@ -150,15 +205,34 @@ class InferenceEngine:
     """Continuous batching over one model replica (one process, one set
     of params). See the module docstring for the two-program design."""
 
-    def __init__(self, params, cfg, engine_config: Optional[EngineConfig] = None):
+    def __init__(
+        self,
+        params,
+        cfg,
+        engine_config: Optional[EngineConfig] = None,
+        kv_layout: Optional[str] = None,
+    ):
         import jax
 
         ecfg = engine_config or EngineConfig()
+        if kv_layout is not None:
+            ecfg = _dc_replace(ecfg, kv_layout=kv_layout)
         ecfg.validate()
         self.cfg = cfg
         self.engine_config = ecfg
         self.params = params
-        self.pool = KVSlotPool(cfg, ecfg.num_slots, ecfg.max_len)
+        self.kv_layout = ecfg.kv_layout
+        if self.kv_layout == "paged":
+            self.pool = PagedKVPool(
+                cfg,
+                ecfg.num_slots,
+                ecfg.max_len,
+                block_size=ecfg.resolved_block_size(),
+                num_blocks=ecfg.num_kv_blocks,
+                prefix_cache=ecfg.prefix_cache,
+            )
+        else:
+            self.pool = KVSlotPool(cfg, ecfg.num_slots, ecfg.max_len)
         self.scheduler = ContinuousBatchScheduler(
             self.pool,
             max_queue=ecfg.max_queue,
@@ -173,6 +247,8 @@ class InferenceEngine:
         self._closed = False
         self._thread: Optional[threading.Thread] = None
         self._stop_when_idle = False
+        # recent TTFTs for the autoscaler's p95 signal (host-side, tiny)
+        self._recent_ttfts: deque = deque(maxlen=128)
         # throughput/utilization accounting (host side, always on)
         self.stats: Dict[str, float] = {
             "decode_steps": 0,
@@ -192,6 +268,7 @@ class InferenceEngine:
 
         from ray_lightning_tpu.models.generation import (
             _sample_logits,
+            decode_step_paged,
             decode_step_ragged,
             init_kv_cache,
             prefill,
@@ -230,8 +307,60 @@ class InferenceEngine:
             )
             return sampled.astype(jnp.int32), cache["k"], cache["v"]
 
-        self._prefill_fn = jax.jit(prefill_into)
-        self._decode_fn = jax.jit(decode)
+        if self.kv_layout == "paged":
+            bs = self.pool.block_size
+            # prompt blocks the fixed-shape prefill spans; the scratch
+            # row is padded up to a block multiple so whole blocks can
+            # be scattered through the write table
+            n_prompt_blocks = (ecfg.max_prompt_len - 1) // bs + 1
+            self._n_prompt_blocks = n_prompt_blocks
+            scratch_len = max(n_prompt_blocks * bs, bs)
+
+            def prefill_into_paged(
+                params, cache_k, cache_v, prompt_row, write_table
+            ):
+                # same batched prefill into a scratch row, then the row
+                # is cut into blocks and scattered to the PHYSICAL
+                # blocks named by write_table — shared-prefix entries
+                # point at the trash block, so a cached prefix is
+                # written exactly once (by the request that registered
+                # it), never re-written per hit
+                row = init_kv_cache(cfg, 1, scratch_len)
+                _, row = prefill(params, prompt_row, cfg, row, table)
+                L = cfg.n_layers
+                hkv = cfg.n_kv_heads
+                hd = cfg.head_dim
+                ks = row["k"][:, 0].reshape(
+                    L, hkv, n_prompt_blocks, bs, hd
+                ).transpose(0, 2, 1, 3, 4)  # [L, nb, Hkv, bs, hd]
+                vs = row["v"][:, 0].reshape(
+                    L, hkv, n_prompt_blocks, bs, hd
+                ).transpose(0, 2, 1, 3, 4)
+                cache_k = cache_k.at[:, write_table].set(
+                    ks.astype(cache_k.dtype)
+                )
+                cache_v = cache_v.at[:, write_table].set(
+                    vs.astype(cache_v.dtype)
+                )
+                return cache_k, cache_v
+
+            def decode_paged(
+                params, cache_k, cache_v, token, pos, tables, key
+            ):
+                logits, cache = decode_step_paged(
+                    params, {"k": cache_k, "v": cache_v}, token, pos,
+                    tables, cfg, table,
+                )
+                sampled = _sample_logits(
+                    logits, key, ecfg.temperature, ecfg.top_k, ecfg.top_p
+                )
+                return sampled.astype(jnp.int32), cache["k"], cache["v"]
+
+            self._prefill_fn = jax.jit(prefill_into_paged)
+            self._decode_fn = jax.jit(decode_paged)
+        else:
+            self._prefill_fn = jax.jit(prefill_into)
+            self._decode_fn = jax.jit(decode)
 
     def compile_stats(self) -> Dict[str, int]:
         """jit cache sizes — flat after warmup is the zero-steady-state-
@@ -318,14 +447,24 @@ class InferenceEngine:
         ecfg = self.engine_config
         ck, cv = self.pool.cache["k"], self.pool.cache["v"]
 
+        paged = self.kv_layout == "paged"
         for req, slot in plan.prefills:
             padded = np.zeros((1, ecfg.max_prompt_len), np.int32)
             padded[0, : req.prompt_len] = req.tokens
             with _obs.span("serve_prefill", prompt_len=req.prompt_len):
-                ck, cv = self._prefill_fn(
-                    self.params, ck, cv, jnp.asarray(padded),
-                    jnp.int32(slot.index),
-                )
+                if paged:
+                    wt = self.pool.prompt_write_table(
+                        slot.index, self._n_prompt_blocks
+                    )
+                    ck, cv = self._prefill_fn(
+                        self.params, ck, cv, jnp.asarray(padded),
+                        jnp.asarray(wt),
+                    )
+                else:
+                    ck, cv = self._prefill_fn(
+                        self.params, ck, cv, jnp.asarray(padded),
+                        jnp.int32(slot.index),
+                    )
             slot.pos = req.prompt_len - 1
             slot.pending_token = req.tokens[-1]
             self.stats["prefills"] += 1
@@ -335,14 +474,26 @@ class InferenceEngine:
             token = np.zeros((self.pool.num_slots,), np.int32)
             pos = np.zeros((self.pool.num_slots,), np.int32)
             for slot in plan.decode_slots:
+                if paged:
+                    # on-demand growth: the block holding slot.pos must be
+                    # physical before the compiled scatter writes it (a
+                    # host-side table-value change, never a shape change)
+                    self.pool.ensure_writable(slot)
                 token[slot.index] = slot.pending_token
                 pos[slot.index] = slot.pos
             self._rng, sub = jax.random.split(self._rng)
             with _obs.span("serve_decode"):
-                sampled, ck, cv = self._decode_fn(
-                    self.params, ck, cv, jnp.asarray(token),
-                    jnp.asarray(pos), sub,
-                )
+                if paged:
+                    sampled, ck, cv = self._decode_fn(
+                        self.params, ck, cv, jnp.asarray(token),
+                        jnp.asarray(pos),
+                        jnp.asarray(self.pool.block_tables), sub,
+                    )
+                else:
+                    sampled, ck, cv = self._decode_fn(
+                        self.params, ck, cv, jnp.asarray(token),
+                        jnp.asarray(pos), sub,
+                    )
                 sampled_host = np.asarray(sampled)  # the per-step sync point
             now = time.perf_counter()
             reg = _obs.registry()
@@ -353,6 +504,7 @@ class InferenceEngine:
                     completion.tokens.append(tok)
                     if completion.ttft_s is None:
                         completion.ttft_s = now - completion.submitted_at
+                        self._recent_ttfts.append(completion.ttft_s)
                         if reg is not None:
                             reg.histogram(
                                 "rlt_serve_ttft_seconds",
@@ -482,11 +634,22 @@ class InferenceEngine:
     # ------------------------------------------------------------------ #
     # views
     # ------------------------------------------------------------------ #
-    def load(self) -> Dict[str, int]:
-        """Routing signal for the replica front door."""
+    def load(self) -> Dict[str, float]:
+        """Routing + autoscaling signal for the replica front door.
+
+        ``ttft_p95_ms`` is the p95 of the last ~128 first-token
+        latencies (0.0 until any request finishes its first token) —
+        the latency half of the autoscaler's scale-up condition."""
+        ttfts = list(self._recent_ttfts)
+        p95 = 0.0
+        if ttfts:
+            from ray_lightning_tpu.observability.metrics import percentile
+
+            p95 = percentile(ttfts, 95.0) * 1000.0
         return {
             "queue_depth": self.scheduler.queue_depth,
             "active": self.pool.occupancy,
+            "ttft_p95_ms": round(p95, 3),
         }
 
     def slot_utilization(self) -> float:
@@ -499,6 +662,11 @@ class InferenceEngine:
         out = dict(self.stats)
         out.update(self.pool.stats())
         out.update(self.compile_stats())
+        out["kv_layout"] = self.kv_layout
         out["slot_utilization"] = round(self.slot_utilization(), 4)
+        if self.kv_layout == "paged":
+            out["block_utilization"] = round(
+                self.pool.block_utilization(), 4
+            )
         out["queue_depth"] = self.scheduler.queue_depth
         return out

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 from ray_lightning_tpu import observability as _obs
 
@@ -83,6 +83,8 @@ class KVSlotPool:
     soundness per tenant.
     """
 
+    layout = "slot"
+
     def __init__(self, cfg, num_slots: int, max_len: int):
         from ray_lightning_tpu.models.generation import init_kv_cache
 
@@ -122,13 +124,17 @@ class KVSlotPool:
         prompt_len: int,
         max_new_tokens: int,
         eos_id: Optional[int] = None,
+        prompt_tokens: Optional[Sequence[int]] = None,
     ) -> Optional[Slot]:
         """Claim a free slot for a request; ``None`` when the pool is full.
 
         Length validation is the pool's contract: the final decode for
         this request reads position ``prompt_len - 1 + max_new_tokens - 1``
-        which must fit the slot's cache length.
+        which must fit the slot's cache length. ``prompt_tokens`` is
+        accepted for interface parity with :class:`~.paged_kv.PagedKVPool`
+        (which uses it for prefix matching) and ignored here.
         """
+        del prompt_tokens  # slot layout has no prefix sharing
         if prompt_len < 1:
             raise ValueError(f"prompt_len must be >= 1, got {prompt_len}")
         if max_new_tokens < 1:
@@ -190,6 +196,7 @@ class KVSlotPool:
 
     def stats(self) -> Dict[str, object]:
         return {
+            "layout": self.layout,
             "num_slots": self.num_slots,
             "max_len": self.max_len,
             "occupancy": self.occupancy,

@@ -33,9 +33,12 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from ray_lightning_tpu import observability as _obs
 
 __all__ = [
+    "Autoscaler",
+    "LocalReplicaFleet",
     "ReplicaGroup",
     "ServeFuture",
     "ServeReplicaActor",
+    "autoscale_decision",
     "needs_relaunch",
     "pick_least_loaded",
 ]
@@ -48,21 +51,158 @@ def pick_least_loaded(
     loads: Dict[int, Dict[str, float]],
     num_replicas: int,
     rr_counter: int,
+    indices: Optional[Sequence[int]] = None,
 ) -> int:
     """Pick a replica index: min (queue_depth + active); replicas with no
     load report yet count as load 0 (fresh replicas attract traffic).
     Ties break round-robin on ``rr_counter`` so equal replicas share
-    load instead of replica 0 absorbing everything."""
-    if num_replicas < 1:
-        raise ValueError("num_replicas must be >= 1")
+    load instead of replica 0 absorbing everything.
+
+    ``indices`` restricts routing to an explicit set of replica indices
+    (an elastic fleet's indices are sparse: draining replicas are
+    excluded, added ones need not be contiguous); the default is the
+    dense ``range(num_replicas)``."""
+    if indices is None:
+        if num_replicas < 1:
+            raise ValueError("num_replicas must be >= 1")
+        indices = range(num_replicas)
+    else:
+        indices = list(indices)
+        if not indices:
+            raise ValueError("no routable replicas")
 
     def load_of(i: int) -> float:
         entry = loads.get(i) or {}
         return float(entry.get("queue_depth", 0)) + float(entry.get("active", 0))
 
-    best = min(load_of(i) for i in range(num_replicas))
-    candidates = [i for i in range(num_replicas) if load_of(i) == best]
+    best = min(load_of(i) for i in indices)
+    candidates = [i for i in indices if load_of(i) == best]
     return candidates[rr_counter % len(candidates)]
+
+
+def autoscale_decision(
+    loads: Dict[int, Dict[str, float]],
+    num_replicas: int,
+    min_replicas: int,
+    max_replicas: int,
+    queue_high: float = 4.0,
+    ttft_high_ms: Optional[float] = None,
+) -> int:
+    """Pure scaling verdict: +1 (add a replica), -1 (drain one), or 0.
+
+    Scale UP when demand outruns the fleet — mean queue depth per
+    replica exceeds ``queue_high``, or any replica's recent TTFT p95
+    exceeds ``ttft_high_ms`` (latency degrades before queues explode
+    when prompts are long). Scale DOWN only when the fleet is completely
+    idle (zero queued AND zero active everywhere): a drain on a busy
+    replica would trade capacity for nothing. Bounds are clamped to
+    [min_replicas, max_replicas]; hysteresis (cooldowns, consecutive
+    idle ticks) is the :class:`Autoscaler`'s job, not this function's —
+    keeping the verdict stateless is what makes it unit-testable."""
+    if min_replicas < 1:
+        raise ValueError("min_replicas must be >= 1")
+    if max_replicas < min_replicas:
+        raise ValueError("max_replicas must be >= min_replicas")
+    entries = [e or {} for e in loads.values()]
+    total_queued = sum(float(e.get("queue_depth", 0)) for e in entries)
+    total_active = sum(float(e.get("active", 0)) for e in entries)
+    worst_ttft = max(
+        (float(e.get("ttft_p95_ms", 0.0)) for e in entries), default=0.0
+    )
+    if num_replicas < max_replicas:
+        if total_queued / max(num_replicas, 1) > queue_high:
+            return 1
+        if ttft_high_ms is not None and worst_ttft > ttft_high_ms:
+            return 1
+    if (
+        num_replicas > min_replicas
+        and total_queued == 0
+        and total_active == 0
+    ):
+        return -1
+    return 0
+
+
+class Autoscaler:
+    """Drives an elastic fleet from its own load reports.
+
+    ``fleet`` is duck-typed: ``num_replicas`` (int), ``loads()``
+    (replica index -> load dict with queue_depth / active /
+    ttft_p95_ms), ``add_replica()``, and ``remove_replica()`` (graceful
+    drain). Both :class:`LocalReplicaFleet` and :class:`ReplicaGroup`
+    satisfy it.
+
+    The verdict comes from :func:`autoscale_decision`; this class adds
+    the hysteresis that keeps a fleet from thrashing: ``cooldown_s``
+    between any two scale actions, and ``idle_ticks_down`` consecutive
+    idle verdicts before a drain actually starts (one quiet heartbeat
+    between bursts must not shed capacity). Call :meth:`tick` on
+    whatever cadence the driver polls health — each call applies at most
+    ONE replica of change, so a load spike ramps over several ticks
+    rather than over-provisioning on a single noisy sample."""
+
+    def __init__(
+        self,
+        fleet: Any,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        queue_high: float = 4.0,
+        ttft_high_ms: Optional[float] = None,
+        cooldown_s: float = 0.0,
+        idle_ticks_down: int = 2,
+    ):
+        if idle_ticks_down < 1:
+            raise ValueError("idle_ticks_down must be >= 1")
+        self.fleet = fleet
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.queue_high = float(queue_high)
+        self.ttft_high_ms = ttft_high_ms
+        self.cooldown_s = float(cooldown_s)
+        self.idle_ticks_down = int(idle_ticks_down)
+        self._last_action_at: Optional[float] = None
+        self._idle_streak = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.history: List[Tuple[float, int, int]] = []  # (t, n, delta)
+
+    def tick(self, now: Optional[float] = None) -> int:
+        """Evaluate once; returns the applied delta (-1, 0, +1)."""
+        now = time.monotonic() if now is None else now
+        n = int(self.fleet.num_replicas)
+        delta = autoscale_decision(
+            self.fleet.loads(),
+            n,
+            self.min_replicas,
+            self.max_replicas,
+            queue_high=self.queue_high,
+            ttft_high_ms=self.ttft_high_ms,
+        )
+        if delta < 0:
+            self._idle_streak += 1
+            if self._idle_streak < self.idle_ticks_down:
+                delta = 0
+        else:
+            self._idle_streak = 0
+        if delta != 0 and self._last_action_at is not None:
+            if now - self._last_action_at < self.cooldown_s:
+                delta = 0
+        if delta > 0:
+            self.fleet.add_replica()
+            self.scale_ups += 1
+        elif delta < 0:
+            self.fleet.remove_replica()
+            self.scale_downs += 1
+            self._idle_streak = 0
+        if delta != 0:
+            self._last_action_at = now
+            self.history.append((now, int(self.fleet.num_replicas), delta))
+        reg = _obs.registry()
+        if reg is not None:
+            reg.gauge("rlt_serve_replicas").set(
+                int(self.fleet.num_replicas)
+            )
+        return delta
 
 
 def needs_relaunch(
@@ -114,6 +254,140 @@ class _LoadTap:
     def snapshot(self) -> Dict[int, Dict[str, float]]:
         with self._lock:
             return {k: dict(v) for k, v in self.loads.items()}
+
+
+# --------------------------------------------------------------------- #
+# threads-as-replicas fleet (single process; the autoscaler's CPU target)
+# --------------------------------------------------------------------- #
+class LocalReplicaFleet:
+    """An elastic fleet of in-process engines, one loop THREAD each.
+
+    Same routing/scaling surface as :class:`ReplicaGroup` but without
+    actors: every replica shares this process's params (free on CPU,
+    where the autoscaler e2e runs), so ``add_replica`` costs one engine
+    construction and ``remove_replica`` is a true graceful drain — the
+    replica leaves the routing set immediately, its engine finishes
+    every admitted request, and only then is it discarded. Submissions
+    return the engine's own :class:`~.engine.Completion`, which stays
+    valid across the owning replica's drain — that is the zero-dropped-
+    requests guarantee the autoscaler e2e asserts.
+    """
+
+    def __init__(
+        self,
+        builder: Callable[[], Tuple[Any, Any]],
+        engine_kwargs: Optional[Dict[str, Any]] = None,
+        initial_replicas: int = 1,
+    ):
+        self._builder = builder
+        self._engine_kwargs = dict(engine_kwargs or {})
+        self._params_cfg: Optional[Tuple[Any, Any]] = None
+        self._replicas: Dict[int, Any] = {}  # routable engines
+        self._draining: Dict[int, Any] = {}  # engines finishing in-flight
+        self._drain_threads: List[threading.Thread] = []
+        self._next_index = 0
+        self._rr = 0
+        self._lock = threading.Lock()
+        self.added_total = 0
+        self.removed_total = 0
+        for _ in range(int(initial_replicas)):
+            self.add_replica()
+
+    # ---------------- fleet surface (Autoscaler duck type) ------------- #
+    @property
+    def num_replicas(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def loads(self) -> Dict[int, Dict[str, float]]:
+        with self._lock:
+            replicas = dict(self._replicas)
+        return {i: eng.load() for i, eng in replicas.items()}
+
+    def add_replica(self) -> int:
+        from ray_lightning_tpu.serving.engine import (
+            EngineConfig,
+            InferenceEngine,
+        )
+
+        if self._params_cfg is None:
+            # one build, shared by every replica: engines never mutate
+            # params, and on CPU duplicate weights would be pure waste
+            self._params_cfg = self._builder()
+        params, cfg = self._params_cfg
+        engine = InferenceEngine(
+            params, cfg, EngineConfig(**self._engine_kwargs)
+        )
+        engine.start()
+        with self._lock:
+            index = self._next_index
+            self._next_index += 1
+            self._replicas[index] = engine
+        self.added_total += 1
+        self._publish_size()
+        return index
+
+    def remove_replica(self, index: Optional[int] = None) -> Optional[int]:
+        """Gracefully drain one replica (default: the newest). Returns
+        its index, or ``None`` when the fleet is down to one replica —
+        the fleet never drains itself to zero."""
+        with self._lock:
+            if len(self._replicas) <= 1:
+                return None
+            if index is None:
+                index = max(self._replicas)
+            engine = self._replicas.pop(index)  # leaves routing NOW
+            self._draining[index] = engine
+
+        def drain_and_discard():
+            engine.drain()  # finishes queued + in-flight, stops the loop
+            with self._lock:
+                self._draining.pop(index, None)
+
+        t = threading.Thread(
+            target=drain_and_discard, daemon=True,
+            name=f"rlt-fleet-drain-{index}",
+        )
+        t.start()
+        self._drain_threads.append(t)
+        self.removed_total += 1
+        self._publish_size()
+        return index
+
+    # ---------------- request path ------------------------------------- #
+    def submit(
+        self,
+        prompt_tokens: Sequence[int],
+        max_new_tokens: int = 16,
+        eos_id: Any = "__default__",
+    ):
+        """Route to the least-loaded routable replica; returns the
+        engine's Completion handle (valid across drains)."""
+        with self._lock:
+            if not self._replicas:
+                raise RuntimeError("fleet has no replicas")
+            replicas = dict(self._replicas)
+            rr = self._rr
+            self._rr += 1
+        loads = {i: eng.load() for i, eng in replicas.items()}
+        index = pick_least_loaded(loads, 0, rr, indices=list(replicas))
+        return replicas[index].submit(
+            prompt_tokens, max_new_tokens=max_new_tokens, eos_id=eos_id
+        )
+
+    def shutdown(self) -> None:
+        with self._lock:
+            engines = list(self._replicas.values())
+            self._replicas.clear()
+        for engine in engines:
+            engine.drain()
+        for t in self._drain_threads:
+            t.join(timeout=30)
+
+    def _publish_size(self) -> None:
+        reg = _obs.registry()
+        if reg is not None:
+            reg.gauge("rlt_serve_replicas").set(self.num_replicas)
 
 
 # --------------------------------------------------------------------- #
@@ -269,6 +543,17 @@ class ReplicaGroup:
     ``hang_timeout`` arms the per-replica relaunch policy (None =
     monitor only); the underlying Supervisor always runs monitor-mode —
     group-wide teardown is a training semantic, not a serving one.
+
+    The group is ELASTIC: :meth:`add_replica` launches a new actor under
+    a fresh index (indices are stable for the life of a replica —
+    :class:`ServeFuture` routes polls by index, so indices are never
+    reused while a future can still reference them), and
+    :meth:`remove_replica` gracefully drains one: it leaves the routing
+    set immediately, finishes every admitted request, waits for the
+    driver to collect all outstanding futures, and only then releases
+    the actor. Wire an :class:`Autoscaler` to the group (it satisfies
+    the fleet duck type) to scale on queue depth / TTFT p95 from the
+    heartbeat telemetry.
     """
 
     def __init__(
@@ -287,20 +572,33 @@ class ReplicaGroup:
             raise ValueError("num_replicas must be >= 1")
         self._builder = builder
         self._engine_kwargs = dict(engine_kwargs or {})
-        self.num_replicas = int(num_replicas)
+        self._initial_replicas = int(num_replicas)
         self.hang_timeout = hang_timeout
         self.startup_timeout = startup_timeout
         self.heartbeat_interval = float(heartbeat_interval)
         self._env = env
         self._telemetry = telemetry
         self._actor_timeout = float(actor_timeout)
-        self.handles: List[Any] = []
+        self.handles: Dict[int, Any] = {}
         self.tap = _LoadTap()
         self.relaunches_total = 0
+        self.added_total = 0
+        self.removed_total = 0
+        self._next_index = 0
+        self._draining: set = set()
+        self._inflight: Dict[str, int] = {}  # request id -> replica index
+        self._drain_threads: List[threading.Thread] = []
         self._rr = 0
         self._lock = threading.Lock()
         self._queue = None
         self._supervisor = None
+
+    @property
+    def num_replicas(self) -> int:
+        """Routable replicas (draining ones no longer count)."""
+        if not self.handles and self._next_index == 0:
+            return self._initial_replicas  # pre-start sizing
+        return len(self.handles) - len(self._draining)
 
     # ------------------------------ lifecycle -------------------------- #
     def start(self) -> "ReplicaGroup":
@@ -313,16 +611,21 @@ class ReplicaGroup:
         if not rt.is_initialized():
             rt.init()
         self._queue = make_queue()
-        self.handles = rt.create_actors(
-            [self._spec(i) for i in range(self.num_replicas)],
-            names=[self._name(i) for i in range(self.num_replicas)],
+        indices = list(range(self._initial_replicas))
+        created = rt.create_actors(
+            [self._spec(i) for i in indices],
+            names=[self._name(i) for i in indices],
             env=self._env,
             timeout=self._actor_timeout,
         )
+        self.handles = dict(zip(indices, created))
+        self._next_index = self._initial_replicas
         # monitor-mode supervisor: pumps beats + ages into the tap; the
-        # RELAUNCH policy is ours (per replica), so no kill_group
+        # RELAUNCH policy is ours (per replica), so no kill_group. Beats
+        # from replicas added later auto-register (observe() creates
+        # health records for unknown ranks).
         self._supervisor = Supervisor(
-            num_workers=self.num_replicas,
+            num_workers=self._initial_replicas,
             drain=self._queue.get_all,
             hang_timeout=None,
             heartbeat_interval=self.heartbeat_interval,
@@ -331,6 +634,91 @@ class ReplicaGroup:
         )
         self._supervisor.start()
         return self
+
+    # ------------------------------ elasticity ------------------------- #
+    def add_replica(self) -> int:
+        """Launch one more replica actor; returns its (new) index."""
+        from ray_lightning_tpu.runtime import api as rt
+
+        if not self.handles:
+            raise RuntimeError("ReplicaGroup.start() first")
+        with self._lock:
+            index = self._next_index
+            self._next_index += 1
+        handle = rt.create_actors(
+            [self._spec(index)],
+            names=[self._name(index)],
+            env=self._env,
+            timeout=self._actor_timeout,
+        )[0]
+        with self._lock:
+            self.handles[index] = handle
+        self.added_total += 1
+        self.tap.record_event("serve_replica_added", replica=index)
+        self._publish_size()
+        return index
+
+    def remove_replica(self, index: Optional[int] = None) -> Optional[int]:
+        """Gracefully drain one replica (default: the newest routable).
+        Returns its index, or ``None`` at the one-replica floor.
+
+        The replica leaves the routing set before the drain starts, so
+        no new request can land on it; its engine finishes everything
+        already admitted; the release then waits until every outstanding
+        :class:`ServeFuture` for it has been collected — zero dropped
+        requests by construction."""
+        with self._lock:
+            routable = [i for i in self.handles if i not in self._draining]
+            if len(routable) <= 1:
+                return None
+            if index is None:
+                index = max(routable)
+            elif index not in routable:
+                raise ValueError(f"replica {index} is not routable")
+            self._draining.add(index)
+            handle = self.handles[index]
+            self.tap.loads.pop(index, None)
+        self.tap.record_event("serve_replica_drain", replica=index)
+
+        def drain_and_release():
+            from ray_lightning_tpu.runtime import api as rt
+
+            try:
+                handle.drain.remote().result(timeout=self._actor_timeout)
+            except Exception:
+                pass
+            # futures poll by index: hold the actor until every
+            # outstanding result() has been served
+            deadline = time.monotonic() + self._actor_timeout
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if index not in self._inflight.values():
+                        break
+                time.sleep(0.05)
+            try:
+                rt.kill(handle)
+            except Exception:
+                pass
+            with self._lock:
+                self.handles.pop(index, None)
+                self._draining.discard(index)
+            if self._supervisor is not None:
+                self._supervisor.health.pop(index, None)
+
+        t = threading.Thread(
+            target=drain_and_release, daemon=True,
+            name=f"rlt-serve-drain-{index}",
+        )
+        t.start()
+        self._drain_threads.append(t)
+        self.removed_total += 1
+        self._publish_size()
+        return index
+
+    def _publish_size(self) -> None:
+        reg = _obs.registry()
+        if reg is not None:
+            reg.gauge("rlt_serve_replicas").set(self.num_replicas)
 
     def _spec(self, index: int):
         return (
@@ -355,7 +743,9 @@ class ReplicaGroup:
         if self._supervisor is not None:
             self._supervisor.stop()
             self._supervisor = None
-        for handle in self.handles:
+        for t in self._drain_threads:
+            t.join(timeout=30)
+        for handle in list(self.handles.values()):
             try:
                 handle.drain.remote().result(timeout=30)
             except Exception:
@@ -364,7 +754,8 @@ class ReplicaGroup:
                 rt.kill(handle)
             except Exception:
                 pass
-        self.handles = []
+        self.handles = {}
+        self._draining = set()
         if self._queue is not None:
             try:
                 self._queue.shutdown()
@@ -382,27 +773,39 @@ class ReplicaGroup:
         if not self.handles:
             raise RuntimeError("ReplicaGroup.start() first")
         with self._lock:
+            routable = [i for i in self.handles if i not in self._draining]
             replica = pick_least_loaded(
-                self.tap.snapshot(), self.num_replicas, self._rr
+                self.tap.snapshot(), 0, self._rr, indices=routable
             )
             self._rr += 1
             # count the routed request locally so a burst between two
             # heartbeats does not all land on the same replica
             entry = self.tap.loads.setdefault(replica, {})
             entry["queue_depth"] = float(entry.get("queue_depth", 0)) + 1
+            handle = self.handles[replica]
         rid = (
-            self.handles[replica]
+            handle
             .submit.remote(list(prompt_tokens), max_new_tokens, eos_id)
             .result(timeout=30)
         )
+        with self._lock:
+            self._inflight[rid] = replica
         return ServeFuture(self, replica, rid)
 
     def _poll(self, replica: int, request_id: str) -> Dict[str, Any]:
-        return (
-            self.handles[replica]
-            .poll.remote(request_id)
-            .result(timeout=30)
-        )
+        with self._lock:
+            handle = self.handles.get(replica)
+        if handle is None:
+            raise RuntimeError(
+                f"replica {replica} is gone with request "
+                f"{request_id!r} unresolved (released before collection "
+                "— drain accounting bug)"
+            )
+        state = handle.poll.remote(request_id).result(timeout=30)
+        if state.get("done"):
+            with self._lock:
+                self._inflight.pop(request_id, None)
+        return state
 
     def loads(self) -> Dict[int, Dict[str, float]]:
         return self.tap.snapshot()
@@ -415,7 +818,9 @@ class ReplicaGroup:
         if self._supervisor is None:
             return out
         now = time.monotonic()
-        for index in range(self.num_replicas):
+        with self._lock:
+            indices = [i for i in self.handles if i not in self._draining]
+        for index in indices:
             health = self._supervisor.health.get(index)
             dead = not self._is_alive(index)
             condemned = dead or needs_relaunch(

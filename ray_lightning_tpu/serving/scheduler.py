@@ -86,6 +86,7 @@ class ContinuousBatchScheduler:
         self._lock = threading.Lock()
         self.queued_total = 0
         self.rejected_total = 0
+        self.deferred_total = 0  # ticks the queue head waited for capacity
 
     # ------------------------------------------------------------------ #
     # producer side (any thread)
@@ -121,23 +122,34 @@ class ContinuousBatchScheduler:
     # engine side (the loop thread)
     # ------------------------------------------------------------------ #
     def tick(self) -> Plan:
-        """Admit queued requests into free slots (bounded per tick) and
-        return the iteration plan."""
+        """Admit queued requests into free capacity (bounded per tick)
+        and return the iteration plan.
+
+        Admission is peek-then-acquire: the pool may refuse the queue
+        head (no free slot, or — paged layout — not enough KV blocks for
+        the prompt plus its worst-case growth reservation), in which
+        case the head stays queued and this tick admits nothing more.
+        Strict FIFO head-of-line blocking is deliberate: skipping ahead
+        to a smaller request would starve long prompts under sustained
+        short-request load."""
         prefills: List[Tuple[Request, Slot]] = []
         with self._lock:
             while (
                 self._queue
-                and self.pool.free_count > 0
                 and len(prefills) < self.max_prefills_per_tick
             ):
-                req = self._queue.popleft()
+                req = self._queue[0]
                 slot = self.pool.acquire(
                     req.request_id,
                     req.prompt_len,
                     req.max_new_tokens,
                     eos_id=req.eos_id,
+                    prompt_tokens=req.tokens,
                 )
-                assert slot is not None  # guarded by free_count above
+                if slot is None:  # back-pressure: keep the head queued
+                    self.deferred_total += 1
+                    break
+                self._queue.popleft()
                 prefills.append((req, slot))
             depth = len(self._queue)
         self._publish_depth(depth)

@@ -18,16 +18,21 @@ import pytest
 from ray_lightning_tpu.models.generation import generate
 from ray_lightning_tpu.models.llama import LlamaConfig, init_params
 from ray_lightning_tpu.serving import (
+    Autoscaler,
     ContinuousBatchScheduler,
     EngineClosed,
     EngineConfig,
     InferenceEngine,
     KVSlotPool,
+    LocalReplicaFleet,
+    PagedKVPool,
     Request,
     RequestQueueFull,
+    autoscale_decision,
     needs_relaunch,
     pick_least_loaded,
 )
+from ray_lightning_tpu.serving.paged_kv import TRASH_BLOCK
 
 pytestmark = pytest.mark.serving
 
@@ -345,3 +350,261 @@ def test_replica_group_serves_and_balances(model):
         assert group.check() == {0: "ok", 1: "ok"}
     finally:
         group.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# paged KV layout: parity, prefix sharing, block back-pressure
+# --------------------------------------------------------------------- #
+def test_paged_engine_matches_slot_and_generate(model):
+    """The staggered acceptance e2e on the PAGED layout with a tiny block
+    size: the same 8 requests as the slot-layout e2e above, so every
+    completion being token-identical to sequential generate() also proves
+    paged == slot bitwise. Block growth happens mid-decode (grown_total),
+    and the jit caches stay FLAT across admit/recycle/growth."""
+    params, cfg = model
+    engine = InferenceEngine(
+        params,
+        cfg,
+        EngineConfig(
+            num_slots=2, max_prompt_len=8, max_len=32,
+            kv_layout="paged", block_size=4,
+        ),
+    )
+    rng = np.random.default_rng(0)
+    reqs = [
+        (
+            [int(t) for t in rng.integers(1, cfg.vocab_size, rng.integers(3, 8))],
+            int(rng.integers(4, 9)),
+        )
+        for _ in range(8)
+    ]
+
+    completions = [engine.submit(p, max_new_tokens=n) for p, n in reqs[:3]]
+    for _ in range(4):
+        engine.step()
+    warm = engine.compile_stats()
+    assert warm == {"prefill_compiles": 1, "decode_compiles": 1}
+    completions += [engine.submit(p, max_new_tokens=n) for p, n in reqs[3:]]
+    engine.run_until_idle()
+
+    for (prompt, n_new), comp in zip(reqs, completions):
+        assert comp.finish_reason == "length"
+        assert comp.result(timeout=1) == _reference(params, cfg, prompt, n_new)
+
+    alloc = engine.pool.allocator
+    assert alloc.grown_total > 0  # decode crossed block boundaries
+    assert alloc.used_blocks == 0  # every request released its blocks
+    assert engine.pool.recycled_total == 8
+    assert engine.pool.occupancy == 0
+    # zero steady-state recompiles under admission, recycling AND growth
+    assert engine.compile_stats() == warm
+    assert engine.describe()["kv_layout"] == "paged"
+
+
+def test_paged_shared_prefix_bitwise_identical(model):
+    """Two requests with a common system prompt: the shared full blocks
+    are prefilled once and HIT by the second admission, and both
+    continuations are bitwise-identical to the prefix-cache-off run and
+    to the sequential reference — sharing changes allocation, not math."""
+    params, cfg = model
+    system = [3, 1, 4, 1, 5, 9, 2, 6]  # two full 4-token blocks
+    prompts = [system + [11, 12], system + [21, 22, 23]]
+    n_new = 6
+
+    def run(prefix_cache):
+        engine = InferenceEngine(
+            params,
+            cfg,
+            EngineConfig(
+                num_slots=2, max_prompt_len=12, max_len=32,
+                kv_layout="paged", block_size=4, prefix_cache=prefix_cache,
+            ),
+        )
+        comps = [engine.submit(p, max_new_tokens=n_new) for p in prompts]
+        engine.run_until_idle()
+        return engine, [c.result(timeout=1) for c in comps]
+
+    shared_engine, shared = run(prefix_cache=True)
+    # both leading system-prompt blocks were served from the chain cache
+    assert shared_engine.pool.allocator.prefix_hits_total == 2
+    unshared_engine, unshared = run(prefix_cache=False)
+    assert unshared_engine.pool.allocator.prefix_hits_total == 0
+    for prompt, a, b in zip(prompts, shared, unshared):
+        ref = _reference(params, cfg, prompt, n_new)
+        assert a == ref  # shared run matches sequential generate()
+        assert b == ref  # and so does the unshared run: bitwise equal
+
+
+def test_paged_pool_write_redirect_and_growth(model):
+    """Pool-level contract: the second tenant of a shared prefix gets a
+    write table that redirects the already-written blocks to TRASH
+    (written exactly once), gathers the same physical blocks, and grows
+    its private tail on demand from the reservation."""
+    _, cfg = model
+    pool = PagedKVPool(cfg, num_slots=2, max_len=16, block_size=4)
+    s1 = pool.acquire("a", prompt_len=9, max_new_tokens=6,
+                      prompt_tokens=[7] * 9)
+    wt1 = pool.prompt_write_table(s1.index, 3)
+    assert TRASH_BLOCK not in wt1  # first tenant writes all its blocks
+    s2 = pool.acquire("b", prompt_len=9, max_new_tokens=6,
+                      prompt_tokens=[7] * 9)
+    assert pool.shared_blocks(s2.index) == 2
+    wt2 = pool.prompt_write_table(s2.index, 3)
+    # shared leading blocks are NOT rewritten; only the private write
+    # frontier (the block decode mutates) lands in the cache
+    assert list(wt2[:2]) == [TRASH_BLOCK, TRASH_BLOCK]
+    assert wt2[2] not in (TRASH_BLOCK, wt1[2])
+    # both block tables gather the same physical prefix blocks
+    assert list(pool.block_tables[s1.index][:2]) == \
+        list(pool.block_tables[s2.index][:2])
+    # decode reaching position 12 pulls block 3 from the reservation
+    assert pool.block_tables[s1.index][3] == TRASH_BLOCK
+    s1.pos = 12
+    pool.ensure_writable(s1)
+    assert pool.block_tables[s1.index][3] != TRASH_BLOCK
+    assert pool.allocator.grown_total == 1
+    pool.release(s1.index)
+    pool.release(s2.index)
+    assert pool.allocator.used_blocks == 0
+
+
+def test_scheduler_defers_on_block_exhaustion_fifo(model):
+    """Admission is gated by BLOCK availability, not just free slots: a
+    big tenant exhausts the pool, later small requests wait in strict
+    FIFO (no skip-ahead), and the head admits as soon as blocks free."""
+    _, cfg = model
+    # 4 slots but only 4 data blocks: blocks are the scarce resource
+    pool = PagedKVPool(cfg, num_slots=4, max_len=16, block_size=4,
+                       num_blocks=5, prefix_cache=False)
+    sched = ContinuousBatchScheduler(pool, max_queue=8,
+                                     max_prefills_per_tick=4)
+    sched.submit(Request("big", tuple(range(1, 9)), max_new_tokens=8))
+    sched.submit(Request("tiny1", (1, 2, 3), max_new_tokens=1))
+    sched.submit(Request("tiny2", (4, 5, 6), max_new_tokens=1))
+
+    plan = sched.tick()  # big takes every block; tinies defer
+    assert [r.request_id for r, _ in plan.prefills] == ["big"]
+    assert sched.queue_depth == 2
+    assert sched.deferred_total == 1
+    assert pool.allocator.available() == 0
+    sched.tick()
+    assert sched.deferred_total == 2  # still waiting, still queued
+
+    pool.release(plan.prefills[0][1].index)
+    plan = sched.tick()  # head-of-line order preserved on admission
+    assert [r.request_id for r, _ in plan.prefills] == ["tiny1", "tiny2"]
+    assert sched.queue_depth == 0
+
+
+# --------------------------------------------------------------------- #
+# autoscaler: pure policy + threads-as-replicas e2e
+# --------------------------------------------------------------------- #
+def test_autoscale_decision_policy():
+    busy = {0: {"queue_depth": 9, "active": 2}}
+    assert autoscale_decision(busy, 1, 1, 4) == 1
+    assert autoscale_decision(busy, 4, 1, 4) == 0  # at the ceiling
+    # TTFT latency trips scale-up even when queues look shallow
+    slow = {0: {"queue_depth": 0, "active": 1, "ttft_p95_ms": 900.0}}
+    assert autoscale_decision(slow, 1, 1, 4, ttft_high_ms=500.0) == 1
+    assert autoscale_decision(slow, 1, 1, 4) == 0  # signal off by default
+    # scale down only when the WHOLE fleet is idle, and never below min
+    idle = {0: {"queue_depth": 0, "active": 0}, 1: {}}
+    assert autoscale_decision(idle, 2, 1, 4) == -1
+    assert autoscale_decision(idle, 1, 1, 4) == 0
+    assert autoscale_decision({0: {"queue_depth": 0, "active": 1}}, 2, 1, 4) == 0
+    with pytest.raises(ValueError):
+        autoscale_decision({}, 1, 0, 4)
+
+
+def test_pick_least_loaded_sparse_indices():
+    loads = {3: {"queue_depth": 2}, 7: {"queue_depth": 0}}
+    assert pick_least_loaded(loads, 0, 0, indices=[3, 7]) == 7
+    # a draining replica leaves the routable set; traffic falls back
+    assert pick_least_loaded(loads, 0, 0, indices=[3]) == 3
+    with pytest.raises(ValueError, match="no routable"):
+        pick_least_loaded(loads, 0, 0, indices=[])
+
+
+class _FakeFleet:
+    def __init__(self, n=2):
+        self.n = n
+        self.load_reports = {}
+
+    @property
+    def num_replicas(self):
+        return self.n
+
+    def loads(self):
+        return self.load_reports
+
+    def add_replica(self):
+        self.n += 1
+
+    def remove_replica(self):
+        self.n -= 1
+
+
+def test_autoscaler_hysteresis_cooldown_and_idle_ticks():
+    fleet = _FakeFleet(n=2)
+    scaler = Autoscaler(fleet, min_replicas=1, max_replicas=4,
+                        queue_high=1.0, cooldown_s=10.0, idle_ticks_down=2)
+    fleet.load_reports = {0: {"queue_depth": 8}}
+    assert scaler.tick(now=0.0) == 1 and fleet.n == 3
+    # cooldown suppresses the immediate follow-up...
+    assert scaler.tick(now=1.0) == 0 and fleet.n == 3
+    # ...but not the next eligible tick
+    assert scaler.tick(now=11.0) == 1 and fleet.n == 4
+    # one quiet beat between bursts must not shed capacity: the first
+    # idle verdict only arms, the second fires
+    fleet.load_reports = {0: {"queue_depth": 0, "active": 0}}
+    assert scaler.tick(now=30.0) == 0 and fleet.n == 4
+    assert scaler.tick(now=41.0) == -1 and fleet.n == 3
+    assert scaler.scale_ups == 2 and scaler.scale_downs == 1
+
+
+def test_local_fleet_autoscales_up_and_drains_down(model):
+    """Autoscaler e2e on the threads-as-replicas fleet: an over-offered
+    burst scales the fleet up, every completion still matches the
+    sequential reference (zero dropped requests, including those owned
+    by later-drained replicas), and an idle fleet drains back to the
+    floor gracefully."""
+    params, cfg = model
+    fleet = LocalReplicaFleet(
+        lambda: (params, cfg),
+        engine_kwargs={"num_slots": 2, "max_prompt_len": 8, "max_len": 32},
+        initial_replicas=1,
+    )
+    scaler = Autoscaler(fleet, min_replicas=1, max_replicas=3,
+                        queue_high=2.0, idle_ticks_down=2)
+    try:
+        rng = np.random.default_rng(7)
+        reqs = [
+            (
+                [int(t) for t in rng.integers(1, cfg.vocab_size, 5)],
+                int(rng.integers(4, 7)),
+            )
+            for _ in range(12)
+        ]
+        comps = [fleet.submit(p, max_new_tokens=n) for p, n in reqs]
+        # the burst all routed to replica 0 (the only one): its queue
+        # depth trips the scaler while it is still prefill-compiling
+        for _ in range(3):
+            scaler.tick()
+        assert fleet.num_replicas >= 2 and scaler.scale_ups >= 1
+
+        for (prompt, n_new), comp in zip(reqs, comps):
+            assert comp.result(timeout=180) == _reference(
+                params, cfg, prompt, n_new
+            )
+        assert all(c.finish_reason == "length" for c in comps)
+
+        # idle: consecutive quiet ticks drain the fleet back to one
+        deadline = time.time() + 60
+        while fleet.num_replicas > 1 and time.time() < deadline:
+            scaler.tick()
+            time.sleep(0.05)
+        assert fleet.num_replicas == 1
+        assert scaler.scale_downs >= 1
+        assert fleet.removed_total == fleet.added_total - 1
+    finally:
+        fleet.shutdown()
