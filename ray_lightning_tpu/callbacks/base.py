@@ -65,6 +65,12 @@ class Callback:
 
     def on_exception(self, trainer, module, err: BaseException) -> None: ...
 
+    # elastic resize: fired after the trainer has reconnected at a new
+    # world size and restored state, before the first step of the new
+    # membership epoch — callbacks holding backend-bound resources (open
+    # checkpoint managers, compiled fns) must rebuild them here
+    def on_membership_resize(self, trainer, module) -> None: ...
+
     # checkpoint state round-trip (PTL parity; the reference's resume tests
     # depend on callback state surviving, e.g. EarlyStopping wait counts:
     # ray_lightning/tests/test_ddp.py:289-308)

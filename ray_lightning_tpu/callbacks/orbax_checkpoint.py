@@ -10,6 +10,7 @@ tests via FairScale (reference: tests/test_ddp_sharded.py:118-137).
 """
 from __future__ import annotations
 
+import itertools
 import os
 from typing import Any, Dict, Optional
 
@@ -38,6 +39,7 @@ class OrbaxModelCheckpoint(Callback):
         self,
         dirpath: Optional[str] = None,
         every_n_epochs: int = 1,
+        every_n_steps: Optional[int] = None,
         max_to_keep: int = 3,
         async_save: bool = True,
     ):
@@ -45,6 +47,13 @@ class OrbaxModelCheckpoint(Callback):
             raise RuntimeError("orbax-checkpoint is not installed")
         self.dirpath = dirpath
         self.every_n_epochs = max(1, every_n_epochs)
+        if every_n_steps is None:
+            raw = os.environ.get("RLT_CKPT_EVERY_N_STEPS")
+            if raw:
+                every_n_steps = int(raw)
+        # streaming saves: also checkpoint every N optimizer steps so a
+        # crash/shrink mid-epoch loses at most N steps, not a whole epoch
+        self.every_n_steps = max(1, int(every_n_steps)) if every_n_steps else None
         self.max_to_keep = max_to_keep
         self.async_save = async_save
         self._manager: Optional["ocp.CheckpointManager"] = None
@@ -59,11 +68,23 @@ class OrbaxModelCheckpoint(Callback):
     def setup(self, trainer, module, stage: str) -> None:
         if self.dirpath is None:
             self.dirpath = self.default_dirpath(trainer)
+        self._manager = self._build_manager()
+
+    def _build_manager(self) -> "ocp.CheckpointManager":
+        # create=False skips CheckpointManager.__init__'s cross-process
+        # directory barrier: processes reach manager construction at
+        # different times in an elastic group (a joiner builds its manager
+        # in setup while survivors are mid-resize), so any collective here
+        # deadlocks. Directory creation is just a local mkdir instead —
+        # every worker shares one filesystem in the paths that reach this.
+        os.makedirs(os.path.abspath(self.dirpath), exist_ok=True)
+        self._realign_barrier_counters()
         options = ocp.CheckpointManagerOptions(
             max_to_keep=self.max_to_keep,
             enable_async_checkpointing=self.async_save,
+            create=False,
         )
-        self._manager = ocp.CheckpointManager(
+        return ocp.CheckpointManager(
             os.path.abspath(self.dirpath), options=options
         )
 
@@ -72,6 +93,29 @@ class OrbaxModelCheckpoint(Callback):
             return
         if trainer.current_epoch % self.every_n_epochs != 0:
             return
+        self._save(trainer, trainer.global_step, bool(trainer._epoch_ended))
+
+    def on_train_batch_end(self, trainer, module, outputs, batch, batch_idx) -> None:
+        if trainer.sanity_checking or self._manager is None:
+            return
+        if self.every_n_steps is None:
+            return
+        # this hook fires BEFORE the trainer bumps global_step, so the step
+        # the just-applied update produced is global_step + 1
+        step = trainer.global_step + 1
+        if step % self.every_n_steps != 0:
+            return
+        latest = self._manager.latest_step()
+        if latest is not None and step <= latest:
+            # a resume re-runs its epoch from the start; those steps are
+            # already committed on disk
+            return
+        # wait-on-previous: at most one async commit in flight, so a fast
+        # cadence degrades to synchronous instead of queueing unboundedly
+        self._manager.wait_until_finished()
+        self._save(trainer, step, epoch_complete=False)
+
+    def _save(self, trainer, step: int, epoch_complete: bool) -> None:
         items = {"params": ocp.args.StandardSave(trainer._params)}
         if trainer._opt_state is not None:
             items["opt_state"] = ocp.args.StandardSave(trainer._opt_state)
@@ -86,21 +130,48 @@ class OrbaxModelCheckpoint(Callback):
         items["meta"] = ocp.args.StandardSave(
             {
                 "epoch": np.asarray(trainer.current_epoch),
-                "epoch_complete": np.asarray(bool(trainer._epoch_ended)),
+                "epoch_complete": np.asarray(epoch_complete),
                 "aux": np.frombuffer(aux, dtype=np.uint8).copy(),
             }
         )
         # the span covers only the (usually short) async dispatch; the
         # actual shard writes overlap with subsequent training steps
         with obs.span(
-            "checkpoint/orbax_save", step=trainer.global_step, dir=self.dirpath
+            "checkpoint/orbax_save", step=step, dir=self.dirpath
         ):
-            self._manager.save(
-                trainer.global_step, args=ocp.args.Composite(**items)
-            )
+            self._manager.save(step, args=ocp.args.Composite(**items))
         reg = obs.registry()
         if reg is not None:
             reg.counter("rlt_checkpoint_saves_total", format="orbax").inc()
+
+    def on_membership_resize(self, trainer, module) -> None:
+        """Elastic resize: the old manager's async machinery holds commit
+        barriers spanning the OLD process group — closing it (or waiting on
+        it) could block against peers that are already dead. Abandon it
+        without closing and open a fresh manager over the same directory;
+        partially-written steps are uncommitted and invisible to
+        latest_step()."""
+        if self._manager is None:
+            return
+        self._manager = None
+        self._manager = self._build_manager()
+
+    @staticmethod
+    def _realign_barrier_counters() -> None:
+        """Orbax embeds process-LOCAL monotonic counters in its multihost
+        barrier names (``multihost/counters.py``): two processes only
+        rendezvous if they have performed the same number of saves since
+        interpreter start. In an elastic group that is false by design — a
+        joiner starts at zero while survivors have been saving all along —
+        so the counters are re-zeroed on every member at manager (re)build,
+        which is a membership-synchronous point on all of them."""
+        try:
+            from orbax.checkpoint.multihost import counters as _counters
+        except ImportError:  # pragma: no cover - layout varies across versions
+            return
+        for name in vars(_counters):
+            if name.startswith("_") and name.endswith("_counter"):
+                setattr(_counters, name, itertools.count())
 
     def on_fit_end(self, trainer, module) -> None:
         if self._manager is not None:

@@ -847,7 +847,14 @@ class Trainer:
         self._input_stats = {"starved_s": 0.0, "batches": 0}
         _setup_wall, _setup_t0 = time.time(), time.perf_counter()
         seed = seed_everything(self.seed)
+        self._seed_used = seed
         self._datamodule = datamodule
+        elastic_agent = getattr(self, "_elastic_agent", None)
+        if elastic_agent is not None and elastic_agent.is_joiner:
+            # warm spare: block until a grow command admits us, join that
+            # rendezvous, and pick up our logical rank — all before the
+            # backend is built, so setup_environment sees the joined world
+            self._elastic_join(elastic_agent)
         self.strategy.setup_environment()
         if hasattr(model, "mesh"):
             model.mesh = self.strategy.mesh
@@ -873,6 +880,20 @@ class Trainer:
             self._rng_root
         )
         host_params = cast_floats(host_params, self.precision_policy.param_dtype)
+        # elastic resizes rebuild the placed templates from these shapes
+        # (the live arrays may be poisoned by a failed donated step)
+        self._param_shape_tree = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), host_params
+        )
+        if elastic_agent is not None and elastic_agent.pending_handoff_cmd is not None:
+            # adopt-from-handoff joiner: survivors mid-resize are placing
+            # ZEROS onto their rebuilt templates right now, and multihost
+            # device_put cross-checks values across processes — run the
+            # identical placement program here; the handoff below supplies
+            # the real values
+            host_params = jax.tree_util.tree_map(
+                lambda a: np.zeros(a.shape, a.dtype), host_params
+            )
         self._params = self.strategy.place_params(host_params)
         self._tx = self._normalize_tx(model.configure_optimizers())
         self._dcn_ctx = self._setup_dcn_compression()
@@ -916,6 +937,7 @@ class Trainer:
             init_fn = lambda p: tuple(tx.init(p) for tx in self._alt_txs)
         else:
             init_fn = self._tx.init
+        self._opt_init_fn = init_fn  # elastic resizes re-init from this
         opt_shapes = jax.eval_shape(init_fn, self._params)
         opt_shardings = self.strategy.optstate_shardings(opt_shapes)
         if opt_shardings is None:
@@ -935,17 +957,11 @@ class Trainer:
             # ckpt_path the original fit() call carried
             ckpt_path = relaunch_ckpt
         if ckpt_path is not None:
-            with obs.span("checkpoint/restore", path=ckpt_path):
-                if ckpt_path.startswith("orbax@"):
-                    # "orbax@<step>:<dir>" — a step pinned by the crash-
-                    # relaunch scanner so a stale step in a reused dir
-                    # can't win
-                    step_s, d = ckpt_path[len("orbax@"):].split(":", 1)
-                    self._restore_orbax(d, step=int(step_s))
-                elif ckpt_path.startswith("orbax:"):
-                    self._restore_orbax(ckpt_path[len("orbax:"):])
-                else:
-                    self._restore_checkpoint(ckpt_path)
+            self._restore_spec(ckpt_path)
+        if elastic_agent is not None and elastic_agent.pending_handoff_cmd is not None:
+            # re-admitted worker: survivors wrote a live-state snapshot for
+            # our membership epoch — it beats any checkpoint restore above
+            self._load_elastic_handoff(elastic_agent)
 
         train_step = self._build_train_step()
         val_step = self._build_eval_step("val") if val_loader is not None else None
@@ -972,16 +988,43 @@ class Trainer:
 
         try:
             while self.current_epoch < self.max_epochs and not self.should_stop:
-                self._run_train_epoch(train_loader, train_step, val_loader, val_step)
+                try:
+                    self._run_train_epoch(train_loader, train_step, val_loader, val_step)
+                except Exception as err:
+                    cmd = self._elastic_resize_for(err)
+                    if cmd is None:
+                        raise
+                    train_step, val_step = self._apply_resize(
+                        cmd, train_loader, val_loader, err=err
+                    )
+                    # same semantics as a mid-epoch checkpoint resume: the
+                    # epoch re-runs from its start — some batches retrain,
+                    # none are skipped
+                    continue
                 self.current_epoch += 1
                 if 0 <= self.max_steps <= self.global_step:
                     self.should_stop = True
                 if self.should_stop and self.current_epoch < self.min_epochs:
                     self.should_stop = False
+                if elastic_agent is not None:
+                    # epoch boundary: admit a pending grow (or any resize
+                    # that raced the end of the epoch). This runs even on
+                    # the FINAL boundary so a joiner blocked in its admission
+                    # barrier is released and exits cleanly with the group.
+                    cmd = elastic_agent.poll_epoch_end()
+                    if cmd is not None:
+                        train_step, val_step = self._apply_resize(
+                            cmd, train_loader, val_loader
+                        )
         finally:
             # an epoch aborted by an exception skips its own drain/fold;
-            # settle both before the logger closes
-            self._drain_step_logs()
+            # settle both before the logger closes. The drain reads device
+            # arrays — a collective failure can leave them unreadable, and
+            # that must not mask the original error
+            try:
+                self._drain_step_logs()
+            except Exception:
+                self._step_log_buffer = []
             if self._input_prefetcher is not None:
                 self._input_stats["starved_s"] += self._input_prefetcher.starved_s
                 self._input_stats["batches"] += self._input_prefetcher.batches
@@ -1077,6 +1120,249 @@ class Trainer:
         if train:
             _faults.fire_step_faults(self.global_step)
         _session.emit_heartbeat(self.global_step)
+        agent = getattr(self, "_elastic_agent", None)
+        if train and agent is not None:
+            # O(1) ledger poll (one stat): an immediate-apply resize aborts
+            # the epoch via the loop's MembershipChanged handler
+            cmd = agent.poll_now()
+            if cmd is not None:
+                from ray_lightning_tpu.runtime.elastic import MembershipChanged
+
+                raise MembershipChanged(cmd)
+
+    # ------------------------------------------------------------------ #
+    # elastic membership (shrink/grow without a full relaunch)
+    # ------------------------------------------------------------------ #
+    def _elastic_join(self, agent) -> None:
+        """Warm-spare admission: wait for the grow command naming our boot
+        id, join its rendezvous, and adopt our logical rank."""
+        from ray_lightning_tpu.runtime import elastic as _elastic
+
+        with obs.span("elastic/join", boot_id=agent.boot_id):
+            while True:
+                cmd = agent.wait_for_join()
+                try:
+                    cmd = agent.connect(cmd)
+                    break
+                except _elastic.MembershipChanged:
+                    # admission superseded before we connected; wait for the
+                    # next command that names us
+                    continue
+            rank = cmd.rank_of(agent.boot_id)
+            self.strategy._set_worker_context(
+                rank, cmd.world, local_rank=0, node_rank=rank
+            )
+
+    def _load_elastic_handoff(self, agent) -> None:
+        """Joiner side of the admission handoff: adopt the survivors' live
+        params/opt-state/progress snapshot, then ack the membership epoch."""
+        from ray_lightning_tpu.runtime import elastic as _elastic
+
+        cmd = agent.pending_handoff_cmd
+        agent.pending_handoff_cmd = None
+        with obs.span("elastic/handoff_load", epoch=cmd.epoch):
+            payload = _elastic.read_handoff(cmd.handoff, timeout=agent.join_timeout)
+            self._apply_handoff_payload(payload)
+        agent.ack(cmd)
+
+    def _elastic_resize_for(self, err: BaseException):
+        """Map an exception escaping the epoch loop to a resize command, or
+        None when it is not an elastic event. A collective failure (a peer
+        died mid-step) waits for the driver's shrink verdict."""
+        agent = getattr(self, "_elastic_agent", None)
+        if agent is None:
+            return None
+        from ray_lightning_tpu.runtime import elastic as _elastic
+
+        if isinstance(err, _elastic.MembershipChanged):
+            return err.cmd
+        if _elastic.is_collective_failure(err):
+            return agent.wait_for_resize()
+        return None
+
+    def _salvage_live_state(self):
+        """Host copies of (params, opt_state) if still readable. A failed
+        train step poisons its donated inputs — those read back as deleted
+        arrays — so salvage degrades to None and the caller falls back to
+        the handoff/checkpoint tiers."""
+        try:
+            for leaf in jax.tree_util.tree_leaves((self._params, self._opt_state)):
+                if hasattr(leaf, "is_deleted") and leaf.is_deleted():
+                    return None
+            return jax.device_get((self._params, self._opt_state))
+        except Exception:
+            return None
+
+    def _place_host_state(self, salvage) -> None:
+        """Re-place host (params, opt_state) onto the CURRENT templates —
+        ``self._params``/``self._opt_state`` must already be freshly
+        initialized at the new world size (mirrors ``_restore_checkpoint``)."""
+        host_params, host_opt = salvage
+        host_params = cast_floats(host_params, self.precision_policy.param_dtype)
+        self._params = self.strategy.place_params(host_params)
+        if host_opt is not None and self._opt_state is not None:
+            self._opt_state = jax.tree_util.tree_map(
+                lambda tmpl, h: jax.device_put(h, tmpl.sharding)
+                if hasattr(tmpl, "sharding")
+                else h,
+                self._opt_state,
+                host_opt,
+            )
+
+    def _apply_handoff_payload(self, payload: Dict[str, Any]) -> None:
+        self._place_host_state((payload["params"], payload.get("opt_state")))
+        meta = payload.get("meta") or {}
+        if "epoch" in meta:
+            self.current_epoch = int(meta["epoch"])
+        if "global_step" in meta:
+            self.global_step = int(meta["global_step"])
+        aux = payload.get("aux")
+        if aux is not None:
+            self._restore_aux_state({**aux, **aux.get("user", {})})
+
+    def _apply_resize(self, cmd, train_loader, val_loader, err=None):
+        """Transition this worker to membership epoch ``cmd.epoch``: settle
+        host buffers, contribute/salvage live state, reconnect at the new
+        world size, rebuild mesh + placed templates + compiled steps, and
+        restore state through the best available tier (live handoff >
+        pinned checkpoint). Returns the rebuilt (train_step, val_step)."""
+        from ray_lightning_tpu import session as _session
+        from ray_lightning_tpu.runtime import elastic as _elastic
+
+        agent = self._elastic_agent
+        _t_wall, _t0 = time.time(), time.perf_counter()
+        my_rank = cmd.rank_of(agent.boot_id)
+        if my_rank is None:  # evicted while transitioning: not our group
+            raise _elastic.MembershipChanged(cmd)
+        new_world = cmd.world
+
+        # -- settle host-side buffers while the old backend still exists --
+        try:
+            self._drain_step_logs()
+        except Exception:
+            self._step_log_buffer = []
+        if self._input_prefetcher is not None:
+            try:
+                self._input_stats["starved_s"] += self._input_prefetcher.starved_s
+                self._input_stats["batches"] += self._input_prefetcher.batches
+            except Exception:
+                pass
+            self._input_prefetcher = None
+
+        # -- contribute live state BEFORE disconnecting ---------------------
+        writer = (
+            cmd.handoff_writer is not None and agent.boot_id == cmd.handoff_writer
+        )
+        salvage = None
+        if writer or (cmd.kind == "shrink" and new_world == 1):
+            salvage = self._salvage_live_state()
+        if writer:
+            if salvage is not None:
+                _elastic.write_handoff(
+                    cmd.handoff,
+                    {
+                        "params": salvage[0],
+                        "opt_state": salvage[1],
+                        "meta": {
+                            "epoch": int(self.current_epoch),
+                            "global_step": int(self.global_step),
+                        },
+                        "aux": self.collect_aux_state(),
+                    },
+                )
+            else:
+                # live state was poisoned by the failed step: tell readers
+                # to fall back to the checkpoint tier instead of waiting
+                _elastic.write_handoff_failed(cmd.handoff)
+        _session.emit_heartbeat(self.global_step, force=True)
+
+        # -- rendezvous at the new membership epoch ------------------------
+        with obs.span("elastic/reconnect", epoch=cmd.epoch, world=new_world):
+            cmd = agent.reconnect(cmd)
+            my_rank = cmd.rank_of(agent.boot_id)
+            new_world = cmd.world
+        strategy = self.strategy
+        strategy._set_worker_context(
+            my_rank, new_world, local_rank=0, node_rank=my_rank
+        )
+        strategy._mesh = None
+        strategy.setup_environment()
+        if hasattr(self._module, "mesh"):
+            self._module.mesh = strategy.mesh
+        # the old root key lived on the torn-down backend; recreate it
+        # bitwise-identically from the run seed
+        self._rng_root = jax.random.key(self._seed_used)
+
+        # -- rebuild placed templates exactly as _fit_impl does ------------
+        host_zeros = jax.tree_util.tree_map(
+            lambda s: np.zeros(s.shape, s.dtype), self._param_shape_tree
+        )
+        self._params = strategy.place_params(host_zeros)
+        opt_shapes = jax.eval_shape(self._opt_init_fn, self._params)
+        opt_shardings = strategy.optstate_shardings(opt_shapes)
+        if opt_shardings is None:
+            self._opt_state = jax.jit(self._opt_init_fn)(self._params)
+        else:
+            self._opt_state = jax.jit(
+                self._opt_init_fn, out_shardings=opt_shardings
+            )(self._params)
+        if self._dcn_ctx is not None:
+            self._opt_state = self._stack_ef_residual(self._opt_state)
+
+        # -- state tiers: live handoff > own salvage > pinned checkpoint ---
+        restored = False
+        if cmd.handoff:
+            if writer and salvage is not None:
+                self._place_host_state(salvage)
+                restored = True
+            elif not writer:
+                payload = _elastic.read_handoff(
+                    cmd.handoff, timeout=agent.failure_wait, allow_failed=True
+                )
+                if payload is not None:
+                    self._apply_handoff_payload(payload)
+                    restored = True
+        elif salvage is not None:
+            self._place_host_state(salvage)
+            restored = True
+        if not restored and cmd.restore:
+            self._restore_spec(cmd.restore)
+            restored = True
+        if not restored:
+            raise RuntimeError(
+                f"elastic {cmd.kind} (membership epoch {cmd.epoch}): no live "
+                "state survived and no checkpoint is available to restore from"
+            ) from err
+
+        # -- rebuild compiled steps + reassign data shards -----------------
+        self._first_step_dispatched = False
+        self._resize_sampler(train_loader, my_rank, new_world)
+        self._resize_sampler(val_loader, my_rank, new_world)
+        train_step = self._build_train_step()
+        val_step = self._build_eval_step("val") if val_loader is not None else None
+        self._cb("on_membership_resize")
+        agent.ack(cmd)
+        if self._obs is not None:
+            self._obs.add_span(
+                "elastic/resize",
+                _t_wall,
+                time.perf_counter() - _t0,
+                step=self.global_step,
+            )
+        _session.emit_heartbeat(self.global_step, force=True)
+        return train_step, val_step
+
+    def _resize_sampler(self, loader, rank: int, world: int) -> None:
+        """Reassign a loader's DistributedSampler to the new replica set."""
+        sampler = getattr(loader, "sampler", None) if loader is not None else None
+        if not isinstance(sampler, DistributedSampler):
+            return
+        sampler.num_replicas = world
+        sampler.rank = rank
+        if sampler.drop_last:
+            sampler.num_samples = sampler.data_len // world
+        else:
+            sampler.num_samples = -(-sampler.data_len // world)  # ceil div
 
     def _run_train_epoch(self, train_loader, train_step, val_loader, val_step):
         model = self._module
@@ -1534,6 +1820,18 @@ class Trainer:
         for k, v in ckpt.get("callback_metrics", {}).items():
             self.callback_metrics[k] = np.asarray(v)
         self._module.on_load_checkpoint(ckpt)
+
+    def _restore_spec(self, ckpt_path: str) -> None:
+        """Dispatch a restore spec: ``orbax@<step>:<dir>`` (exact step),
+        ``orbax:<dir>`` (latest committed), or a plain ``.ckpt`` path."""
+        with obs.span("checkpoint/restore", path=ckpt_path):
+            if ckpt_path.startswith("orbax@"):
+                step_s, dirpath = ckpt_path[len("orbax@") :].split(":", 1)
+                self._restore_orbax(dirpath, step=int(step_s))
+            elif ckpt_path.startswith("orbax:"):
+                self._restore_orbax(ckpt_path[len("orbax:") :])
+            else:
+                self._restore_checkpoint(ckpt_path)
 
     def _restore_orbax(self, dirpath: str, step: Optional[int] = None) -> None:
         """Resume from an orbax step (default: latest) onto the CURRENT

@@ -48,7 +48,7 @@ def _drain_queue(queue) -> None:
 
 
 def process_results(
-    futures: List[rt.CallFuture], queue=None, supervisor=None
+    futures: List[rt.CallFuture], queue=None, supervisor=None, controller=None
 ) -> List[Any]:
     """Poll worker futures while draining the tune queue (reference:
     util.py:57-70). Raises a worker error, preferring a PROCESS failure
@@ -61,17 +61,26 @@ def process_results(
     one: each poll round also checks the hang watchdog's verdict
     (``Supervisor.poll`` raises ``WorkerHangError`` once the group has been
     declared hung and torn down), so a deadlocked collective can no longer
-    block the driver forever."""
+    block the driver forever.
+
+    With an elastic ``controller``, a settled process failure is first
+    offered to ``controller.on_future_failure`` — when absorbed (the group
+    shrinks and keeps training) the dead future is simply dropped, and any
+    spare-worker futures the controller spawned join the wait set."""
     remaining = list(futures)
+    tracked = list(futures)  # original order + controller-spawned spares
+    settled: Dict[int, Any] = {}  # id(fut) -> result, successes only
     first_error: Optional[Exception] = None
 
     def check(fut) -> None:
         """Raise immediately on a process failure; record anything else."""
         nonlocal first_error
         try:
-            fut.result()
+            settled[id(fut)] = fut.result()
         except rt.ActorError as e:
             if e.is_process_failure:
+                if controller is not None and controller.on_future_failure(fut, e):
+                    return  # absorbed elastically: group shrank, work goes on
                 raise
             if first_error is None:
                 first_error = e
@@ -79,28 +88,48 @@ def process_results(
             if first_error is None:
                 first_error = e
 
-    while remaining:
-        ready, remaining = rt.wait(remaining, num_returns=1, timeout=0.1)
-        # verdict BEFORE futures: the supervisor records its hang verdict
-        # and THEN kills the group, so by the time a killed worker's future
-        # settles as connection_lost the verdict is guaranteed visible —
-        # polling first reports "hang" instead of a generic process failure
-        if supervisor is not None:
-            supervisor.poll()
-        for fut in ready:
-            check(fut)
-        if first_error is not None:
-            # grace window: let the crashed peer's connection-loss surface
-            # so the failure classifies as retryable
-            deadline = time.monotonic() + 3.0
-            while remaining and time.monotonic() < deadline:
-                ready, remaining = rt.wait(remaining, num_returns=1, timeout=0.2)
-                for fut in ready:
-                    check(fut)
-            raise first_error
-        _drain_queue(queue)
+    while True:
+        while remaining:
+            ready, remaining = rt.wait(remaining, num_returns=1, timeout=0.1)
+            # verdict BEFORE futures: the supervisor records its hang verdict
+            # and THEN kills the group, so by the time a killed worker's
+            # future settles as connection_lost the verdict is guaranteed
+            # visible — polling first reports "hang" instead of a generic
+            # process failure
+            if supervisor is not None:
+                supervisor.poll()
+            for fut in ready:
+                check(fut)
+            if controller is not None:
+                spares = controller.drain_new_futures()
+                if spares:
+                    remaining.extend(spares)
+                    tracked.extend(spares)
+                controller.poll()
+            if first_error is not None:
+                # grace window: let the crashed peer's connection-loss
+                # surface so the failure classifies as retryable
+                deadline = time.monotonic() + 3.0
+                while remaining and time.monotonic() < deadline:
+                    ready, remaining = rt.wait(remaining, num_returns=1, timeout=0.2)
+                    for fut in ready:
+                        check(fut)
+                raise first_error
+            _drain_queue(queue)
+        # a supervisor-thread resize can spawn a spare between our last
+        # drain and the wait set emptying — sweep once more before exiting
+        if controller is None:
+            break
+        controller.poll()
+        spares = controller.drain_new_futures()
+        if not spares:
+            break
+        remaining.extend(spares)
+        tracked.extend(spares)
+    if first_error is not None:
+        raise first_error
     _drain_queue(queue)
-    return [f.result() for f in futures]
+    return [settled[id(f)] for f in tracked if id(f) in settled]
 
 
 def compute_local_ranks(node_ips: List[str]) -> List[Tuple[int, int]]:
@@ -174,6 +203,12 @@ def _wrapping_function(
         node_rank=node_rank if node_rank is not None else global_rank,
     )
 
+    # elastic membership agent: global_rank doubles as the worker's stable
+    # *boot id* (ledger identity); the logical rank may change on resizes
+    from ray_lightning_tpu.runtime import elastic as _elastic
+
+    trainer._elastic_agent = _elastic.worker_agent_from_env(global_rank)
+
     reset_session()
     init_session(
         rank=global_rank,
@@ -196,7 +231,13 @@ def _wrapping_function(
         # a full metrics snapshot — short runs and error exits included
         flush_telemetry(getattr(trainer, "global_step", 0))
 
-    if global_rank != 0:
+    # resizes can reassign logical ranks (a boot-id-1 survivor may end as
+    # rank 0 after a shrink) — result collection follows the FINAL rank
+    try:
+        final_rank = strategy.global_rank
+    except Exception:
+        final_rank = global_rank
+    if final_rank != 0:
         return None
     return _collect_rank_zero_results(trainer, results)
 
@@ -260,6 +301,14 @@ class RayLauncher:
         self._hb_queue = None
         self._aggregator = None  # driver-side telemetry collector
         self._group_killed = False  # set once the supervisor hard-killed us
+        # elastic membership (strategy.elastic): driver-hosted coordination
+        # services + file ledger + resize controller
+        self._coord_host = None
+        self._elastic_dir: Optional[str] = None
+        self._elastic_controller = None
+        self._run_tag = ""
+        self._spare_ctx: Optional[tuple] = None
+        self._launch_t0 = time.time()
 
     def get_local_ranks(self) -> List[Tuple[int, int]]:
         """global_rank -> (node_rank, local_rank) for the current worker set
@@ -292,6 +341,7 @@ class RayLauncher:
         max_failures = getattr(self._strategy, "max_failures", 0)
         attempt = 0
         launch_t0 = time.time()
+        self._launch_t0 = launch_t0  # elastic restore scans share the fence
         if getattr(self._strategy, "telemetry", False):
             obs.enable()  # the driver gets its own track in the merged trace
         if trainer is not None:
@@ -348,14 +398,22 @@ class RayLauncher:
 
         ``not_before`` fences out stale files from a previous run sharing
         the same dirpath — resuming from those would silently skip training.
+
+        ``save_weights_only`` checkpoints are NOT resume candidates: they
+        carry params but no optimizer/callback state, so resuming from one
+        silently restarts momentum and schedules. Those families are
+        skipped outright and the next committed full checkpoint (or orbax
+        step) wins instead — from scratch when none exists.
         """
         candidates = []  # (mtime, resume spec) — families compete on recency
-        weights_only = []  # fallback tier: params but no optimizer/callbacks
+        skipped_weights_only = False
         for cb in trainer.checkpoint_callbacks:
+            if cb.save_weights_only:
+                skipped_weights_only = True
+                continue
             d = cb.dirpath or cb.default_dirpath(trainer)
             if not os.path.isdir(d):
                 continue
-            tier = weights_only if cb.save_weights_only else candidates
             for name in os.listdir(d):
                 if not name.endswith(".ckpt"):
                     continue
@@ -365,7 +423,7 @@ class RayLauncher:
                 except OSError:
                     continue
                 if mtime >= not_before:
-                    tier.append((mtime, path))
+                    candidates.append((mtime, path))
         # orbax checkpoints (sharded/async path): the newest FRESH step is
         # pinned into the spec ("orbax@<step>:<dir>") — restoring "latest"
         # could pick a stale step when the dirpath is reused across runs —
@@ -408,13 +466,12 @@ class RayLauncher:
                     break
         if candidates:
             return max(candidates)[1]
-        if weights_only:
+        if skipped_weights_only:
             rank_zero_info(
-                "relaunch is resuming from a save_weights_only checkpoint: "
-                "params are restored but the optimizer state and callback "
-                "states restart fresh"
+                "relaunch found only save_weights_only checkpoints; those "
+                "lack optimizer/callback state and are skipped — restarting "
+                "from scratch"
             )
-            return max(weights_only)[1]
         return None
 
     # ------------------------------------------------------------------ #
@@ -453,6 +510,24 @@ class RayLauncher:
         if not rt.is_initialized():
             rt.init()
 
+        elastic_enabled = bool(getattr(strategy, "elastic", False)) and n > 1
+        self._coord_host = None
+        self._elastic_dir = None
+        if elastic_enabled:
+            import tempfile
+
+            from ray_lightning_tpu.runtime import elastic as elastic_mod
+
+            # fresh ledger per worker-group bring-up: a full relaunch must
+            # not replay a previous attempt's membership epochs. A user-set
+            # RLT_ELASTIC_DIR (shared FS for multi-host) becomes the parent.
+            base = os.environ.get(elastic_mod.ELASTIC_DIR_ENV)
+            self._elastic_dir = tempfile.mkdtemp(
+                prefix="rlt-elastic-", dir=base or None
+            )
+            env[elastic_mod.ELASTIC_DIR_ENV] = self._elastic_dir
+            env[elastic_mod.ELASTIC_ENV] = "1"
+
         demands = [self._worker_demand() for _ in range(n)]
         # one worker per TPU host is the design stance (SURVEY §7); with
         # several nodes attached, spread workers across them
@@ -487,6 +562,7 @@ class RayLauncher:
         import secrets as _secrets
 
         run_tag = _secrets.token_hex(3)
+        self._run_tag = run_tag
         with obs.span("boot/spawn_workers", workers=n):
             self._workers = rt.create_actors(
                 specs,
@@ -515,17 +591,37 @@ class RayLauncher:
 
         if n > 1:
             with obs.span("boot/init_distributed", workers=n):
-                # coordinator = worker-0 IP + free port (reference :85-87)
-                ip = rt.get(self._workers[0].get_node_ip.remote())
-                port = rt.get(self._workers[0].find_free_port.remote())
-                coordinator = f"{ip}:{port}"
-                rank_zero_info("rlt coordinator at %s", coordinator)
-                counts = rt.get(
-                    [
-                        w.init_distributed.remote(coordinator, n, i)
-                        for i, w in enumerate(self._workers)
-                    ]
-                )
+                if elastic_enabled:
+                    # the DRIVER hosts the coordination service so the
+                    # rendezvous outlives any worker: a resize stands up a
+                    # fresh service (new port) and superseded ones stay in
+                    # the graveyard until every worker is dead
+                    from ray_lightning_tpu.runtime import elastic as elastic_mod
+                    from ray_lightning_tpu.utils.ports import node_ip_address
+
+                    self._coord_host = elastic_mod.CoordinationHost(
+                        node_ip_address()
+                    )
+                    coordinator = self._coord_host.new_address(n)
+                    rank_zero_info("rlt elastic coordinator at %s", coordinator)
+                    counts = rt.get(
+                        [
+                            w.init_elastic_distributed.remote(coordinator, n, i)
+                            for i, w in enumerate(self._workers)
+                        ]
+                    )
+                else:
+                    # coordinator = worker-0 IP + free port (reference :85-87)
+                    ip = rt.get(self._workers[0].get_node_ip.remote())
+                    port = rt.get(self._workers[0].find_free_port.remote())
+                    coordinator = f"{ip}:{port}"
+                    rank_zero_info("rlt coordinator at %s", coordinator)
+                    counts = rt.get(
+                        [
+                            w.init_distributed.remote(coordinator, n, i)
+                            for i, w in enumerate(self._workers)
+                        ]
+                    )
                 if len(set(counts)) != 1:
                     raise RuntimeError(
                         f"workers disagree on device count: {counts}"
@@ -580,8 +676,11 @@ class RayLauncher:
 
         queue_handle = self._tune_queue.handle() if self._tune_queue else None
         hb_handle = self._hb_queue.handle() if self._hb_queue else None
+        heartbeat_interval = getattr(self._strategy, "heartbeat_interval", 1.0)
         aggregator = self._make_aggregator(trainer, fn_name)
         supervisor = self._make_supervisor(aggregator)
+        self._spare_ctx = (payload_ref, queue_handle, hb_handle, heartbeat_interval)
+        controller = self._make_elastic_controller(trainer, aggregator, supervisor)
         try:
             futures = [
                 w.execute.remote(
@@ -593,12 +692,18 @@ class RayLauncher:
                     self._worker_ranks[rank][1] if self._worker_ranks else 0,
                     self._worker_ranks[rank][0] if self._worker_ranks else rank,
                     hb_handle,
-                    getattr(self._strategy, "heartbeat_interval", 1.0),
+                    heartbeat_interval,
                 )
                 for rank, w in enumerate(self._workers)
             ]
-            results = process_results(futures, self._tune_queue, supervisor)
+            if controller is not None:
+                for rank, fut in enumerate(futures):
+                    controller.register_future(fut, rank)
+            results = process_results(
+                futures, self._tune_queue, supervisor, controller
+            )
         finally:
+            self._spare_ctx = None
             if supervisor is not None:
                 supervisor.stop()
                 # the final forced beats (flush_telemetry) may still sit in
@@ -672,6 +777,90 @@ class RayLauncher:
         supervisor.start()
         return supervisor
 
+    def _make_elastic_controller(self, trainer, aggregator, supervisor):
+        """Driver-side resize controller; only with ``strategy.elastic`` and
+        a live coordination host (multi-worker group)."""
+        if self._coord_host is None or self._elastic_dir is None:
+            return None
+        from ray_lightning_tpu.runtime import elastic
+
+        strategy = self._strategy
+        controller = elastic.ElasticController(
+            ledger=elastic.MembershipLedger(self._elastic_dir),
+            host=self._coord_host,
+            num_workers=strategy.num_workers,
+            min_workers=getattr(strategy, "min_workers", 1),
+            kill_worker=self._kill_worker,
+            spawn_worker=self._spawn_spare,
+            find_restore=lambda: (
+                self._find_relaunch_checkpoint(trainer, self._launch_t0)
+                if trainer is not None
+                else None
+            ),
+            aggregator=aggregator,
+        )
+        controller.supervisor = supervisor
+        if supervisor is not None:
+            # hang verdicts become per-rank shrinks instead of group trips
+            supervisor.on_hung = controller.on_hung
+        self._elastic_controller = controller
+        controller._publish()  # seed the world-size gauge pre-resize
+        return controller
+
+    def _kill_worker(self, boot_id: int) -> None:
+        """Hard-kill one worker actor (elastic shrink eviction)."""
+        try:
+            w = self._workers[boot_id]
+        except IndexError:
+            return
+        try:
+            rt.kill(w, force=True, timeout=2.0)
+        except Exception:
+            pass
+
+    def _spawn_spare(self, boot_id: int, world_hint: int):
+        """Spawn a warm spare (zygote pre-fork path of ``rt.create_actors``)
+        that will join the group at the next membership epoch. Returns its
+        execute future; the joiner blocks inside the trainer's join path
+        until a grow command names its boot id."""
+        strategy = self._strategy
+        payload_ref, queue_handle, hb_handle, heartbeat_interval = self._spare_ctx
+        from ray_lightning_tpu.runtime import elastic as elastic_mod
+
+        env = dict(strategy.worker_env())
+        env[elastic_mod.ELASTIC_DIR_ENV] = self._elastic_dir
+        env[elastic_mod.ELASTIC_ENV] = "1"
+        per_env = {
+            "RLT_GLOBAL_RANK": str(boot_id),
+            elastic_mod.ELASTIC_JOINER_ENV: "1",
+        }
+        seed = os.environ.get(GLOBAL_SEED_ENV)
+        if seed is not None:
+            per_env[GLOBAL_SEED_ENV] = seed
+        with obs.span("elastic/spawn_spare", boot_id=boot_id):
+            [w] = rt.create_actors(
+                [(RayExecutor, (), {})],
+                names=[f"rlt-worker-{boot_id}-{os.getpid()}-{self._run_tag}"],
+                env=env,
+                per_actor_env=[per_env],
+                demands=[self._worker_demand()],
+            )
+        # self._workers is indexed by boot id: spares get monotonically
+        # increasing ids, so appending preserves the invariant
+        self._workers.append(w)
+        self._worker_ranks.append((0, 0))
+        return w.execute.remote(
+            _wrapping_function,
+            boot_id,
+            world_hint,
+            payload_ref,
+            queue_handle,
+            0,
+            boot_id,
+            hb_handle,
+            heartbeat_interval,
+        )
+
     def _worker_alive(self, rank: int) -> bool:
         """Best-effort liveness probe: only decisive for local workers whose
         pid we can signal-0; remote workers default to alive so an aged-out
@@ -740,3 +929,9 @@ class RayLauncher:
             rt.kill(w, force=self._group_killed)
         self._workers = []
         self._group_killed = False
+        if self._coord_host is not None:
+            # safe only now: every client that pointed at our services died
+            # with its worker above
+            self._coord_host.shutdown()
+            self._coord_host = None
+        self._elastic_controller = None

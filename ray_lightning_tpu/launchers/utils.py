@@ -34,6 +34,7 @@ class RayExecutor:
 
     def __init__(self):
         self._distributed_initialized = False
+        self._elastic_connected = False
 
     def set_env_var(self, key: str, value: str) -> None:
         os.environ[key] = value
@@ -90,6 +91,22 @@ class RayExecutor:
             self._distributed_initialized = True
         return jax.device_count()
 
+    def init_elastic_distributed(
+        self, coordinator: str, num_processes: int, process_id: int
+    ) -> int:
+        """Elastic variant of :meth:`init_distributed`: joins the driver-
+        hosted coordination service through ``runtime/elastic.py`` so the
+        process can later disconnect and rejoin a *different* rendezvous
+        (new service, new world size) without being restarted."""
+        import jax
+
+        from ray_lightning_tpu.runtime import elastic
+
+        if num_processes > 1 and not self._elastic_connected:
+            elastic.elastic_connect(coordinator, num_processes, process_id)
+            self._elastic_connected = True
+        return jax.device_count()
+
     def psum_smoke_test(self) -> float:
         """1-element all-reduce over every device: proves the collective
         plane is up before training starts."""
@@ -121,6 +138,15 @@ class RayExecutor:
         return fn(*args, **kwargs)
 
     def shutdown_distributed(self) -> None:
+        if self._elastic_connected:
+            # never jax.distributed.shutdown() here: a clean shutdown
+            # barriers against peers that may already be dead — graveyard
+            # the client instead and let process exit reap the sockets
+            from ray_lightning_tpu.runtime import elastic
+
+            elastic.elastic_disconnect()
+            self._elastic_connected = False
+            return
         import jax
 
         if self._distributed_initialized:

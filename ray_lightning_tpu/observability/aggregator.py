@@ -111,6 +111,7 @@ class DriverAggregator:
         self._last_beat: Dict[Any, float] = {}
         self._rank_gauges: Dict[Any, Dict[str, float]] = {}
         self._events_fh = None
+        self._elastic: Optional[Dict[str, Any]] = None
         self._summary_interval = float(summary_interval)
         self._summary_written = 0.0
         self._finalized = False
@@ -168,6 +169,34 @@ class DriverAggregator:
     def heartbeat_age(self, rank: int, age: float) -> None:
         """Supervisor-reported time since a rank's last beat."""
         self.registry.gauge("rlt_heartbeat_age_seconds", rank=rank).set(age)
+
+    def set_elastic(
+        self,
+        world_size: int,
+        membership_epoch: int,
+        shrinks: int = 0,
+        grows: int = 0,
+        recovery_s: Optional[float] = None,
+    ) -> None:
+        """Elastic membership controller state: current world size, the
+        membership epoch counter, cumulative resize counts, and (when a
+        resize just completed) its wall-clock recovery time."""
+        self._elastic = {
+            "world_size": int(world_size),
+            "membership_epoch": int(membership_epoch),
+            "shrinks": int(shrinks),
+            "grows": int(grows),
+        }
+        if recovery_s is not None:
+            self._elastic["last_recovery_s"] = round(float(recovery_s), 3)
+        reg = self.registry
+        reg.gauge("rlt_elastic_world_size").set(world_size)
+        reg.gauge("rlt_elastic_membership_epoch").set(membership_epoch)
+        # counters carry cumulative totals from the controller: latest-wins
+        reg.counter("rlt_elastic_resizes_total", kind="shrink").value = float(shrinks)
+        reg.counter("rlt_elastic_resizes_total", kind="grow").value = float(grows)
+        if recovery_s is not None:
+            reg.histogram("rlt_elastic_recovery_seconds").observe(recovery_s)
 
     def record_event(self, kind: str, **fields) -> None:
         """Append one line to the JSONL flight record (always on) and
@@ -251,13 +280,16 @@ class DriverAggregator:
         if steps:
             cluster["steps_min"] = min(steps)
             cluster["steps_max"] = max(steps)
-        return {
+        out = {
             "ts": now,
             "num_workers": self.num_workers,
             "telemetry": self.full,
             "per_rank": per_rank,
             "cluster": cluster,
         }
+        if self._elastic is not None:
+            out["elastic"] = dict(self._elastic)
+        return out
 
     # ----------------------------------------------------------------- #
     # outputs
@@ -369,6 +401,17 @@ def format_summary(summary: Dict[str, Any], events: List[dict]) -> str:
             cl_bits.append(fmt.format(cl[key]))
     if cl_bits:
         lines.append("cluster: " + " · ".join(cl_bits))
+    el = summary.get("elastic")
+    if el:
+        el_bits = [
+            f"world {el.get('world_size', '?')}",
+            f"epoch {el.get('membership_epoch', '?')}",
+            f"shrinks {el.get('shrinks', 0)}",
+            f"grows {el.get('grows', 0)}",
+        ]
+        if "last_recovery_s" in el:
+            el_bits.append(f"last recovery {el['last_recovery_s']:.1f}s")
+        lines.append("elastic: " + " · ".join(el_bits))
     header = f"{'rank':>5} {'step':>8} {'p50(s)':>9} {'p90(s)':>9} " \
              f"{'sps':>9} {'mfu':>7} {'starve(s)':>9} {'beat age':>9} " \
              f"{'skew(s)':>9}"

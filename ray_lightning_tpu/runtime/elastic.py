@@ -1,0 +1,828 @@
+"""Elastic membership: shrink/grow the worker group without a full relaunch.
+
+On a Supervisor crash/hang verdict the driver keeps the surviving actors,
+bumps a *membership epoch*, stands up a fresh coordination service, and
+broadcasts a :class:`ResizeCommand` through a file-based
+:class:`MembershipLedger` (the repo already assumes a shared filesystem for
+checkpoints).  Survivors tear down their distributed client, reconnect at the
+new world size, rebuild the mesh/shardings, and resume mid-epoch.  A warm
+spare (pre-forked through the zygote path of ``rt.create_actors``) is
+announced as a ``grow`` command applied at the next epoch boundary, where the
+survivors hand it a snapshot of live state.
+
+Hard-won rules for elastic ``jax.distributed`` (validated against jaxlib's
+coordination service on CPU+gloo):
+
+* Never destroy an old service or client mid-run.  A live client whose
+  service socket closes is *fatally terminated* from a background thread, so
+  superseded clients/services go to a module-level graveyard and die with the
+  process.
+* Never install a Python ``missed_heartbeat_callback`` (pybind ``bad_cast``
+  crash); instead disable heartbeat-based death detection entirely
+  (``max_missing_heartbeats`` huge) — liveness is the Supervisor's job.
+* The driver hosts the coordination service: one fresh service on a fresh
+  port per membership epoch; workers are pure clients.
+* A gloo collective against a dead peer fails fast with a catchable error
+  and leaves the survivor healthy — that failure is the worker-side resize
+  trigger (see :func:`is_collective_failure`).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import threading
+import time
+from dataclasses import dataclass, field, asdict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+ELASTIC_ENV = "RLT_ELASTIC"
+ELASTIC_DIR_ENV = "RLT_ELASTIC_DIR"
+ELASTIC_JOINER_ENV = "RLT_ELASTIC_JOINER"
+MIN_WORKERS_ENV = "RLT_MIN_WORKERS"
+
+# How long a survivor waits for a shrink command after a collective failure
+# before giving up and re-raising the original error (-> full relaunch path).
+RESIZE_WAIT_ENV = "RLT_ELASTIC_WAIT"
+_DEFAULT_RESIZE_WAIT = 60.0
+
+# How long a joiner waits to be named in a grow command.
+JOIN_TIMEOUT_ENV = "RLT_ELASTIC_JOIN_TIMEOUT"
+_DEFAULT_JOIN_TIMEOUT = 300.0
+
+# Driver-side wait for per-worker acks after announcing a command.
+ACK_TIMEOUT_ENV = "RLT_ELASTIC_ACK_TIMEOUT"
+_DEFAULT_ACK_TIMEOUT = 120.0
+
+# Barrier timeout for the reconnect rendezvous (client init_timeout).
+CONNECT_TIMEOUT_ENV = "RLT_ELASTIC_CONNECT_TIMEOUT"
+_DEFAULT_CONNECT_TIMEOUT = 120.0
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+class MembershipChanged(Exception):
+    """Raised inside the training loop when a resize must be applied *now*."""
+
+    def __init__(self, cmd: "ResizeCommand"):
+        super().__init__(f"membership epoch {cmd.epoch}: {cmd.kind} -> world {cmd.world}")
+        self.cmd = cmd
+
+
+_COLLECTIVE_FAILURE_MARKERS = (
+    "gloo",
+    "all-reduce failed",
+    "allreduce",
+    "all-gather failed",
+    "collective",
+    "connection reset",
+    "connection refused",
+    "broken pipe",
+    "socket closed",
+    "peer closed",
+    "unavailable",
+    "deadline exceeded",
+    "coordination service",
+    "distributed runtime",
+    "heartbeat",
+)
+
+
+def is_collective_failure(exc: BaseException) -> bool:
+    """True if ``exc`` looks like a peer-death / distributed-runtime failure.
+
+    gloo surfaces a dead peer as a fast ``ValueError`` whose text names the
+    transport; the XLA coordination service surfaces RPC errors with grpc
+    status words.  Matching on text is crude but these errors cross a pybind
+    boundary and carry no structured type.
+    """
+
+    text = str(exc).lower()
+    return any(marker in text for marker in _COLLECTIVE_FAILURE_MARKERS)
+
+
+# ---------------------------------------------------------------------------
+# Low-level distributed plumbing (graveyards, connect/disconnect)
+# ---------------------------------------------------------------------------
+
+# Superseded clients/services are parked here so their sockets stay open
+# until process exit.  Destroying either side early fatally terminates any
+# peer still holding a reference to the old runtime.
+_CLIENT_GRAVEYARD: List[Any] = []
+_SERVICE_GRAVEYARD: List[Any] = []
+
+# Disable heartbeat-based death detection: liveness belongs to the
+# Supervisor, and the coordination service's own detector kills survivors.
+_HEARTBEAT_INTERVAL_S = 10
+_MAX_MISSING_HEARTBEATS = 10**6
+
+
+def _xla_extension():
+    from jax._src.lib import xla_extension as xe  # type: ignore
+
+    return xe
+
+
+def _global_state():
+    from jax._src import distributed as jdist  # type: ignore
+
+    return jdist.global_state
+
+
+def _configure_cpu_collectives() -> None:
+    import jax
+
+    try:
+        if jax.default_backend() in ("cpu",) or os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - config name varies across versions
+        logger.debug("could not configure gloo collectives", exc_info=True)
+
+
+def _clear_backends() -> None:
+    import jax
+
+    jax.clear_caches()
+    try:
+        import jax.extend.backend as jeb
+
+        jeb.clear_backends()
+    except Exception:  # pragma: no cover - fallback for older jax
+        try:
+            jax.clear_backends()  # type: ignore[attr-defined]
+        except Exception:
+            logger.debug("clear_backends unavailable", exc_info=True)
+
+
+def start_service(address: str, num_processes: int) -> Any:
+    """Start a coordination service bound to ``address`` (``ip:port``)."""
+
+    xe = _xla_extension()
+    return xe.get_distributed_runtime_service(
+        address,
+        num_processes,
+        heartbeat_interval=_HEARTBEAT_INTERVAL_S,
+        max_missing_heartbeats=_MAX_MISSING_HEARTBEATS,
+    )
+
+
+def elastic_connect(coordinator: str, num_processes: int, process_id: int,
+                    init_timeout: Optional[float] = None) -> None:
+    """Connect this process to ``coordinator`` and install the client into
+    jax's distributed global state, then flush caches/backends so the next
+    backend build sees the new world."""
+
+    import jax
+
+    if init_timeout is None:
+        init_timeout = _env_float(CONNECT_TIMEOUT_ENV, _DEFAULT_CONNECT_TIMEOUT)
+    _configure_cpu_collectives()
+    xe = _xla_extension()
+    client = xe.get_distributed_runtime_client(
+        coordinator,
+        process_id,
+        rpc_timeout=10,
+        init_timeout=int(max(1, init_timeout)),
+        shutdown_timeout=5,
+        heartbeat_interval=_HEARTBEAT_INTERVAL_S,
+        max_missing_heartbeats=_MAX_MISSING_HEARTBEATS,
+        shutdown_on_destruction=False,
+    )
+    client.connect()
+    st = _global_state()
+    if st.client is not None:
+        _CLIENT_GRAVEYARD.append(st.client)
+    st.service = None
+    st.client = client
+    st.coordinator_address = coordinator
+    st.process_id = process_id
+    st.num_processes = num_processes
+    # multihost consumers (orbax's should_save, among others) consult the
+    # preemption sync manager whenever a client exists — rebind it to the
+    # new client, graveyarding the old one with its client
+    try:
+        if getattr(st, "preemption_sync_manager", None) is not None:
+            _CLIENT_GRAVEYARD.append(st.preemption_sync_manager)
+        psm = xe.create_preemption_sync_manager()
+        psm.initialize(client)
+        st.preemption_sync_manager = psm
+    except Exception:  # pragma: no cover - absent in exotic jaxlibs
+        logger.debug("preemption sync manager unavailable", exc_info=True)
+    _clear_backends()
+    del jax  # only imported for its side-effectful config above
+
+
+def elastic_disconnect() -> None:
+    """Graveyard the current client (never shut it down — a clean shutdown
+    barriers against peers that may be dead) and clear backends."""
+
+    st = _global_state()
+    if st.client is not None:
+        _CLIENT_GRAVEYARD.append(st.client)
+    if getattr(st, "preemption_sync_manager", None) is not None:
+        _CLIENT_GRAVEYARD.append(st.preemption_sync_manager)
+        st.preemption_sync_manager = None
+    st.client = None
+    st.coordinator_address = None
+    _clear_backends()
+
+
+def is_elastic_connected() -> bool:
+    try:
+        return _global_state().client is not None
+    except Exception:
+        return False
+
+
+class CoordinationHost:
+    """Driver-side owner of coordination services: a fresh service on a fresh
+    port per membership epoch; superseded services are kept alive in the
+    graveyard until :meth:`shutdown` (i.e. after every worker is dead)."""
+
+    def __init__(self, host_ip: str):
+        self._host_ip = host_ip
+        self._service: Any = None
+
+    def new_address(self, num_processes: int) -> str:
+        from ray_lightning_tpu.utils.ports import find_free_port
+
+        port = find_free_port()
+        address = f"{self._host_ip}:{port}"
+        service = start_service(address, num_processes)
+        if self._service is not None:
+            _SERVICE_GRAVEYARD.append(self._service)
+        self._service = service
+        return address
+
+    def shutdown(self) -> None:
+        # Only safe once every client that ever pointed at any of our
+        # services is gone (workers killed).  Drop references and let the
+        # interpreter reap them.
+        if self._service is not None:
+            _SERVICE_GRAVEYARD.append(self._service)
+            self._service = None
+
+
+# ---------------------------------------------------------------------------
+# Resize commands + file ledger
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ResizeCommand:
+    """One membership transition, broadcast driver -> workers via the ledger.
+
+    ``members`` lists surviving *boot ids* (the rank a worker was spawned
+    with — its stable actor identity) in new-logical-rank order: a worker's
+    post-resize rank is ``members.index(boot_id)``.
+    """
+
+    epoch: int
+    kind: str  # "shrink" | "grow"
+    members: Tuple[int, ...]
+    coordinator: str
+    apply: str = "now"  # "now" | "epoch_end"
+    restore: Optional[str] = None  # relaunch-checkpoint spec (driver-pinned)
+    handoff: Optional[str] = None  # path survivors use to exchange live state
+    handoff_writer: Optional[int] = None  # boot id that writes the handoff
+    failed: Tuple[int, ...] = ()
+    reason: str = ""
+    ts: float = field(default_factory=time.time)
+
+    @property
+    def world(self) -> int:
+        return len(self.members)
+
+    def rank_of(self, boot_id: int) -> Optional[int]:
+        try:
+            return self.members.index(boot_id)
+        except ValueError:
+            return None
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @staticmethod
+    def from_json(raw: str) -> "ResizeCommand":
+        data = json.loads(raw)
+        data["members"] = tuple(data.get("members") or ())
+        data["failed"] = tuple(data.get("failed") or ())
+        return ResizeCommand(**data)
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class MembershipLedger:
+    """Append-only command log + ack files on a shared filesystem.
+
+    Commands are ``epoch_%06d.json`` written atomically (tmp + rename), so a
+    reader either sees a complete command or nothing.  Polling for the next
+    epoch is a single ``os.path.exists`` — cheap enough for the per-step
+    health tick.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    # -- commands ----------------------------------------------------------
+    def _cmd_path(self, epoch: int) -> str:
+        return os.path.join(self.root, f"epoch_{epoch:06d}.json")
+
+    def announce(self, cmd: ResizeCommand) -> None:
+        _atomic_write(self._cmd_path(cmd.epoch), cmd.to_json().encode("utf-8"))
+
+    def read(self, epoch: int) -> Optional[ResizeCommand]:
+        path = self._cmd_path(epoch)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return ResizeCommand.from_json(f.read())
+        except FileNotFoundError:
+            return None
+        except (ValueError, TypeError):  # pragma: no cover - defensive
+            logger.warning("unreadable ledger entry: %s", path)
+            return None
+
+    def has(self, epoch: int) -> bool:
+        return os.path.exists(self._cmd_path(epoch))
+
+    # -- acks --------------------------------------------------------------
+    def _ack_path(self, epoch: int, boot_id: int) -> str:
+        return os.path.join(self.root, f"ack_{epoch:06d}_b{boot_id}.json")
+
+    def ack(self, epoch: int, boot_id: int) -> None:
+        _atomic_write(
+            self._ack_path(epoch, boot_id),
+            json.dumps({"ts": time.time(), "pid": os.getpid()}).encode("utf-8"),
+        )
+
+    def acks_present(self, epoch: int, boot_ids: Sequence[int]) -> bool:
+        return all(os.path.exists(self._ack_path(epoch, b)) for b in boot_ids)
+
+    def wait_acks(self, epoch: int, boot_ids: Sequence[int], timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.acks_present(epoch, boot_ids):
+                return True
+            time.sleep(0.05)
+        return self.acks_present(epoch, boot_ids)
+
+    # -- handoff -----------------------------------------------------------
+    def handoff_path(self, epoch: int) -> str:
+        return os.path.join(self.root, f"handoff_{epoch:06d}.pkl")
+
+
+def write_handoff(path: str, payload: Dict[str, Any]) -> None:
+    _atomic_write(path, pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL))
+
+
+def write_handoff_failed(path: str) -> None:
+    _atomic_write(path + ".failed", b"{}")
+
+
+def read_handoff(path: str, timeout: float, allow_failed: bool = False) -> Optional[Dict[str, Any]]:
+    """Wait for a handoff file (or, when ``allow_failed``, its failure
+    marker — returning ``None`` so the caller falls back to a checkpoint)."""
+
+    deadline = time.monotonic() + timeout
+    while True:
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        if allow_failed and os.path.exists(path + ".failed"):
+            return None
+        if time.monotonic() >= deadline:
+            raise TimeoutError(f"timed out waiting for elastic handoff at {path}")
+        time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# Worker-side agent
+# ---------------------------------------------------------------------------
+
+
+class ElasticWorkerAgent:
+    """Worker-side view of the membership ledger.
+
+    The agent scans forward through announced commands; when several pile up
+    (e.g. a grow superseded by another failure's shrink) the *latest* one
+    wins and intermediates are skipped — every command carries the full
+    member list, so they don't compose.
+    """
+
+    def __init__(self, ledger_dir: str, boot_id: int, joiner: bool = False):
+        self.ledger = MembershipLedger(ledger_dir)
+        self.boot_id = boot_id
+        self.is_joiner = joiner
+        self.epoch = 0  # last *applied* membership epoch
+        self._seen = 0  # last *scanned* ledger epoch
+        self._pending: Optional[ResizeCommand] = None
+        self.pending_handoff_cmd: Optional[ResizeCommand] = None
+        self.failure_wait = _env_float(RESIZE_WAIT_ENV, _DEFAULT_RESIZE_WAIT)
+        self.join_timeout = _env_float(JOIN_TIMEOUT_ENV, _DEFAULT_JOIN_TIMEOUT)
+
+    # -- scanning ----------------------------------------------------------
+    def _advance(self) -> Optional[ResizeCommand]:
+        """Scan newly-announced commands; stash and return the latest."""
+
+        latest = None
+        while self.ledger.has(self._seen + 1):
+            cmd = self.ledger.read(self._seen + 1)
+            if cmd is None:  # pragma: no cover - half-visible write
+                break
+            self._seen += 1
+            latest = cmd
+        if latest is not None:
+            self._pending = latest
+        return latest
+
+    def poll_now(self) -> Optional[ResizeCommand]:
+        """Return a command that must be applied immediately, if any."""
+
+        self._advance()
+        cmd = self._pending
+        if cmd is not None and cmd.apply == "now":
+            self._pending = None
+            return cmd
+        return None
+
+    def poll_epoch_end(self) -> Optional[ResizeCommand]:
+        """Return any pending command at an epoch boundary (boundaries may
+        also apply 'now' commands that raced the end of the epoch)."""
+
+        self._advance()
+        cmd, self._pending = self._pending, None
+        return cmd
+
+    def wait_for_resize(self, timeout: Optional[float] = None) -> Optional[ResizeCommand]:
+        """After a collective failure: wait for the driver's shrink verdict."""
+
+        if timeout is None:
+            timeout = self.failure_wait
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            cmd = self.poll_now()
+            if cmd is not None:
+                return cmd
+            time.sleep(0.05)
+        return self.poll_now()
+
+    def wait_for_join(self, timeout: Optional[float] = None) -> ResizeCommand:
+        """Joiner path: wait until the latest command names our boot id."""
+
+        if timeout is None:
+            timeout = self.join_timeout
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self._advance()
+            cmd = self._pending
+            if cmd is not None and self.boot_id in cmd.members:
+                self._pending = None
+                return cmd
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"worker boot_id={self.boot_id} was never admitted to the group"
+        )
+
+    # -- connection --------------------------------------------------------
+    def connect(self, cmd: ResizeCommand) -> ResizeCommand:
+        """Join the rendezvous for ``cmd``; if it fails and a *newer* command
+        (fresh service) has appeared, retry against that one instead.
+
+        Never retries against the same service: a half-registered rank
+        reconnecting to the same coordination service trips "different
+        incarnation" errors.  Returns the command actually applied.
+        """
+
+        deadline = time.monotonic() + self.join_timeout
+        while True:
+            rank = cmd.rank_of(self.boot_id)
+            if rank is None:
+                raise MembershipChanged(cmd)  # evicted while transitioning
+            try:
+                elastic_connect(cmd.coordinator, cmd.world, rank)
+            except Exception as exc:
+                elastic_disconnect()
+                newer = self._await_newer(cmd, deadline)
+                if newer is None:
+                    raise
+                logger.warning(
+                    "elastic connect to epoch %d failed (%s); retrying at epoch %d",
+                    cmd.epoch, exc, newer.epoch,
+                )
+                cmd = newer
+                continue
+            self.epoch = cmd.epoch
+            self.pending_handoff_cmd = cmd if cmd.handoff else None
+            return cmd
+
+    def _await_newer(self, cmd: ResizeCommand, deadline: float) -> Optional[ResizeCommand]:
+        while time.monotonic() < deadline:
+            latest = self._advance() or self._pending
+            if latest is not None and latest.epoch > cmd.epoch:
+                self._pending = None
+                return latest
+            time.sleep(0.1)
+        return None
+
+    def reconnect(self, cmd: ResizeCommand) -> ResizeCommand:
+        elastic_disconnect()
+        return self.connect(cmd)
+
+    def ack(self, cmd: ResizeCommand) -> None:
+        self.ledger.ack(cmd.epoch, self.boot_id)
+
+
+def worker_agent_from_env(boot_id: Optional[int] = None) -> Optional[ElasticWorkerAgent]:
+    """Build the worker-side agent from env, or None when not elastic."""
+
+    ledger_dir = os.environ.get(ELASTIC_DIR_ENV)
+    if not ledger_dir:
+        return None
+    if boot_id is None:
+        boot_id = int(os.environ.get("RLT_GLOBAL_RANK", "0"))
+    joiner = os.environ.get(ELASTIC_JOINER_ENV) == "1"
+    return ElasticWorkerAgent(ledger_dir, boot_id, joiner=joiner)
+
+
+# ---------------------------------------------------------------------------
+# Driver-side controller
+# ---------------------------------------------------------------------------
+
+
+class ElasticController:
+    """Driver-side membership controller.
+
+    Sits between the Supervisor / future-polling loop and the launcher: on a
+    worker death it evicts the dead boot id, announces a shrink applied
+    *now*, then (optionally) spawns a warm spare and announces a grow applied
+    at the next epoch boundary.  Falls back (returns ``False``) when the
+    survivor count would drop below ``min_workers`` — the caller then runs
+    the pre-existing full-group relaunch.
+    """
+
+    def __init__(
+        self,
+        ledger: MembershipLedger,
+        host: CoordinationHost,
+        num_workers: int,
+        min_workers: int,
+        kill_worker: Callable[[int], None],
+        spawn_worker: Callable[[int, int], Any],
+        find_restore: Callable[[], Optional[str]],
+        aggregator: Any = None,
+        readmit: bool = True,
+    ):
+        self.ledger = ledger
+        self.host = host
+        self.min_workers = max(1, int(min_workers))
+        self._kill_worker = kill_worker
+        self._spawn_worker = spawn_worker
+        self._find_restore = find_restore
+        self._aggregator = aggregator
+        self._readmit = readmit
+        self.supervisor: Any = None  # wired by the launcher after creation
+
+        self._lock = threading.Lock()
+        self.members: List[int] = list(range(num_workers))
+        self.epoch = 0
+        self._next_boot_id = num_workers
+        self._fut_owner: Dict[int, int] = {}
+        self._new_futures: List[Any] = []
+        self._grow_pending: Optional[Tuple[int, Tuple[int, ...], float]] = None
+        self._unacked: Dict[int, Tuple[int, ...]] = {}  # epoch -> boot ids
+        self.resizes = {"shrink": 0, "grow": 0}
+        self.last_recovery_s: Optional[float] = None
+        self.ack_timeout = _env_float(ACK_TIMEOUT_ENV, _DEFAULT_ACK_TIMEOUT)
+
+    # -- wiring ------------------------------------------------------------
+    def register_future(self, fut: Any, boot_id: int) -> None:
+        self._fut_owner[id(fut)] = boot_id
+
+    def drain_new_futures(self) -> List[Any]:
+        out, self._new_futures = self._new_futures, []
+        return out
+
+    @property
+    def world_size(self) -> int:
+        return len(self.members)
+
+    # -- failure entry points ---------------------------------------------
+    def on_future_failure(self, fut: Any, err: BaseException) -> bool:
+        """A worker future settled with a process failure.  Returns True if
+        absorbed elastically (caller drops the future), False to fall back
+        to the full-relaunch path."""
+
+        boot_id = self._fut_owner.get(id(fut))
+        if boot_id is None:
+            return False
+        return self.handle_failure(boot_id, f"process failure: {err}")
+
+    def on_hung(self, ranks: Sequence[int]) -> bool:
+        """Supervisor hang verdict for ``ranks`` (boot ids).  Kills each hung
+        actor and shrinks around it.  Ranks that are mid-transition (an
+        announced command they haven't acked yet — reconnect barriers and
+        post-resize recompiles look like hangs) are skipped: the supervisor
+        forgets them and re-arms on their next heartbeat."""
+
+        ok = True
+        for boot_id in list(ranks):
+            if self._in_transition(boot_id):
+                logger.info(
+                    "elastic: rank %d looks hung but is mid-transition; deferring",
+                    boot_id,
+                )
+                continue
+            try:
+                self._kill_worker(boot_id)
+            except Exception:  # pragma: no cover - best-effort kill
+                logger.warning("elastic: kill of hung rank %d failed", boot_id, exc_info=True)
+            ok = self.handle_failure(boot_id, "hang verdict") and ok
+        return ok
+
+    def _in_transition(self, boot_id: int) -> bool:
+        for epoch, boots in list(self._unacked.items()):
+            if self.ledger.acks_present(epoch, boots):
+                self._unacked.pop(epoch, None)
+                continue
+            if boot_id in boots and not self.ledger.acks_present(epoch, [boot_id]):
+                return True
+        return False
+
+    # -- the resize itself -------------------------------------------------
+    def handle_failure(self, boot_id: int, reason: str) -> bool:
+        with self._lock:
+            if boot_id not in self.members:
+                # Already evicted (e.g. the killed hung worker's future
+                # settling afterwards).  Nothing more to do.
+                return True
+            survivors = [b for b in self.members if b != boot_id]
+            if len(survivors) < self.min_workers:
+                logger.warning(
+                    "elastic: %d survivors < min_workers=%d; falling back to full relaunch",
+                    len(survivors), self.min_workers,
+                )
+                return False
+            t0 = time.monotonic()
+            if self.supervisor is not None:
+                try:
+                    self.supervisor.forget_rank(boot_id)
+                except Exception:  # pragma: no cover
+                    pass
+            try:
+                self._kill_worker(boot_id)
+            except Exception:  # pragma: no cover - usually already dead
+                pass
+            restore = None
+            try:
+                restore = self._find_restore()
+            except Exception:  # pragma: no cover - checkpoint scan is best-effort
+                logger.warning("elastic: relaunch-checkpoint scan failed", exc_info=True)
+
+            self.epoch += 1
+            address = self.host.new_address(len(survivors))
+            multi = len(survivors) > 1
+            cmd = ResizeCommand(
+                epoch=self.epoch,
+                kind="shrink",
+                members=tuple(survivors),
+                coordinator=address,
+                apply="now",
+                restore=restore,
+                handoff=self.ledger.handoff_path(self.epoch) if multi else None,
+                handoff_writer=survivors[0] if multi else None,
+                failed=(boot_id,),
+                reason=reason,
+            )
+            self.ledger.announce(cmd)
+            self.members = survivors
+            self._unacked[cmd.epoch] = cmd.members
+            self._record_event(
+                "elastic_shrink",
+                {"epoch": cmd.epoch, "failed": boot_id, "world": cmd.world, "reason": reason},
+            )
+            acked = self.ledger.wait_acks(cmd.epoch, cmd.members, self.ack_timeout)
+            recovery = time.monotonic() - t0
+            self.resizes["shrink"] += 1
+            self.last_recovery_s = recovery
+            self._publish(recovery_s=recovery if acked else None)
+            if not acked:
+                logger.warning(
+                    "elastic: shrink epoch %d not fully acked after %.0fs; continuing",
+                    cmd.epoch, self.ack_timeout,
+                )
+            if self._readmit:
+                self._schedule_readmit()
+            return True
+
+    def _schedule_readmit(self) -> None:
+        joiner = self._next_boot_id
+        self._next_boot_id += 1
+        new_members = tuple(self.members) + (joiner,)
+        self.epoch += 1
+        address = self.host.new_address(len(new_members))
+        cmd = ResizeCommand(
+            epoch=self.epoch,
+            kind="grow",
+            members=new_members,
+            coordinator=address,
+            apply="epoch_end",
+            handoff=self.ledger.handoff_path(self.epoch),
+            handoff_writer=self.members[0],
+            reason="re-admit",
+        )
+        self.ledger.announce(cmd)
+        try:
+            fut = self._spawn_worker(joiner, len(new_members))
+        except Exception:
+            logger.exception("elastic: spare spawn failed; cancelling re-admit")
+            # Supersede the grow with a no-op "shrink" back to the current
+            # members so survivors don't wait at a barrier for a ghost.
+            self.epoch += 1
+            cancel = ResizeCommand(
+                epoch=self.epoch,
+                kind="shrink",
+                members=tuple(self.members),
+                coordinator=self.host.new_address(len(self.members)),
+                apply="epoch_end",
+                handoff=self.ledger.handoff_path(self.epoch),
+                handoff_writer=self.members[0],
+                reason="re-admit cancelled: spare spawn failed",
+            )
+            self.ledger.announce(cancel)
+            self._record_event("elastic_grow_failed", {"epoch": cmd.epoch, "joiner": joiner})
+            return
+        self.members = list(new_members)
+        self._unacked[cmd.epoch] = cmd.members
+        self._grow_pending = (cmd.epoch, cmd.members, time.monotonic())
+        if self.supervisor is not None:
+            try:
+                self.supervisor.track_rank(joiner)
+            except Exception:  # pragma: no cover
+                pass
+        self.register_future(fut, joiner)
+        self._new_futures.append(fut)
+        self._record_event(
+            "elastic_grow_announced",
+            {"epoch": cmd.epoch, "joiner": joiner, "world": cmd.world},
+        )
+        self._publish()
+
+    def poll(self) -> None:
+        """Cheap periodic check: detect completed grows."""
+
+        pending = self._grow_pending
+        if pending is None:
+            return
+        epoch, boots, t0 = pending
+        if self.ledger.acks_present(epoch, boots):
+            self._grow_pending = None
+            self._unacked.pop(epoch, None)
+            recovery = time.monotonic() - t0
+            self.resizes["grow"] += 1
+            self.last_recovery_s = recovery
+            self._record_event(
+                "elastic_grow", {"epoch": epoch, "world": len(boots)}
+            )
+            self._publish(recovery_s=recovery)
+
+    # -- observability -----------------------------------------------------
+    def _record_event(self, kind: str, detail: Dict[str, Any]) -> None:
+        if self._aggregator is not None:
+            try:
+                self._aggregator.record_event(kind, **detail)
+            except Exception:  # pragma: no cover
+                logger.debug("elastic event emit failed", exc_info=True)
+
+    def _publish(self, recovery_s: Optional[float] = None) -> None:
+        if self._aggregator is None:
+            return
+        try:
+            self._aggregator.set_elastic(
+                world_size=len(self.members),
+                membership_epoch=self.epoch,
+                shrinks=self.resizes["shrink"],
+                grows=self.resizes["grow"],
+                recovery_s=recovery_s,
+            )
+        except Exception:  # pragma: no cover
+            logger.debug("elastic gauge publish failed", exc_info=True)

@@ -15,6 +15,8 @@ zero API surface in the happy path:
     rank1:drop-heartbeats@step2  # stay alive but go silent from step 2 on
     rank0:crash@boot           # die during actor bring-up, before the
                                # ready handshake (startup-failure path)
+    rank1:crash@every:5        # sustained kill loop: die at every global
+                               # step that is a positive multiple of 5
 
 Step faults fire at the start of the named *global training step* (the
 trainer's per-step health tick, ``core/trainer.py``); boot faults fire in
@@ -30,6 +32,12 @@ firing). This is how chaos tests script "crash once, then recover": the
 relaunched worker replays the same steps, matches the same spec, and skips
 it because the fuse is blown. Without a fuse dir faults are pure functions
 of (rank, step) and fire on every match.
+
+``@every:N`` specs are the sustained-kill-loop escape hatch from the
+at-most-once semantics: they match every global step that is a positive
+multiple of N, and the fuse marker is per *firing step* (``...-s<step>``),
+so each boundary fires at most once across relaunches/resizes while the
+schedule as a whole keeps repeating.
 
 Rank resolution: ``RLT_GLOBAL_RANK`` (set by the launcher for worker
 actors). Step faults default to rank 0 when unset so in-process trainers
@@ -53,7 +61,7 @@ BOOT = "boot"
 
 _SPEC_RE = re.compile(
     r"^rank(?P<rank>\d+):(?P<kind>crash|hang|slow|drop-heartbeats)"
-    r"(?:@(?:step(?P<step>\d+)|(?P<boot>boot)))?"
+    r"(?:@(?:step(?P<step>\d+)|every:(?P<every>\d+)|(?P<boot>boot)))?"
     r"(?::(?P<arg>[0-9.]+))?$"
 )
 
@@ -61,17 +69,34 @@ _SPEC_RE = re.compile(
 @dataclass(frozen=True)
 class FaultSpec:
     """One scripted fault: ``kind`` fires for ``rank`` at ``at`` (a global
-    step number, or the string ``"boot"``). ``seconds`` is the slow-fault
+    step number, or the string ``"boot"``), or — when ``every`` is set — at
+    every positive multiple of ``every``. ``seconds`` is the slow-fault
     stall length."""
 
     rank: int
     kind: str
     at: Union[int, str] = 0
     seconds: float = 0.0
+    every: Optional[int] = None
 
     @property
     def fuse_id(self) -> str:
+        if self.every is not None:
+            return f"rank{self.rank}-{self.kind}-every{self.every}"
         return f"rank{self.rank}-{self.kind}-at{self.at}"
+
+    def fuse_id_at(self, step: int) -> str:
+        """Fuse marker name for one firing. Repeating specs burn one fuse
+        per firing step so each boundary fires at most once across
+        relaunches while the schedule keeps repeating."""
+        if self.every is not None:
+            return f"{self.fuse_id}-s{step}"
+        return self.fuse_id
+
+    def matches_step(self, step: int) -> bool:
+        if self.every is not None:
+            return step > 0 and step % self.every == 0
+        return self.at == step
 
 
 def parse_faults(text: Optional[str]) -> List[FaultSpec]:
@@ -91,12 +116,26 @@ def parse_faults(text: Optional[str]) -> List[FaultSpec]:
         if m is None:
             raise ValueError(
                 f"bad {FAULT_ENV} spec {raw!r}: expected "
-                "rank<R>:<crash|hang|slow|drop-heartbeats>@<step<N>|boot>"
-                "[:<seconds>]"
+                "rank<R>:<crash|hang|slow|drop-heartbeats>"
+                "@<step<N>|every:<N>|boot>[:<seconds>]"
             )
         kind = m.group("kind")
-        if m.group("boot"):
-            at: Union[int, str] = BOOT
+        every: Optional[int] = None
+        if m.group("every") is not None:
+            every = int(m.group("every"))
+            at: Union[int, str] = 0
+            if every < 1:
+                raise ValueError(
+                    f"bad {FAULT_ENV} spec {raw!r}: @every needs N >= 1"
+                )
+            if kind == "drop-heartbeats":
+                raise ValueError(
+                    f"bad {FAULT_ENV} spec {raw!r}: drop-heartbeats is "
+                    "already persistent (silent from @step<N> on); @every "
+                    "does not apply"
+                )
+        elif m.group("boot"):
+            at = BOOT
         elif m.group("step") is not None:
             at = int(m.group("step"))
         elif kind == "drop-heartbeats":
@@ -104,7 +143,7 @@ def parse_faults(text: Optional[str]) -> List[FaultSpec]:
         else:
             raise ValueError(
                 f"bad {FAULT_ENV} spec {raw!r}: {kind} needs an explicit "
-                "@step<N> or @boot"
+                "@step<N>, @every:<N> or @boot"
             )
         if kind == "slow" and m.group("arg") is None:
             raise ValueError(
@@ -122,6 +161,7 @@ def parse_faults(text: Optional[str]) -> List[FaultSpec]:
                 kind=kind,
                 at=at,
                 seconds=float(m.group("arg") or 0.0),
+                every=every,
             )
         )
     return specs
@@ -150,27 +190,29 @@ def _rank(default: Optional[int] = 0) -> Optional[int]:
         return default
 
 
-def _fuse_blown(spec: FaultSpec) -> bool:
+def _fuse_blown(spec: FaultSpec, step: Optional[int] = None) -> bool:
     fuse_dir = os.environ.get(FUSE_ENV)
     if not fuse_dir:
         return False
-    return os.path.exists(os.path.join(fuse_dir, spec.fuse_id))
+    marker = spec.fuse_id if step is None else spec.fuse_id_at(step)
+    return os.path.exists(os.path.join(fuse_dir, marker))
 
 
-def _blow_fuse(spec: FaultSpec) -> None:
+def _blow_fuse(spec: FaultSpec, step: Optional[int] = None) -> None:
     fuse_dir = os.environ.get(FUSE_ENV)
     if not fuse_dir:
         return
     os.makedirs(fuse_dir, exist_ok=True)
+    marker = spec.fuse_id if step is None else spec.fuse_id_at(step)
     # write + flush BEFORE firing: a crash fault must not lose its marker
-    with open(os.path.join(fuse_dir, spec.fuse_id), "w") as f:
+    with open(os.path.join(fuse_dir, marker), "w") as f:
         f.write(str(time.time()))
         f.flush()
         os.fsync(f.fileno())
 
 
-def _fire(spec: FaultSpec) -> None:
-    _blow_fuse(spec)
+def _fire(spec: FaultSpec, step: Optional[int] = None) -> None:
+    _blow_fuse(spec, step)
     if spec.kind == "crash":
         os._exit(1)
     elif spec.kind == "hang":
@@ -192,11 +234,11 @@ def fire_step_faults(step: int) -> None:
     for spec in specs:
         if (
             spec.rank == rank
-            and spec.at == step
             and spec.kind in ("crash", "hang", "slow")
-            and not _fuse_blown(spec)
+            and spec.matches_step(step)
+            and not _fuse_blown(spec, step)
         ):
-            _fire(spec)
+            _fire(spec, step)
 
 
 def fire_boot_faults() -> None:

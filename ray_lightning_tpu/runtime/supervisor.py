@@ -126,6 +126,7 @@ class Supervisor:
         startup_timeout: Optional[float] = None,
         label: str = "workers",
         aggregator: Optional[object] = None,
+        on_hung: Optional[Callable[[List[int]], bool]] = None,
     ):
         # a timeout below a couple of heartbeat periods would flag healthy
         # workers; clamp rather than error so the knobs stay independent.
@@ -142,6 +143,10 @@ class Supervisor:
         self._is_alive = is_alive
         self._label = label
         self._aggregator = aggregator
+        # Elastic hook: given the hung ranks, return True if they were
+        # absorbed (group shrank around them) — the supervisor then forgets
+        # those ranks and keeps watching instead of tripping the group.
+        self.on_hung = on_hung
         self.health: Dict[int, WorkerHealth] = {
             r: WorkerHealth(rank=r) for r in range(num_workers)
         }
@@ -212,7 +217,8 @@ class Supervisor:
         now = time.monotonic() if now is None else now
         out: Dict[int, str] = {}
         agg = self._aggregator
-        for rank, h in self.health.items():
+        # snapshot: track_rank/forget_rank may mutate concurrently
+        for rank, h in list(self.health.items()):
             if agg is not None and h.last_beat is not None:
                 try:
                     agg.heartbeat_age(rank, now - h.last_beat)
@@ -242,6 +248,16 @@ class Supervisor:
                 )
             out[rank] = verdict
         return out
+
+    def forget_rank(self, rank: int) -> None:
+        """Stop watching ``rank`` (evicted by an elastic shrink, or merely
+        mid-transition — a later heartbeat re-arms it via :meth:`observe`)."""
+        self.health.pop(rank, None)
+
+    def track_rank(self, rank: int) -> None:
+        """Start watching a newly-admitted rank (elastic grow). The fresh
+        ``started`` stamp re-arms the startup grace period."""
+        self.health[rank] = WorkerHealth(rank=rank)
 
     def _record_event(self, kind: str, **fields) -> None:
         agg = self._aggregator
@@ -291,6 +307,19 @@ class Supervisor:
                     pass
             if not hung:
                 continue
+            if self.on_hung is not None:
+                handled = False
+                try:
+                    handled = bool(self.on_hung(list(hung)))
+                except Exception:
+                    logger.exception("supervisor: on_hung hook failed")
+                if handled:
+                    # the group shrank around the hung ranks (or they are
+                    # mid-transition); forget them — survivors' beats keep
+                    # flowing and a deferred rank re-arms on its next beat
+                    for r in hung:
+                        self.forget_rank(r)
+                    continue
             self._trip(hung)
             return
 

@@ -58,6 +58,8 @@ class RayStrategy(XLAStrategy):
         dcn_grad_compression: Optional[str] = None,
         debug_collectives: bool = False,
         max_failures: int = 0,
+        elastic: Optional[bool] = None,
+        min_workers: Optional[int] = None,
         heartbeat_interval: Optional[float] = None,
         hang_timeout: Optional[float] = None,
         telemetry: Optional[bool] = None,
@@ -88,6 +90,8 @@ class RayStrategy(XLAStrategy):
         self.chips_per_host = chips_per_host
         self.debug_collectives = debug_collectives
         self.max_failures = int(max_failures)
+        self._elastic = elastic
+        self._min_workers = min_workers
         if kwargs:
             rank_zero_warn("ignoring unsupported strategy kwargs: %s", sorted(kwargs))
         self._launcher = None
@@ -194,6 +198,29 @@ class RayStrategy(XLAStrategy):
     @property
     def is_global_zero(self) -> bool:
         return self.global_rank == 0
+
+    # ------------------------------------------------------------------ #
+    # elastic membership knobs (ctor > env > default)
+    # ------------------------------------------------------------------ #
+    @property
+    def elastic(self) -> bool:
+        """Shrink/grow the worker group on failure instead of relaunching
+        the whole group (ctor ``elastic=`` > ``RLT_ELASTIC`` > False)."""
+        if self._elastic is not None:
+            return bool(self._elastic)
+        return os.environ.get("RLT_ELASTIC", "0") == "1"
+
+    @property
+    def min_workers(self) -> int:
+        """Smallest world size elastic training may shrink to before giving
+        up and falling back to the max_failures relaunch path (ctor
+        ``min_workers=`` > ``RLT_MIN_WORKERS`` > 1)."""
+        if self._min_workers is not None:
+            return max(1, int(self._min_workers))
+        try:
+            return max(1, int(os.environ.get("RLT_MIN_WORKERS", "1")))
+        except ValueError:
+            return 1
 
     def teardown(self) -> None:
         super().teardown()

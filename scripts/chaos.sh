@@ -11,6 +11,10 @@ export JAX_PLATFORMS=cpu
 echo "== chaos tests (fault injection + supervisor) =="
 python -m pytest tests/test_chaos.py -v -m chaos -p no:cacheprovider "$@"
 
+echo "== elastic membership (shrink/grow, incl. sustained kill loop) =="
+# RLT_CHAOS_KILL_EVERY tunes the @every:<N> kill cadence of the loop test
+python -m pytest tests/test_elastic.py -v -m elastic -p no:cacheprovider "$@"
+
 echo "== legacy relaunch/retry path (slow) =="
 python -m pytest tests/test_cli_and_checkpointing.py -v -m slow \
     -k "retries or relaunch" -p no:cacheprovider "$@"
