@@ -316,8 +316,17 @@ def _child(args: argparse.Namespace) -> int:
     if step is None:
         step = make_step(cfg)
 
-    for _ in range(args.warmup):
+    # the first warmup step pays the XLA compile (unless the remat sweep
+    # already compiled the winner) — reported as detail.compile_ms so a
+    # compile-time regression is visible next to the steady-state number
+    compile_ms = None
+    for i in range(args.warmup):
+        if i == 0:
+            t_compile = time.perf_counter()
         params, opt_state, loss = step(params, opt_state, tokens)
+        if i == 0:
+            float(loss)
+            compile_ms = round((time.perf_counter() - t_compile) * 1e3, 2)
     jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
 
     t0 = time.perf_counter()
@@ -367,9 +376,17 @@ def _child(args: argparse.Namespace) -> int:
             "final_loss": round(final_loss, 4),
             "platform": dev.platform,
             "device_kind": getattr(dev, "device_kind", "?"),
+            "compile_ms": compile_ms,
             **step_dist,
         },
     }
+    from ray_lightning_tpu.observability import metrics as _obs_metrics
+
+    devmem = _obs_metrics.device_memory_stats()
+    if devmem:
+        result["detail"]["hbm_peak_bytes"] = max(
+            d.get("peak_bytes", 0) for d in devmem
+        )
     if matmul_ceiling is not None:
         result["detail"]["matmul_ceiling_tflops_measured"] = matmul_ceiling
     if autotune_note:
@@ -800,8 +817,11 @@ def _serve_sweep(args: argparse.Namespace) -> int:
     )
     engine.start()
     try:
-        # warmup: compile both programs off the clock
+        # warmup: compile both programs off the clock (but on this timer —
+        # reported as compile_ms next to the steady-state levels)
+        t_compile = time.perf_counter()
         engine.submit([1, 2, 3], max_new_tokens=2).result(timeout=120)
+        compile_ms = round((time.perf_counter() - t_compile) * 1e3, 2)
         levels = [
             _serve_microbench(
                 engine, rate, num_requests,
@@ -823,6 +843,7 @@ def _serve_sweep(args: argparse.Namespace) -> int:
                     lvl["tokens_per_sec"] for lvl in levels
                 ),
                 "compile_stats": compiles,
+                "compile_ms": compile_ms,
             }
         )
     )

@@ -283,7 +283,58 @@ def _cmd_serve(args) -> int:
         reg = _obs.registry()
         if reg is not None:
             print(reg.prometheus_text())
+        if args.telemetry_dir:
+            from ray_lightning_tpu.observability.aggregator import (
+                write_local_dump,
+            )
+
+            write_local_dump(
+                args.telemetry_dir,
+                _obs.get_recorder(),
+                reg,
+                requests=engine.drain_request_records(),
+            )
+            print(json.dumps({"telemetry_dir": args.telemetry_dir}))
     engine.shutdown(drain=False)
+    return 0
+
+
+def _cmd_requests(args) -> int:
+    """List the slowest finished requests from a run's ``requests.jsonl``
+    (written by the driver aggregator / ``serve --telemetry-dir``)."""
+    import json
+    import os
+
+    from ray_lightning_tpu.observability import reqtrace
+
+    path = os.path.join(args.dir, reqtrace.REQUESTS_FILE)
+    records = reqtrace.read_requests(path)
+    if not records:
+        print(f"no request records found at {path}")
+        return 1
+    key = args.sort
+    records.sort(key=lambda r: (r.get(key) or 0.0), reverse=True)
+    if args.limit > 0:
+        records = records[: args.limit]
+    if args.json:
+        for r in records:
+            print(json.dumps(r))
+        return 0
+    cols = (
+        ("request_id", 14), ("finish_reason", 8), ("prompt_len", 6),
+        ("tokens_out", 6), ("queue_wait_s", 12), ("prefill_s", 9),
+        ("ttft_s", 8), ("total_s", 8), ("itl_p50_ms", 10),
+        ("itl_max_ms", 10), ("deferred_ticks", 8), ("replica", 7),
+    )
+    print("  ".join(f"{name:>{w}}" for name, w in cols))
+    for r in records:
+        cells = []
+        for name, w in cols:
+            v = r.get(name)
+            if isinstance(v, float):
+                v = f"{v:.4f}"
+            cells.append(f"{'-' if v is None else v:>{w}}")
+        print("  ".join(cells))
     return 0
 
 
@@ -353,6 +404,36 @@ def main(argv: Optional[list] = None) -> int:
         action="store_true",
         help="enable spans/metrics and dump the Prometheus text exposition",
     )
+    serve.add_argument(
+        "--telemetry-dir",
+        default=None,
+        help="with --telemetry: write trace.json / summary.json / "
+        "requests.jsonl to this directory on exit",
+    )
+    requests_p = sub.add_parser(
+        "requests",
+        help="slowest finished requests from a run's requests.jsonl",
+    )
+    requests_p.add_argument(
+        "--dir",
+        required=True,
+        help="telemetry directory containing requests.jsonl",
+    )
+    requests_p.add_argument(
+        "--sort",
+        default="ttft_s",
+        choices=(
+            "ttft_s", "total_s", "queue_wait_s", "deferred_wait_s",
+            "prefill_s", "itl_p50_ms", "itl_max_ms", "tokens_out",
+        ),
+        help="sort key (descending)",
+    )
+    requests_p.add_argument(
+        "--limit", type=int, default=20, help="show at most N requests"
+    )
+    requests_p.add_argument(
+        "--json", action="store_true", help="emit JSONL instead of a table"
+    )
     args = parser.parse_args(argv)
     if args.command == "top":
         from ray_lightning_tpu.observability.aggregator import render_top
@@ -360,6 +441,8 @@ def main(argv: Optional[list] = None) -> int:
         return render_top(args.dir, follow=args.follow, interval=args.interval)
     if args.command == "serve":
         return _cmd_serve(args)
+    if args.command == "requests":
+        return _cmd_requests(args)
     parser.print_help()
     return 2
 

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from . import metrics, trace
+from . import metrics, reqtrace, slo, trace
 from .trace import (  # noqa: F401  (re-exported API)
     DRIVER,
     NOOP_SPAN,
@@ -50,7 +50,10 @@ __all__ = [
     "merge_traces",
     "metrics",
     "registry",
+    "reqtrace",
     "reset",
+    "sample_device_memory",
+    "slo",
     "span",
     "trace",
 ]
@@ -82,6 +85,15 @@ def collect_beat_payload(final: bool = False) -> Optional[Dict[str, Any]]:
     if not final and not events and reg.is_empty_snapshot(snap):
         return None
     return {"m": snap, "t": events}
+
+
+def sample_device_memory(force: bool = False) -> None:
+    """Throttled device-memory (HBM) snapshot into the gauges; a no-op
+    when telemetry is disabled, one clock read when the cache is fresh.
+    Beat paths (session heartbeat, serve replica beat loop) call this so
+    the gauges ride the existing heartbeat channel."""
+    if trace.enabled():
+        metrics.publish_device_memory(metrics.get_registry(), force=force)
 
 
 def reset() -> None:

@@ -30,6 +30,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from . import metrics as _metrics
+from . import reqtrace as _reqtrace
 from . import trace as _trace
 
 TRACE_FILE = "trace.json"
@@ -37,6 +38,7 @@ METRICS_FILE = "metrics.json"
 PROM_FILE = "metrics.prom"
 EVENTS_FILE = "events.jsonl"
 SUMMARY_FILE = "summary.json"
+REQUESTS_FILE = _reqtrace.REQUESTS_FILE
 
 DIR_ENV = "RLT_TELEMETRY_DIR"
 
@@ -99,18 +101,23 @@ class DriverAggregator:
         num_workers: int,
         full: bool = True,
         summary_interval: float = 2.0,
+        slo_monitor: Optional[Any] = None,
     ):
         self.run_dir = run_dir
         self.num_workers = int(num_workers)
         self.full = bool(full)
         self.registry = _metrics.MetricsRegistry()
+        self.slo = slo_monitor
         self._trace_by_rank: Dict[Any, deque] = {}
         self._skew_samples: Dict[Any, deque] = {}
         self._step_samples: Dict[Any, deque] = {}
         self._last_step: Dict[Any, int] = {}
         self._last_beat: Dict[Any, float] = {}
         self._rank_gauges: Dict[Any, Dict[str, float]] = {}
-        self._events_fh = None
+        self._events = _reqtrace.JsonlWriter(os.path.join(run_dir, EVENTS_FILE))
+        self._requests: Optional[_reqtrace.JsonlWriter] = None
+        self.requests_total = 0
+        self._slo_counter_last: Dict[Any, float] = {}
         self._elastic: Optional[Dict[str, Any]] = None
         self._summary_interval = float(summary_interval)
         self._summary_written = 0.0
@@ -139,6 +146,7 @@ class DriverAggregator:
         reg.gauge("rlt_worker_step", rank=rank).set(step)
         if payload:
             self.ingest_payload(rank, payload)
+        self._evaluate_slo()
         self._maybe_write_summary(recv)
 
     def ingest_payload(self, rank: int, payload: dict) -> None:
@@ -148,13 +156,22 @@ class DriverAggregator:
                 rank, deque(maxlen=MAX_EVENTS_PER_RANK)
             )
             buf.extend(events)
+        for rec in payload.get("r", ()):
+            self.record_request(rec, rank=rank)
         snap = payload.get("m")
         if snap:
             self.registry.merge_snapshot(snap, extra_labels={"rank": rank})
             gauges = self._rank_gauges.setdefault(rank, {})
+            hbm_seen: Dict[str, float] = {}
             for name, labels, value in snap.get("gauges", ()):
                 if not labels:
                     gauges[name] = value
+                elif name in (
+                    _metrics.HBM_IN_USE_METRIC, _metrics.HBM_PEAK_METRIC
+                ):
+                    # device-labelled: fold to the rank's worst device
+                    hbm_seen[name] = max(hbm_seen.get(name, 0.0), value)
+            gauges.update(hbm_seen)
             # counters are cumulative at the source, so latest-wins like
             # gauges; the input-starved total feeds the summary/top view
             for name, labels, value in snap.get("counters", ()):
@@ -165,6 +182,42 @@ class DriverAggregator:
                     self._step_samples.setdefault(
                         rank, deque(maxlen=MAX_STEP_SAMPLES)
                     ).extend(h.get("samples", ()))
+            if self.slo is not None:
+                self._feed_slo(rank, snap)
+
+    # ----------------------------------------------------------------- #
+    # SLO routing: worker metric snapshots -> burn-rate observations
+    # ----------------------------------------------------------------- #
+    def _feed_slo(self, rank: int, snap: dict) -> None:
+        slo = self.slo
+        for name, labels, h in snap.get("histograms", ()):
+            m = slo.monitor_for_metric(name)
+            if m is not None and m.objective.kind == "latency":
+                for v in h.get("samples", ()):
+                    m.observe(v)
+        for name, labels, value in snap.get("counters", ()):
+            m = slo.monitor_for_metric(name)
+            if m is None:
+                continue
+            key = (rank, name, tuple(labels))
+            delta = value - self._slo_counter_last.get(key, 0.0)
+            self._slo_counter_last[key] = value
+            if delta <= 0:
+                continue
+            if m.objective.kind == "ratio":
+                # serving completions: `reason=error` burns budget
+                bad = dict(labels).get("reason") == "error"
+                m.record(0 if bad else int(delta), int(delta) if bad else 0)
+            else:
+                # cumulative-seconds counters (input starvation): the
+                # per-beat increase is the latency-style observation
+                m.observe(delta)
+
+    def _evaluate_slo(self) -> None:
+        if self.slo is None:
+            return
+        for v in self.slo.evaluate(reg=self.registry):
+            self.record_event(v.pop("event"), **v)
 
     def heartbeat_age(self, rank: int, age: float) -> None:
         """Supervisor-reported time since a rank's last beat."""
@@ -199,21 +252,31 @@ class DriverAggregator:
             reg.histogram("rlt_elastic_recovery_seconds").observe(recovery_s)
 
     def record_event(self, kind: str, **fields) -> None:
-        """Append one line to the JSONL flight record (always on) and
-        mirror it as an instant event on the driver's trace track."""
+        """Append one line to the JSONL flight record (always on, rotated
+        at ``RLT_EVENTS_MAX_BYTES``) and mirror it as an instant event on
+        the driver's trace track."""
         line = {"ts": time.time(), "event": kind}
-        line.update(fields)
-        try:
-            if self._events_fh is None:
-                self._events_fh = open(
-                    os.path.join(self.run_dir, EVENTS_FILE), "a"
-                )
-            self._events_fh.write(json.dumps(line, default=str) + "\n")
-            self._events_fh.flush()
-        except OSError:  # pragma: no cover - telemetry must never kill a run
-            pass
+        line.update(
+            {k: (v if isinstance(v, (int, float, bool, type(None))) else str(v))
+             for k, v in fields.items()}
+        )
+        self._events.write(line)
         _trace.event(f"verdict/{kind}" if kind in (
             "crash", "hang", "straggler") else kind, **fields)
+
+    def record_request(self, record: dict, rank: Optional[int] = None) -> None:
+        """One finished-request record (from a replica's beat payload or a
+        local engine) into the fleet-wide ``requests.jsonl``."""
+        if not self.full:
+            return
+        if self._requests is None:
+            self._requests = _reqtrace.JsonlWriter(
+                os.path.join(self.run_dir, REQUESTS_FILE)
+            )
+        if rank is not None and "rank" not in record:
+            record = dict(record, rank=rank)
+        self._requests.write(record)
+        self.requests_total += 1
 
     # ----------------------------------------------------------------- #
     # aggregation
@@ -255,6 +318,8 @@ class DriverAggregator:
                 ("rlt_tokens_per_sec_per_chip", "tokens_per_sec_per_chip"),
                 ("rlt_input_starved_seconds", "input_starved_s"),
                 ("rlt_prefetch_queue_depth", "prefetch_queue_depth"),
+                (_metrics.HBM_IN_USE_METRIC, "hbm_bytes_in_use"),
+                (_metrics.HBM_PEAK_METRIC, "hbm_peak_bytes"),
             ):
                 if name in gauges:
                     info[key] = round(gauges[name], 6)
@@ -276,6 +341,13 @@ class DriverAggregator:
         ]
         if starved:
             cluster["input_starved_s"] = round(max(starved), 6)
+        hbm = [
+            info["hbm_bytes_in_use"]
+            for info in per_rank.values()
+            if "hbm_bytes_in_use" in info
+        ]
+        if hbm:
+            cluster["hbm_bytes_in_use"] = round(max(hbm))
         steps = [s for s in self._last_step.values() if s is not None]
         if steps:
             cluster["steps_min"] = min(steps)
@@ -287,6 +359,13 @@ class DriverAggregator:
             "per_rank": per_rank,
             "cluster": cluster,
         }
+        if self.requests_total:
+            cluster["requests_total"] = self.requests_total
+        if self.slo is not None:
+            out["slo"] = {
+                name: {k: round(v, 3) for k, v in rates.items()}
+                for name, rates in self.slo.burn_rates().items()
+            }
         if self._elastic is not None:
             out["elastic"] = dict(self._elastic)
         return out
@@ -314,12 +393,19 @@ class DriverAggregator:
         out: Dict[str, Any] = {}
         for (name, labels), m in self.registry.items():
             if isinstance(m, _metrics.Histogram):
-                out.setdefault(name, {})[_metrics._format_labels(labels) or "{}"] = {
+                h = {
                     "bounds": list(m.bounds),
                     "counts": list(m.counts),
                     "sum": m.sum,
                     "count": m.count,
                 }
+                if m.exemplars:
+                    # slow buckets name their offending request ids
+                    h["exemplars"] = {
+                        str(b): list(ids)
+                        for b, ids in sorted(m.exemplars.items())
+                    }
+                out.setdefault(name, {})[_metrics._format_labels(labels) or "{}"] = h
         return out
 
     def finalize(
@@ -351,12 +437,9 @@ class DriverAggregator:
                     f.write(self.registry.prometheus_text())
             except OSError:  # pragma: no cover
                 pass
-        if self._events_fh is not None:
-            try:
-                self._events_fh.close()
-            except OSError:  # pragma: no cover
-                pass
-            self._events_fh = None
+        self._events.close()
+        if self._requests is not None:
+            self._requests.close()
         return self.run_dir if self.full else None
 
 
@@ -365,14 +448,22 @@ def write_local_dump(
     recorder: Optional[_trace.TraceRecorder],
     registry: Optional[_metrics.MetricsRegistry],
     rank: int = 0,
+    requests: Optional[List[dict]] = None,
 ) -> str:
     """Dump a single process's telemetry (no launcher / in-process
-    strategies): same file set as the driver aggregator, one rank track."""
+    strategies): same file set as the driver aggregator, one rank track.
+    ``requests`` carries finished-request records (an engine tracer's
+    drain) into ``requests.jsonl``."""
     agg = DriverAggregator(run_dir, num_workers=1, full=True)
+    payload: Dict[str, Any] = {}
     if registry is not None:
-        agg.ingest_payload(rank, {"m": registry.snapshot(delta=False)})
+        payload["m"] = registry.snapshot(delta=False)
     if recorder is not None:
-        agg.ingest_payload(rank, {"t": recorder.drain()})
+        payload["t"] = recorder.drain()
+    if requests:
+        payload["r"] = list(requests)
+    if payload:
+        agg.ingest_payload(rank, payload)
     agg.finalize()
     return run_dir
 
@@ -396,11 +487,24 @@ def format_summary(summary: Dict[str, Any], events: List[dict]) -> str:
         ("samples_per_sec", "{:.1f} samples/s"),
         ("mfu", "MFU {:.3f}"),
         ("input_starved_s", "input starved {:.2f}s"),
+        ("requests_total", "{:d} requests"),
     ):
         if key in cl:
             cl_bits.append(fmt.format(cl[key]))
+    if "hbm_bytes_in_use" in cl:
+        cl_bits.append(f"HBM {cl['hbm_bytes_in_use'] / 2**30:.2f}GB")
     if cl_bits:
         lines.append("cluster: " + " · ".join(cl_bits))
+    slo_state = summary.get("slo")
+    if slo_state:
+        slo_bits = []
+        for name, rates in sorted(slo_state.items()):
+            mark = "BREACH" if rates.get("breached") else "ok"
+            slo_bits.append(
+                f"{name} {mark} (fast {rates.get('fast', 0):.1f}x "
+                f"slow {rates.get('slow', 0):.1f}x)"
+            )
+        lines.append("slo: " + " · ".join(slo_bits))
     el = summary.get("elastic")
     if el:
         el_bits = [
@@ -413,14 +517,16 @@ def format_summary(summary: Dict[str, Any], events: List[dict]) -> str:
             el_bits.append(f"last recovery {el['last_recovery_s']:.1f}s")
         lines.append("elastic: " + " · ".join(el_bits))
     header = f"{'rank':>5} {'step':>8} {'p50(s)':>9} {'p90(s)':>9} " \
-             f"{'sps':>9} {'mfu':>7} {'starve(s)':>9} {'beat age':>9} " \
-             f"{'skew(s)':>9}"
+             f"{'sps':>9} {'mfu':>7} {'starve(s)':>9} {'hbm(GB)':>8} " \
+             f"{'beat age':>9} {'skew(s)':>9}"
     lines.append(header)
     for rank, info in sorted(summary.get("per_rank", {}).items(), key=lambda kv: kv[0]):
         def _f(key, spec, default="-"):
             v = info.get(key)
             return spec.format(v) if v is not None else default
 
+        hbm = info.get("hbm_bytes_in_use")
+        hbm_gb = f"{hbm / 2**30:.2f}" if hbm is not None else "-"
         lines.append(
             f"{rank:>5} {_f('step', '{:d}'):>8} "
             f"{_f('step_time_p50', '{:.4f}'):>9} "
@@ -428,6 +534,7 @@ def format_summary(summary: Dict[str, Any], events: List[dict]) -> str:
             f"{_f('samples_per_sec', '{:.1f}'):>9} "
             f"{_f('mfu', '{:.3f}'):>7} "
             f"{_f('input_starved_s', '{:.2f}'):>9} "
+            f"{hbm_gb:>8} "
             f"{_f('heartbeat_age_s', '{:.1f}'):>9} "
             f"{_f('clock_skew_s', '{:.4f}'):>9}"
         )

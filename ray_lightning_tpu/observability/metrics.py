@@ -15,6 +15,7 @@ resolution.
 """
 from __future__ import annotations
 
+import time
 from bisect import bisect_left
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -28,14 +29,51 @@ DEFAULT_BOUNDS = (
 )
 # Cap on raw samples buffered between two delta snapshots.
 PENDING_CAP = 4096
+# Last-N exemplar ids kept per histogram bucket.
+EXEMPLAR_CAP = 3
+
+# `# HELP` text for the exposition; metrics not listed fall back to a
+# name-derived placeholder so every family still carries a HELP line.
+HELP: Dict[str, str] = {
+    "rlt_step_time_seconds": "Training step wall time per rank.",
+    "rlt_heartbeat_latency_seconds": "Heartbeat send-to-receive latency.",
+    "rlt_heartbeat_age_seconds": "Seconds since the last beat per rank.",
+    "rlt_worker_step": "Latest step number reported by each rank.",
+    "rlt_serve_ttft_seconds": "Serving time-to-first-token (submit to first sampled token).",
+    "rlt_serve_itl_seconds": "Serving inter-token latency.",
+    "rlt_serve_queue_depth": "Serving admission queue depth.",
+    "rlt_slo_burn_rate": "SLO error-budget burn rate per objective and window.",
+    "rlt_slo_breached": "1 while the objective's multi-window burn-rate alert is firing.",
+    "rlt_hbm_bytes_in_use": "Device (HBM) bytes currently allocated, per local device.",
+    "rlt_hbm_peak_bytes": "Peak device (HBM) bytes allocated, per local device.",
+}
+
+
+def set_help(name: str, text: str) -> None:
+    """Register `# HELP` text for a metric family."""
+    HELP[name] = text
+
+
+def help_for(name: str) -> str:
+    return HELP.get(name, name.replace("_", " "))
 
 
 def _key(name: str, labels: Dict[str, Any]) -> LabelKey:
     return name, tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
+def _escape_label_value(v: str) -> str:
+    # Prometheus text format: backslash, double-quote, and newline must be
+    # escaped inside label values for real scrapers to parse the output.
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _format_labels(labels: Sequence[Tuple[str, str]], extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in labels]
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in labels]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -73,30 +111,59 @@ class Histogram:
 
     ``counts``/``sum``/``count`` are cumulative (Prometheus semantics,
     with a +Inf overflow bucket at the end). ``pending`` holds samples
-    recorded since the last delta snapshot; ``recent`` is a ring used for
-    local percentile queries.
+    recorded since the last delta snapshot, hard-capped at
+    ``pending_cap`` entries so a stalled drain can't grow memory;
+    ``recent`` is a ring used for local percentile queries.
+    ``exemplars`` keeps the last few observation ids (e.g. request ids)
+    per bucket so a slow bucket names its offenders.
     """
 
-    __slots__ = ("bounds", "counts", "sum", "count", "pending", "recent")
+    __slots__ = (
+        "bounds", "counts", "sum", "count", "pending", "pending_cap",
+        "recent", "exemplars",
+    )
 
     kind = "histogram"
 
-    def __init__(self, bounds: Sequence[float] = DEFAULT_BOUNDS):
+    def __init__(
+        self,
+        bounds: Sequence[float] = DEFAULT_BOUNDS,
+        pending_cap: int = PENDING_CAP,
+    ):
         self.bounds: Tuple[float, ...] = tuple(bounds)
         self.counts: List[int] = [0] * (len(self.bounds) + 1)
         self.sum = 0.0
         self.count = 0
         self.pending: List[float] = []
+        self.pending_cap = max(1, int(pending_cap))
         self.recent: deque = deque(maxlen=1024)
+        self.exemplars: Dict[int, List[str]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         value = float(value)
-        self.counts[bisect_left(self.bounds, value)] += 1
+        bucket = bisect_left(self.bounds, value)
+        self.counts[bucket] += 1
         self.sum += value
         self.count += 1
-        if len(self.pending) < PENDING_CAP:
+        if len(self.pending) < self.pending_cap:
             self.pending.append(value)
         self.recent.append(value)
+        if exemplar is not None:
+            ids = self.exemplars.setdefault(bucket, [])
+            ids.append(str(exemplar))
+            if len(ids) > EXEMPLAR_CAP:
+                del ids[0]
+
+    def bucket_exemplars(self, lower_than: Optional[float] = None) -> List[str]:
+        """Exemplar ids, slowest buckets first; with ``lower_than`` only
+        buckets whose lower bound is >= that value (``ttft > 1s`` style)."""
+        out: List[str] = []
+        for bucket in sorted(self.exemplars, reverse=True):
+            lower = self.bounds[bucket - 1] if bucket > 0 else 0.0
+            if lower_than is not None and lower < lower_than:
+                continue
+            out.extend(reversed(self.exemplars[bucket]))
+        return out
 
     def load(self, counts: Sequence[int], total: float, count: int) -> None:
         """Overwrite cumulative state (driver rebuilding a worker histogram)."""
@@ -180,15 +247,19 @@ class MetricsRegistry:
                 gauges.append([name, list(labels), m.value])
             else:
                 samples = m.drain_pending() if delta else list(m.recent)
-                hists.append(
-                    [name, list(labels), {
-                        "bounds": list(m.bounds),
-                        "counts": list(m.counts),
-                        "sum": m.sum,
-                        "count": m.count,
-                        "samples": samples,
-                    }]
-                )
+                h: Dict[str, Any] = {
+                    "bounds": list(m.bounds),
+                    "counts": list(m.counts),
+                    "sum": m.sum,
+                    "count": m.count,
+                    "samples": samples,
+                }
+                if m.exemplars:
+                    # str keys so the dict survives a JSON round-trip
+                    h["exemplars"] = {
+                        str(b): list(ids) for b, ids in m.exemplars.items()
+                    }
+                hists.append([name, list(labels), h])
         return {"counters": counters, "gauges": gauges, "histograms": hists}
 
     def is_empty_snapshot(self, snap: Dict[str, Any]) -> bool:
@@ -216,19 +287,26 @@ class MetricsRegistry:
             m = self.histogram(name, bounds=h["bounds"], **_merged(labels))
             m.load(h["counts"], h["sum"], h["count"])
             for v in h.get("samples", ()):
-                if len(m.pending) < PENDING_CAP:
+                if len(m.pending) < m.pending_cap:
                     m.pending.append(v)
                 m.recent.append(v)
+            for b, ids in (h.get("exemplars") or {}).items():
+                dst = m.exemplars.setdefault(int(b), [])
+                for x in ids:
+                    dst.append(str(x))
+                del dst[:-EXEMPLAR_CAP]
 
     # ----------------------------------------------------------------- #
     # exposition
     # ----------------------------------------------------------------- #
     def prometheus_text(self) -> str:
-        """Prometheus text exposition format (one line per series)."""
+        """Prometheus text exposition format (one line per series), with
+        `# HELP`/`# TYPE` headers per family and escaped label values."""
         lines: List[str] = []
         seen_type: Dict[str, str] = {}
         for (name, labels), m in sorted(self._metrics.items()):
             if seen_type.get(name) != m.kind:
+                lines.append(f"# HELP {name} {_escape_help(help_for(name))}")
                 lines.append(f"# TYPE {name} {m.kind}")
                 seen_type[name] = m.kind
             if isinstance(m, (Counter, Gauge)):
@@ -263,4 +341,84 @@ def reset_registry() -> MetricsRegistry:
     """Replace the global registry (test isolation)."""
     global _registry
     _registry = MetricsRegistry()
+    _devmem_cache[0] = 0.0
+    _devmem_cache[1] = None
     return _registry
+
+
+# --------------------------------------------------------------------- #
+# device-memory telemetry (HBM gauges)
+# --------------------------------------------------------------------- #
+HBM_IN_USE_METRIC = "rlt_hbm_bytes_in_use"
+HBM_PEAK_METRIC = "rlt_hbm_peak_bytes"
+DEVMEM_MIN_INTERVAL_S = 5.0
+
+# [last monotonic sample time, last stats list or None]
+_devmem_cache: List[Any] = [0.0, None]
+
+
+def device_memory_stats() -> List[Dict[str, Any]]:
+    """Best-effort ``device.memory_stats()`` per local accelerator.
+
+    Returns ``[]`` on backends without allocator stats (CPU) or when jax
+    is unavailable — callers treat device-memory telemetry as optional.
+    """
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    out: List[Dict[str, Any]] = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats or "bytes_in_use" not in stats:
+            continue
+        in_use = int(stats["bytes_in_use"])
+        out.append(
+            {
+                "device": f"{getattr(d, 'platform', 'dev')}:{getattr(d, 'id', len(out))}",
+                "bytes_in_use": in_use,
+                "peak_bytes": int(stats.get("peak_bytes_in_use", in_use)),
+                "bytes_limit": int(stats.get("bytes_limit", 0)),
+            }
+        )
+    return out
+
+
+def publish_device_memory(
+    reg: Optional[MetricsRegistry],
+    min_interval_s: float = DEVMEM_MIN_INTERVAL_S,
+    force: bool = False,
+) -> List[Dict[str, Any]]:
+    """Throttled device-memory snapshot into the HBM gauges.
+
+    Samples at most once per ``min_interval_s`` (cached list returned in
+    between — a beat-rate call site costs one clock read). Publishes
+    ``rlt_hbm_bytes_in_use`` / ``rlt_hbm_peak_bytes`` per device when a
+    registry is given.
+    """
+    now = time.monotonic()
+    if (
+        not force
+        and _devmem_cache[1] is not None
+        and now - _devmem_cache[0] < min_interval_s
+    ):
+        return _devmem_cache[1]
+    stats = device_memory_stats()
+    _devmem_cache[0] = now
+    _devmem_cache[1] = stats
+    if reg is not None:
+        for s in stats:
+            reg.gauge(HBM_IN_USE_METRIC, device=s["device"]).set(s["bytes_in_use"])
+            reg.gauge(HBM_PEAK_METRIC, device=s["device"]).set(s["peak_bytes"])
+    return stats
+
+
+def last_device_memory() -> Optional[List[Dict[str, Any]]]:
+    """The most recent (possibly stale) device-memory snapshot, or None
+    if none has been taken — never touches the device."""
+    return _devmem_cache[1]

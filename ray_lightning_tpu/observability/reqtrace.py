@@ -1,0 +1,401 @@
+"""Request-scoped tracing for the serving stack.
+
+A :class:`RequestTrace` is minted at ``InferenceEngine.submit`` (and
+stamped with routing info at ``ReplicaGroup`` submit) and threaded — as
+one attribute on the scheduler :class:`~..serving.scheduler.Request` and
+on the KV :class:`~..serving.kv_pool.Slot` — through admission,
+block-pool deferral, prefill, and every decode tick. It accumulates a
+per-request timeline: queue wait, deferred-block wait, prefill duration,
+TTFT, and per-token ITL stamps.
+
+On finish the :class:`RequestTracer`:
+
+- emits ``req/queue_wait`` / ``req/deferred_block_wait`` / ``req/prefill``
+  / ``req/decode`` spans into the process trace ring, tagged with the
+  :data:`~.trace.TRACK_ARG` arg so the merged ``trace.json`` renders one
+  Perfetto track per request under its rank's process;
+- appends a JSON record to ``requests.jsonl`` (locally when an output
+  dir is known) and buffers it for heartbeat shipping so the driver-side
+  aggregator can build a fleet-wide request log.
+
+Head-based sampling: the keep/drop decision is taken once at submit from
+``RLT_TRACE_SAMPLE`` (fraction in [0, 1], default 1.0 when telemetry is
+on) by hashing the request id, so a request is either fully traced or
+free — the per-token cost for an unsampled request is the same single
+attribute ``None`` check as with telemetry off.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import metrics, trace
+
+SAMPLE_ENV = "RLT_TRACE_SAMPLE"
+EVENTS_MAX_ENV = "RLT_EVENTS_MAX_BYTES"
+
+REQUESTS_FILE = "requests.jsonl"
+
+# JSONL writers rotate once past this size unless the env overrides.
+DEFAULT_MAX_JSONL_BYTES = 64 * 1024 * 1024
+# Per-request ITL stamp cap (offsets from the first token, seconds).
+MAX_TOKEN_STAMPS = 512
+# Finished records buffered for heartbeat drain before the oldest drop.
+MAX_PENDING_RECORDS = 1024
+
+
+def sample_rate(environ=os.environ) -> float:
+    raw = environ.get(SAMPLE_ENV)
+    if raw is None:
+        return 1.0
+    try:
+        rate = float(raw)
+    except ValueError:
+        return 1.0
+    return min(1.0, max(0.0, rate))
+
+
+def head_sampled(request_id: str, rate: float) -> bool:
+    """Deterministic head-sampling verdict for one request id."""
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    h = zlib.crc32(str(request_id).encode("utf-8", "replace")) & 0xFFFFFFFF
+    return h < rate * 2.0**32
+
+
+def jsonl_max_bytes(environ=os.environ) -> int:
+    try:
+        return int(environ.get(EVENTS_MAX_ENV, DEFAULT_MAX_JSONL_BYTES))
+    except ValueError:
+        return DEFAULT_MAX_JSONL_BYTES
+
+
+class JsonlWriter:
+    """Append-mode JSONL writer with single-generation size rotation.
+
+    Once the file passes ``max_bytes`` it is renamed to ``<path>.1``
+    (replacing the previous rotation) and a fresh file is started, so
+    multi-day runs hold at most two generations on disk. ``max_bytes <=
+    0`` disables rotation. Used for ``events.jsonl`` and
+    ``requests.jsonl``.
+    """
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
+        self.path = path
+        self.max_bytes = jsonl_max_bytes() if max_bytes is None else int(max_bytes)
+        self.rotations = 0
+        self._fh = None
+        self._bytes = 0
+
+    def write(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(record, sort_keys=True) + "\n"
+        if self._fh is None:
+            self._open()
+        try:
+            self._fh.write(line)
+            self._fh.flush()
+        except (OSError, ValueError):
+            return
+        self._bytes += len(line)
+        if 0 < self.max_bytes <= self._bytes:
+            self._rotate()
+
+    def _open(self) -> None:
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        try:
+            self._bytes = self._fh.tell()
+        except OSError:
+            self._bytes = 0
+
+    def _rotate(self) -> None:
+        self.close()
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass
+        self.rotations += 1
+        self._bytes = 0
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except Exception:
+                pass
+            self._fh = None
+
+
+class RequestTrace:
+    """Mutable timeline of one in-flight request (perf_counter based,
+    anchored to a wall time at submit for trace export)."""
+
+    __slots__ = (
+        "request_id", "prompt_len", "max_new_tokens", "replica",
+        "submitted_wall", "_submitted", "_admitted", "_first_deferred",
+        "deferred_ticks", "prefill_s", "_prefill_done", "_first_token",
+        "_last_token", "tokens", "token_stamps", "slot",
+        "hbm_bytes_in_use",
+    )
+
+    def __init__(
+        self,
+        request_id: str,
+        prompt_len: int = 0,
+        max_new_tokens: int = 0,
+        replica: Optional[Any] = None,
+    ):
+        self.request_id = str(request_id)
+        self.prompt_len = int(prompt_len)
+        self.max_new_tokens = int(max_new_tokens)
+        self.replica = replica
+        self.submitted_wall = time.time()
+        self._submitted = time.perf_counter()
+        self._admitted: Optional[float] = None
+        self._first_deferred: Optional[float] = None
+        self.deferred_ticks = 0
+        self.prefill_s: Optional[float] = None
+        self._prefill_done: Optional[float] = None
+        self._first_token: Optional[float] = None
+        self._last_token: Optional[float] = None
+        self.tokens = 0
+        self.token_stamps: List[float] = []
+        self.slot: Optional[int] = None
+        self.hbm_bytes_in_use: Optional[int] = None
+
+    # ------------------------------------------------------------- #
+    # lifecycle stamps (called from scheduler/engine hot paths)
+    # ------------------------------------------------------------- #
+    def deferred(self) -> None:
+        """The scheduler peeked but could not admit (slot/block pressure)."""
+        self.deferred_ticks += 1
+        if self._first_deferred is None:
+            self._first_deferred = time.perf_counter()
+
+    def admitted(self, slot: Optional[int] = None) -> None:
+        if self._admitted is None:
+            self._admitted = time.perf_counter()
+            self.slot = slot
+            stats = metrics.last_device_memory()
+            if stats:
+                self.hbm_bytes_in_use = sum(s["bytes_in_use"] for s in stats)
+
+    def prefilled(self, duration_s: float) -> None:
+        self.prefill_s = float(duration_s)
+        self._prefill_done = time.perf_counter()
+
+    def token(self) -> None:
+        now = time.perf_counter()
+        if self._first_token is None:
+            self._first_token = now
+        elif len(self.token_stamps) < MAX_TOKEN_STAMPS:
+            self.token_stamps.append(now - self._first_token)
+        self.tokens += 1
+        self._last_token = now
+
+    # ------------------------------------------------------------- #
+    # derived timings
+    # ------------------------------------------------------------- #
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self._admitted is None:
+            return None
+        return self._admitted - self._submitted
+
+    @property
+    def deferred_wait_s(self) -> float:
+        if self._first_deferred is None:
+            return 0.0
+        end = self._admitted if self._admitted is not None else time.perf_counter()
+        return max(0.0, end - self._first_deferred)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self._first_token is None:
+            return None
+        return self._first_token - self._submitted
+
+    @property
+    def total_s(self) -> float:
+        end = self._last_token if self._last_token is not None else time.perf_counter()
+        return end - self._submitted
+
+    def itls(self) -> List[float]:
+        """Inter-token latencies reconstructed from the stamp list."""
+        prev = 0.0
+        out = []
+        for s in self.token_stamps:
+            out.append(s - prev)
+            prev = s
+        return out
+
+    def _wall(self, perf_t: float) -> float:
+        return self.submitted_wall + (perf_t - self._submitted)
+
+    def record(self, finish_reason: str) -> Dict[str, Any]:
+        """The finished-request JSON record (one ``requests.jsonl`` line)."""
+        itls = self.itls()
+        rec: Dict[str, Any] = {
+            "ts": round(self._wall(time.perf_counter()), 6),
+            "request_id": self.request_id,
+            "prompt_len": self.prompt_len,
+            "tokens_out": self.tokens,
+            "finish_reason": finish_reason,
+            "deferred_ticks": self.deferred_ticks,
+            "total_s": round(self.total_s, 6),
+        }
+        for key, val in (
+            ("queue_wait_s", self.queue_wait_s),
+            ("deferred_wait_s", self.deferred_wait_s or None),
+            ("prefill_s", self.prefill_s),
+            ("ttft_s", self.ttft_s),
+        ):
+            if val is not None:
+                rec[key] = round(val, 6)
+        if itls:
+            rec["itl_p50_ms"] = round(
+                metrics.percentile(itls, 50) * 1e3, 3
+            )
+            rec["itl_max_ms"] = round(max(itls) * 1e3, 3)
+        if self.slot is not None:
+            rec["slot"] = self.slot
+        if self.replica is not None:
+            rec["replica"] = self.replica
+        if self.hbm_bytes_in_use is not None:
+            rec["hbm_bytes_in_use"] = self.hbm_bytes_in_use
+        return rec
+
+    def emit_spans(self, recorder: trace.TraceRecorder, finish_reason: str) -> None:
+        """Replay the timeline into the trace ring as one track per request."""
+        track = f"req {self.request_id}"
+        if self._admitted is not None:
+            recorder.add_span(
+                "req/queue_wait",
+                self._wall(self._submitted),
+                self._admitted - self._submitted,
+                args={trace.TRACK_ARG: track},
+            )
+        if self._first_deferred is not None and self._admitted is not None:
+            recorder.add_span(
+                "req/deferred_block_wait",
+                self._wall(self._first_deferred),
+                self.deferred_wait_s,
+                args={trace.TRACK_ARG: track, "ticks": self.deferred_ticks},
+            )
+        if self.prefill_s is not None and self._prefill_done is not None:
+            recorder.add_span(
+                "req/prefill",
+                self._wall(self._prefill_done - self.prefill_s),
+                self.prefill_s,
+                args={trace.TRACK_ARG: track, "prompt_len": self.prompt_len},
+            )
+        if self._first_token is not None:
+            end = self._last_token or self._first_token
+            args: Dict[str, Any] = {
+                trace.TRACK_ARG: track,
+                "tokens": self.tokens,
+                "reason": finish_reason,
+            }
+            if self.ttft_s is not None:
+                args["ttft_ms"] = round(self.ttft_s * 1e3, 3)
+            stamps = self.token_stamps[:128]
+            if stamps:
+                args["itl_stamps_ms"] = [round(s * 1e3, 3) for s in stamps]
+            recorder.add_span(
+                "req/decode",
+                self._wall(self._first_token),
+                end - self._first_token,
+                args=args,
+            )
+
+
+class RequestTracer:
+    """Per-engine request-trace book: sampling at submit, span + record
+    emission at finish, bounded pending buffer for heartbeat drain."""
+
+    def __init__(
+        self,
+        out_dir: Optional[str] = None,
+        rate: Optional[float] = None,
+    ):
+        self.rate = sample_rate() if rate is None else min(1.0, max(0.0, rate))
+        self._writer = (
+            JsonlWriter(os.path.join(out_dir, REQUESTS_FILE)) if out_dir else None
+        )
+        self._pending: deque = deque(maxlen=MAX_PENDING_RECORDS)
+        self.started_total = 0
+        self.sampled_total = 0
+        self.finished_total = 0
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._writer.path if self._writer else None
+
+    def start(
+        self,
+        request_id: str,
+        prompt_len: int = 0,
+        max_new_tokens: int = 0,
+        replica: Optional[Any] = None,
+    ) -> Optional[RequestTrace]:
+        """Mint a trace for a new request, or ``None`` when head sampling
+        drops it (the request then costs one attribute check per tick)."""
+        self.started_total += 1
+        if not head_sampled(request_id, self.rate):
+            return None
+        self.sampled_total += 1
+        return RequestTrace(request_id, prompt_len, max_new_tokens, replica)
+
+    def finish(self, tr: RequestTrace, finish_reason: str) -> Dict[str, Any]:
+        recorder = trace.get_recorder()
+        if recorder is not None:
+            tr.emit_spans(recorder, finish_reason)
+        rec = tr.record(finish_reason)
+        self.finished_total += 1
+        self._pending.append(rec)
+        if self._writer is not None:
+            self._writer.write(rec)
+        return rec
+
+    def drain(self) -> List[Dict[str, Any]]:
+        """Pop buffered finished-request records (for a heartbeat payload)."""
+        out: List[Dict[str, Any]] = []
+        pending = self._pending
+        while True:
+            try:
+                out.append(pending.popleft())
+            except IndexError:
+                return out
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+
+def read_requests(path: str, limit: int = 0) -> List[Dict[str, Any]]:
+    """Load a ``requests.jsonl`` (including its ``.1`` rotation if
+    present), oldest first; bad lines are skipped."""
+    out: List[Dict[str, Any]] = []
+    for p in (path + ".1", path):
+        if not os.path.exists(p):
+            continue
+        with open(p, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    if limit > 0:
+        out = out[-limit:]
+    return out

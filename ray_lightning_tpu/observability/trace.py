@@ -36,6 +36,11 @@ ENABLE_ENV = "RLT_TELEMETRY"
 # rank label used for the driver process's track in the merged trace
 DRIVER = "driver"
 
+# reserved span/event arg: events carrying it are routed onto a named
+# sub-track (Chrome "thread") of their rank's process instead of tid 0 —
+# this is how per-request serving timelines get their own Perfetto track
+TRACK_ARG = "track"
+
 
 def env_enabled(environ=os.environ) -> bool:
     return str(environ.get(ENABLE_ENV, "")).strip().lower() in (
@@ -202,27 +207,50 @@ def _pid_for(rank) -> int:
 def to_chrome_events(
     rank, events: Iterable[TraceTuple], skew: float = 0.0
 ) -> List[Dict[str, Any]]:
-    """One rank's trace tuples -> Chrome trace event dicts (ts/dur in µs)."""
+    """One rank's trace tuples -> Chrome trace event dicts (ts/dur in µs).
+
+    Events whose args carry :data:`TRACK_ARG` are assigned a stable
+    per-track tid (> 0) within the rank's process, with ``thread_name``
+    metadata appended, so each named track (e.g. one serving request)
+    renders as its own row under the rank's process in Perfetto.
+    """
     pid = _pid_for(rank)
     out: List[Dict[str, Any]] = []
+    tracks: Dict[str, int] = {}
     for kind, name, wall, dur, step, args in events:
+        a = dict(args) if args else {}
+        track = a.pop(TRACK_ARG, None)
+        tid = 0
+        if track is not None:
+            track = str(track)
+            tid = tracks.get(track)
+            if tid is None:
+                tid = tracks[track] = len(tracks) + 1
         ev: Dict[str, Any] = {
             "name": name,
             "ph": kind,
             "ts": (wall - skew) * 1e6,
             "pid": pid,
-            "tid": 0,
+            "tid": tid,
         }
         if kind == "X":
             ev["dur"] = dur * 1e6
         elif kind == "i":
             ev["s"] = "t"
-        a = dict(args) if args else {}
         if step is not None:
             a["step"] = int(step)
         if a:
             ev["args"] = a
         out.append(ev)
+    for track, tid in tracks.items():
+        out.append(
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": track}}
+        )
+        out.append(
+            {"name": "thread_sort_index", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"sort_index": tid}}
+        )
     return out
 
 

@@ -127,6 +127,7 @@ class Supervisor:
         label: str = "workers",
         aggregator: Optional[object] = None,
         on_hung: Optional[Callable[[List[int]], bool]] = None,
+        slo_monitor: Optional[object] = None,
     ):
         # a timeout below a couple of heartbeat periods would flag healthy
         # workers; clamp rather than error so the knobs stay independent.
@@ -143,6 +144,12 @@ class Supervisor:
         self._is_alive = is_alive
         self._label = label
         self._aggregator = aggregator
+        # Optional slo.SLOMonitor evaluated on every check() pass — lets
+        # monitor-only supervisors surface burn-rate verdicts even when
+        # their aggregator was not built with one. The aggregator's own
+        # monitor (if any) takes precedence; don't double-wire the same
+        # monitor in both places or verdicts are recorded twice.
+        self._slo_monitor = slo_monitor
         # Elastic hook: given the hung ranks, return True if they were
         # absorbed (group shrank around them) — the supervisor then forgets
         # those ranks and keeps watching instead of tripping the group.
@@ -217,6 +224,12 @@ class Supervisor:
         now = time.monotonic() if now is None else now
         out: Dict[int, str] = {}
         agg = self._aggregator
+        if self._slo_monitor is not None:
+            try:
+                for v in self._slo_monitor.evaluate():
+                    self._record_event(v.pop("event"), **v)
+            except Exception:  # SLO math must never break supervision
+                logger.debug("slo evaluate failed", exc_info=True)
         # snapshot: track_rank/forget_rank may mutate concurrently
         for rank, h in list(self.health.items()):
             if agg is not None and h.last_beat is not None:

@@ -63,6 +63,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ray_lightning_tpu import observability as _obs
+from ray_lightning_tpu.observability import reqtrace as _reqtrace
 from ray_lightning_tpu.serving.kv_pool import KVSlotPool
 from ray_lightning_tpu.serving.paged_kv import PagedKVPool
 from ray_lightning_tpu.serving.scheduler import (
@@ -249,6 +250,11 @@ class InferenceEngine:
         self._stop_when_idle = False
         # recent TTFTs for the autoscaler's p95 signal (host-side, tiny)
         self._recent_ttfts: deque = deque(maxlen=128)
+        # request-scoped tracing: None when telemetry is off, so every
+        # per-request/per-token trace site stays a single attribute check
+        self._tracer: Optional[_reqtrace.RequestTracer] = (
+            _reqtrace.RequestTracer() if _obs.enabled() else None
+        )
         # throughput/utilization accounting (host side, always on)
         self.stats: Dict[str, float] = {
             "decode_steps": 0,
@@ -414,6 +420,10 @@ class InferenceEngine:
             eos_id=eos_id,
             on_token=on_token,
         )
+        if self._tracer is not None:
+            req.trace = self._tracer.start(
+                rid, len(tokens), int(max_new_tokens)
+            )
         with self._work:
             if self._closed:
                 raise EngineClosed(
@@ -451,6 +461,8 @@ class InferenceEngine:
         for req, slot in plan.prefills:
             padded = np.zeros((1, ecfg.max_prompt_len), np.int32)
             padded[0, : req.prompt_len] = req.tokens
+            tr = req.trace
+            t0 = time.perf_counter() if tr is not None else 0.0
             with _obs.span("serve_prefill", prompt_len=req.prompt_len):
                 if paged:
                     wt = self.pool.prompt_write_table(
@@ -465,6 +477,8 @@ class InferenceEngine:
                         self.params, ck, cv, jnp.asarray(padded),
                         jnp.int32(slot.index),
                     )
+            if tr is not None:
+                tr.prefilled(time.perf_counter() - t0)
             slot.pos = req.prompt_len - 1
             slot.pending_token = req.tokens[-1]
             self.stats["prefills"] += 1
@@ -509,11 +523,15 @@ class InferenceEngine:
                             reg.histogram(
                                 "rlt_serve_ttft_seconds",
                                 bounds=LATENCY_BOUNDS,
-                            ).observe(completion.ttft_s)
+                            ).observe(
+                                completion.ttft_s, exemplar=slot.request_id
+                            )
                     elif reg is not None and slot.last_token_at is not None:
                         reg.histogram(
                             "rlt_serve_itl_seconds", bounds=LATENCY_BOUNDS
-                        ).observe(now - slot.last_token_at)
+                        ).observe(
+                            now - slot.last_token_at, exemplar=slot.request_id
+                        )
                 cb = self._on_token.get(slot.request_id)
                 if cb is not None:
                     try:
@@ -523,6 +541,9 @@ class InferenceEngine:
                 if slot.first_token_at is None:
                     slot.first_token_at = now
                 slot.last_token_at = now
+                tr = slot.trace
+                if tr is not None:
+                    tr.token()
                 slot.generated += 1
                 slot.pos += 1
                 slot.pending_token = tok
@@ -537,6 +558,8 @@ class InferenceEngine:
                 if reason is not None:
                     completed.append(slot.request_id)
                     self._finish(slot.request_id, reason)
+                    if tr is not None:
+                        self._tracer.finish(tr, reason)
                     self.pool.release(slot.index)
             self.stats["decode_steps"] += 1
             self.stats["busy_slot_steps"] += len(plan.decode_slots)
@@ -592,8 +615,12 @@ class InferenceEngine:
     def _fail_all(self, error: BaseException) -> None:
         for req in self.scheduler.drain_queue():
             self._finish(req.request_id, "error", error)
+            if req.trace is not None:
+                self._tracer.finish(req.trace, "error")
         for slot in self.pool.active_slots():
             self._finish(slot.request_id, "error", error)
+            if slot.trace is not None:
+                self._tracer.finish(slot.trace, "error")
             self.pool.release(slot.index)
 
     def run_until_idle(self, max_steps: int = 100_000) -> None:
@@ -651,6 +678,17 @@ class InferenceEngine:
             "active": self.pool.occupancy,
             "ttft_p95_ms": round(p95, 3),
         }
+
+    def drain_request_records(self) -> List[Dict[str, Any]]:
+        """Pop finished-request trace records (``requests.jsonl`` lines).
+
+        Empty when telemetry is off. Replica beat loops ship these to the
+        driver aggregator; local callers can hand them to
+        ``observability.aggregator.write_local_dump``.
+        """
+        if self._tracer is None:
+            return []
+        return self._tracer.drain()
 
     def slot_utilization(self) -> float:
         steps = self.stats["decode_steps"]

@@ -54,6 +54,10 @@ class Slot:
     admitted_at: float = 0.0
     first_token_at: Optional[float] = None
     last_token_at: Optional[float] = None
+    # the tenant's RequestTrace (None when telemetry is off or the
+    # request was not head-sampled) — the decode loop's only per-token
+    # tracing cost is reading this attribute
+    trace: Optional[object] = None
 
     @property
     def occupied(self) -> bool:
@@ -70,6 +74,7 @@ class Slot:
         self.admitted_at = 0.0
         self.first_token_at = None
         self.last_token_at = None
+        self.trace = None
 
 
 class KVSlotPool:

@@ -87,18 +87,22 @@ def autoscale_decision(
     max_replicas: int,
     queue_high: float = 4.0,
     ttft_high_ms: Optional[float] = None,
+    slo_breached: bool = False,
 ) -> int:
     """Pure scaling verdict: +1 (add a replica), -1 (drain one), or 0.
 
     Scale UP when demand outruns the fleet — mean queue depth per
-    replica exceeds ``queue_high``, or any replica's recent TTFT p95
+    replica exceeds ``queue_high``, any replica's recent TTFT p95
     exceeds ``ttft_high_ms`` (latency degrades before queues explode
-    when prompts are long). Scale DOWN only when the fleet is completely
-    idle (zero queued AND zero active everywhere): a drain on a busy
-    replica would trade capacity for nothing. Bounds are clamped to
-    [min_replicas, max_replicas]; hysteresis (cooldowns, consecutive
-    idle ticks) is the :class:`Autoscaler`'s job, not this function's —
-    keeping the verdict stateless is what makes it unit-testable."""
+    when prompts are long), or an SLO burn-rate breach is firing
+    (``slo_breached``, see :mod:`~..observability.slo` — a principled
+    verdict rather than a raw percentile). Scale DOWN only when the
+    fleet is completely idle (zero queued AND zero active everywhere)
+    and no SLO is burning: a drain on a busy or breaching fleet would
+    trade capacity for nothing. Bounds are clamped to [min_replicas,
+    max_replicas]; hysteresis (cooldowns, consecutive idle ticks) is the
+    :class:`Autoscaler`'s job, not this function's — keeping the verdict
+    stateless is what makes it unit-testable."""
     if min_replicas < 1:
         raise ValueError("min_replicas must be >= 1")
     if max_replicas < min_replicas:
@@ -110,12 +114,15 @@ def autoscale_decision(
         (float(e.get("ttft_p95_ms", 0.0)) for e in entries), default=0.0
     )
     if num_replicas < max_replicas:
+        if slo_breached:
+            return 1
         if total_queued / max(num_replicas, 1) > queue_high:
             return 1
         if ttft_high_ms is not None and worst_ttft > ttft_high_ms:
             return 1
     if (
         num_replicas > min_replicas
+        and not slo_breached
         and total_queued == 0
         and total_active == 0
     ):
@@ -150,6 +157,7 @@ class Autoscaler:
         ttft_high_ms: Optional[float] = None,
         cooldown_s: float = 0.0,
         idle_ticks_down: int = 2,
+        slo_monitor: Optional[Any] = None,
     ):
         if idle_ticks_down < 1:
             raise ValueError("idle_ticks_down must be >= 1")
@@ -160,6 +168,9 @@ class Autoscaler:
         self.ttft_high_ms = ttft_high_ms
         self.cooldown_s = float(cooldown_s)
         self.idle_ticks_down = int(idle_ticks_down)
+        # optional observability.slo.SLOMonitor: a firing burn-rate
+        # breach forces scale-up and vetoes idle scale-down
+        self.slo_monitor = slo_monitor
         self._last_action_at: Optional[float] = None
         self._idle_streak = 0
         self.scale_ups = 0
@@ -170,6 +181,10 @@ class Autoscaler:
         """Evaluate once; returns the applied delta (-1, 0, +1)."""
         now = time.monotonic() if now is None else now
         n = int(self.fleet.num_replicas)
+        slo_breached = False
+        if self.slo_monitor is not None:
+            self.slo_monitor.evaluate(reg=_obs.registry())
+            slo_breached = self.slo_monitor.breached()
         delta = autoscale_decision(
             self.fleet.loads(),
             n,
@@ -177,6 +192,7 @@ class Autoscaler:
             self.max_replicas,
             queue_high=self.queue_high,
             ttft_high_ms=self.ttft_high_ms,
+            slo_breached=slo_breached,
         )
         if delta < 0:
             self._idle_streak += 1
@@ -371,9 +387,14 @@ class LocalReplicaFleet:
             self._rr += 1
         loads = {i: eng.load() for i, eng in replicas.items()}
         index = pick_least_loaded(loads, 0, rr, indices=list(replicas))
-        return replicas[index].submit(
+        completion = replicas[index].submit(
             prompt_tokens, max_new_tokens=max_new_tokens, eos_id=eos_id
         )
+        _obs.event(
+            "req/route", request_id=completion.request_id, replica=index,
+            track=f"req {completion.request_id}",
+        )
+        return completion
 
     def shutdown(self) -> None:
         with self._lock:
@@ -432,10 +453,14 @@ class ServeReplicaActor:
 
     def _beat_loop(self) -> None:
         while not self._hb_stop.wait(self._hb_interval):
+            _obs.sample_device_memory()  # HBM gauges ride the beat
             payload: Dict[str, Any] = {"load": self.engine.load()}
             telemetry = _obs.collect_beat_payload()
             if telemetry is not None:
                 payload.update(telemetry)
+            records = self.engine.drain_request_records()
+            if records:
+                payload["r"] = records
             try:
                 self._hb.put(
                     (
@@ -787,6 +812,13 @@ class ReplicaGroup:
             handle
             .submit.remote(list(prompt_tokens), max_new_tokens, eos_id)
             .result(timeout=30)
+        )
+        # routing leg of the request trace: an instant on the request's
+        # own track in the DRIVER process (the engine-side spans live in
+        # the replica's process)
+        _obs.event(
+            "req/route", request_id=rid, replica=replica,
+            track=f"req {rid}",
         )
         with self._lock:
             self._inflight[rid] = replica

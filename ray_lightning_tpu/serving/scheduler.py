@@ -45,6 +45,9 @@ class Request:
     eos_id: Optional[int] = None
     on_token: Optional[Callable[[str, int], Any]] = None
     submitted_at: float = field(default_factory=time.perf_counter)
+    # request-scoped trace context (reqtrace.RequestTrace), minted at
+    # engine submit; None when telemetry is off or head sampling dropped it
+    trace: Optional[Any] = None
 
     @property
     def prompt_len(self) -> int:
@@ -148,8 +151,13 @@ class ContinuousBatchScheduler:
                 )
                 if slot is None:  # back-pressure: keep the head queued
                     self.deferred_total += 1
+                    if req.trace is not None:
+                        req.trace.deferred()
                     break
                 self._queue.popleft()
+                if req.trace is not None:
+                    req.trace.admitted(slot.index)
+                    slot.trace = req.trace
                 prefills.append((req, slot))
             depth = len(self._queue)
         self._publish_depth(depth)

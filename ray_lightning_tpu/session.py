@@ -79,6 +79,7 @@ class RayLightningSession:
         if faults.heartbeats_dropped(step):
             return
         self._last_beat = now
+        _obs.sample_device_memory()  # HBM gauges ride the beat payload
         payload = _obs.collect_beat_payload()
         beat = (
             (self._rank, int(step), time.time())
@@ -98,6 +99,7 @@ class RayLightningSession:
         like every other beat."""
         if self._heartbeat is None:
             return
+        _obs.sample_device_memory(force=True)
         payload = _obs.collect_beat_payload(final=True)
         if payload is None:
             return

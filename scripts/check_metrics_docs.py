@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Fail when a ``rlt_*`` metric emitted by the package is missing from
+the metric table in docs/observability.md.
+
+Run directly (``python scripts/check_metrics_docs.py``) or via the
+tier-1 test that wraps it (tests/test_observability.py) so metric/docs
+drift fails CI instead of rotting silently.
+
+Only metric EMISSION sites count: a complete ``rlt_*`` literal passed to
+a registry ``counter(`` / ``gauge(`` / ``histogram(`` call, or assigned
+to a ``*_METRIC*`` constant. Log strings that merely start with
+``rlt_`` (e.g. ``f"rlt_queue_push failed: ..."``) and unrelated dict
+keys (``"rlt_version"``) are not false positives.
+"""
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "ray_lightning_tpu"
+DOCS = REPO / "docs" / "observability.md"
+
+# a metric name is the ENTIRE quoted literal, nothing more
+_METRIC_LITERAL = re.compile(r"""["'](rlt_[a-z0-9_]+)["']""")
+# registry emission call (possibly line-wrapped after the paren)
+_EMIT_CALL = re.compile(
+    r"""\.(?:counter|gauge|histogram)\(\s*["'](rlt_[a-z0-9_]+)["']"""
+)
+# module-level metric-name constant, e.g. BURN_RATE_METRIC = "rlt_..."
+_METRIC_CONST = re.compile(
+    r"""[A-Z][A-Z0-9_]*METRIC[A-Z0-9_]*\s*=\s*["'](rlt_[a-z0-9_]+)["']"""
+)
+
+
+def emitted_metrics(package: Path = PACKAGE) -> set:
+    names = set()
+    for path in sorted(package.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        names.update(_EMIT_CALL.findall(text))
+        names.update(_METRIC_CONST.findall(text))
+    return names
+
+
+def documented_metrics(docs: Path = DOCS) -> set:
+    return set(_METRIC_LITERAL.findall(docs.read_text(encoding="utf-8")) ) | {
+        m.group(1)
+        for m in re.finditer(r"`(rlt_[a-z0-9_]+)`", docs.read_text(encoding="utf-8"))
+    }
+
+
+def main() -> int:
+    emitted = emitted_metrics()
+    documented = documented_metrics()
+    missing = sorted(emitted - documented)
+    if missing:
+        print(
+            "metrics emitted by ray_lightning_tpu but absent from "
+            f"{DOCS.relative_to(REPO)}:"
+        )
+        for name in missing:
+            print(f"  {name}")
+        print(
+            "\nadd each to the 'Metric name reference' table (or rename "
+            "the metric)."
+        )
+        return 1
+    stale = sorted(documented - emitted)
+    if stale:
+        # documented-but-not-emitted is a warning, not a failure: docs may
+        # legitimately mention label values or externally-derived names
+        print("note: documented but not found as a literal in the package:")
+        for name in stale:
+            print(f"  {name}")
+    print(f"ok: {len(emitted)} emitted metrics all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -152,17 +152,39 @@ def test_prometheus_text_golden():
     h.observe(0.5)
     h.observe(5.0)
     assert reg.prometheus_text() == (
+        "# HELP rlt_lat rlt lat\n"
         "# TYPE rlt_lat histogram\n"
         'rlt_lat_bucket{le="0.1"} 1\n'
         'rlt_lat_bucket{le="1"} 2\n'
         'rlt_lat_bucket{le="+Inf"} 3\n'
         "rlt_lat_sum 5.55\n"
         "rlt_lat_count 3\n"
+        "# HELP rlt_mfu rlt mfu\n"
         "# TYPE rlt_mfu gauge\n"
         "rlt_mfu 0.5\n"
+        "# HELP rlt_saves_total rlt saves total\n"
         "# TYPE rlt_saves_total counter\n"
         'rlt_saves_total{format="orbax"} 2\n'
     )
+
+
+def test_prometheus_text_escapes_label_values():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("rlt_odd_total", path='a\\b"c\nd').inc()
+    text = reg.prometheus_text()
+    assert 'path="a\\\\b\\"c\\nd"' in text
+    # the emitted line itself holds no raw newline inside the label value
+    assert 'rlt_odd_total{path="a\\\\b\\"c\\nd"} 1' in text.splitlines()
+
+
+def test_prometheus_help_registry():
+    obs_metrics.set_help("rlt_custom_total", "my help text")
+    try:
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter("rlt_custom_total").inc()
+        assert "# HELP rlt_custom_total my help text" in reg.prometheus_text()
+    finally:
+        obs_metrics.HELP.pop("rlt_custom_total", None)
 
 
 def test_collect_beat_payload_roundtrip():
@@ -505,3 +527,275 @@ def test_local_fit_telemetry_dump(tmp_root):
     metrics_doc = json.load(open(os.path.join(run_dir, METRICS_FILE)))
     hists = metrics_doc["per_rank_histograms"][STEP_TIME_METRIC]
     assert hists['{rank="0"}']["count"] > 0
+
+
+# --------------------------------------------------------------------- #
+# request-scoped tracing: sampling, jsonl plumbing, per-request tracks
+# --------------------------------------------------------------------- #
+def test_head_sampling_deterministic_and_env_rate(monkeypatch):
+    from ray_lightning_tpu.observability import reqtrace
+
+    assert reqtrace.head_sampled("anything", 1.0)
+    assert not reqtrace.head_sampled("anything", 0.0)
+    # same id -> same verdict every time (a request is all-or-nothing)
+    verdicts = {reqtrace.head_sampled("req-7", 0.5) for _ in range(10)}
+    assert len(verdicts) == 1
+    # ~half of a large id population at rate 0.5
+    kept = sum(reqtrace.head_sampled(f"req-{i}", 0.5) for i in range(1000))
+    assert 350 < kept < 650
+    monkeypatch.setenv(reqtrace.SAMPLE_ENV, "2.5")
+    assert reqtrace.sample_rate() == 1.0  # clamped
+    monkeypatch.setenv(reqtrace.SAMPLE_ENV, "junk")
+    assert reqtrace.sample_rate() == 1.0
+    monkeypatch.setenv(reqtrace.SAMPLE_ENV, "0.25")
+    assert reqtrace.sample_rate() == 0.25
+
+
+def test_jsonl_writer_rotation_and_read_requests(tmp_path):
+    from ray_lightning_tpu.observability import reqtrace
+
+    path = str(tmp_path / "requests.jsonl")
+    w = reqtrace.JsonlWriter(path, max_bytes=200)
+    for i in range(20):
+        w.write({"request_id": f"r{i}", "pad": "x" * 40})
+    w.close()
+    assert w.rotations >= 1
+    assert os.path.exists(path + ".1")
+    records = reqtrace.read_requests(path)
+    # rotation keeps at most two generations but never loses the newest
+    assert records[-1]["request_id"] == "r19"
+    assert reqtrace.read_requests(path, limit=3) == records[-3:]
+    assert reqtrace.read_requests(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_histogram_pending_cap_and_exemplars():
+    h = obs_metrics.Histogram(bounds=(0.1, 1.0), pending_cap=5)
+    for i in range(50):
+        h.observe(0.05)
+    assert len(h.pending) == 5  # capped; cumulative state still full
+    assert h.count == 50
+    h.observe(0.5, exemplar="mid")
+    for i in range(5):
+        h.observe(2.0, exemplar=f"slow-{i}")
+    # per-bucket exemplars keep the last few ids only
+    assert h.bucket_exemplars(lower_than=1.0) == ["slow-4", "slow-3", "slow-2"]
+    assert "mid" in h.bucket_exemplars()
+    # exemplars survive the snapshot -> merge round trip with rank labels
+    reg = obs_metrics.MetricsRegistry()
+    reg._metrics[("rlt_lat", ())] = h
+    driver = obs_metrics.MetricsRegistry()
+    driver.merge_snapshot(
+        json.loads(json.dumps(reg.snapshot())), extra_labels={"rank": 0}
+    )
+    merged = driver.get("rlt_lat", rank=0)
+    assert merged.bucket_exemplars(lower_than=1.0) == [
+        "slow-4", "slow-3", "slow-2"
+    ]
+
+
+def test_request_trace_record_fields():
+    from ray_lightning_tpu.observability import reqtrace
+
+    tr = reqtrace.RequestTrace("r1", prompt_len=3, max_new_tokens=4)
+    tr.deferred()
+    tr.deferred()
+    tr.admitted(slot=2)
+    tr.prefilled(0.01)
+    for _ in range(3):
+        tr.token()
+    rec = tr.record("length")
+    assert rec["request_id"] == "r1"
+    assert rec["prompt_len"] == 3 and rec["tokens_out"] == 3
+    assert rec["finish_reason"] == "length"
+    assert rec["deferred_ticks"] == 2 and rec["slot"] == 2
+    assert rec["queue_wait_s"] >= 0 and rec["ttft_s"] >= 0
+    assert rec["total_s"] >= rec["ttft_s"]
+    assert "itl_p50_ms" in rec and "itl_max_ms" in rec
+
+
+def test_request_tracer_sampling_and_drain(tmp_path):
+    from ray_lightning_tpu.observability import reqtrace
+
+    t = reqtrace.RequestTracer(out_dir=str(tmp_path), rate=0.0)
+    assert t.start("r1") is None  # unsampled -> one attribute check per tick
+    t = reqtrace.RequestTracer(out_dir=str(tmp_path), rate=1.0)
+    tr = t.start("r2", prompt_len=2, max_new_tokens=2)
+    tr.admitted(slot=0)
+    tr.token()
+    t.finish(tr, "eos")
+    t.close()
+    drained = t.drain()
+    assert [r["request_id"] for r in drained] == ["r2"]
+    assert t.drain() == []  # drain pops
+    on_disk = reqtrace.read_requests(t.path)
+    assert [r["request_id"] for r in on_disk] == ["r2"]
+
+
+def test_request_tracks_roundtrip_trace_json(tmp_path):
+    """Per-request spans tagged with the track arg render as their own
+    named Perfetto thread rows after a full write-to-disk round trip."""
+    from ray_lightning_tpu.observability import reqtrace
+
+    obs.enable()
+    tracer = reqtrace.RequestTracer()
+    tr = tracer.start("r9", prompt_len=4, max_new_tokens=3)
+    tr.deferred()
+    tr.admitted(slot=1)
+    tr.prefilled(0.002)
+    for _ in range(3):
+        tr.token()
+    tracer.finish(tr, "length")
+    run_dir = write_local_dump(
+        str(tmp_path / "t"), obs.get_recorder(), obs.registry()
+    )
+    trace = json.load(open(os.path.join(run_dir, TRACE_FILE)))
+    threads = {
+        e["args"]["name"]: e["tid"]
+        for e in trace["traceEvents"]
+        if e.get("name") == "thread_name"
+    }
+    assert "req r9" in threads and threads["req r9"] > 0
+    req_spans = {
+        e["name"]
+        for e in trace["traceEvents"]
+        if e["ph"] == "X" and e.get("tid") == threads["req r9"]
+    }
+    assert {
+        "req/queue_wait", "req/deferred_block_wait", "req/prefill",
+        "req/decode",
+    } <= req_spans
+    decode = next(
+        e for e in trace["traceEvents"]
+        if e["ph"] == "X" and e["name"] == "req/decode"
+    )
+    assert decode["args"]["tokens"] == 3
+    assert decode["args"]["reason"] == "length"
+    assert "ttft_ms" in decode["args"]
+
+
+def test_aggregator_negative_skew_alignment_with_tracks(tmp_path):
+    """A rank whose clock runs AHEAD of the driver (negative correction)
+    still lands its spans — including per-request tracks — on the driver
+    timeline next to a well-synced rank's."""
+    run_dir = str(tmp_path / "telemetry")
+    agg = DriverAggregator(run_dir, num_workers=2)
+    now = time.time()
+    track_args = {"track": "req rA"}
+    for i in range(3):
+        # rank 0's wall clock reads 5s in the future at the same instant
+        agg.on_beat(
+            0, i, now + 5.0 + i * 0.01, recv_wall=now + i * 0.01,
+            payload={
+                "t": [("X", "req/decode", now + 5.0, 0.5, None, track_args)],
+                "m": None,
+            },
+        )
+        agg.on_beat(
+            1, i, now + i * 0.01, recv_wall=now + i * 0.01,
+            payload={
+                "t": [("X", "req/decode", now, 0.5, None, dict(track_args))],
+                "m": None,
+            },
+        )
+    skews = agg.skew_by_rank()
+    assert skews[0] == pytest.approx(5.0, abs=0.02)
+    assert skews[1] == pytest.approx(0.0, abs=0.02)
+    agg.finalize()
+    trace = json.load(open(os.path.join(run_dir, TRACE_FILE)))
+    spans = [e for e in trace["traceEvents"]
+             if e["ph"] == "X" and e["name"] == "req/decode"]
+    ts_by_pid = {}
+    for e in spans:
+        ts_by_pid.setdefault(e["pid"], e["ts"])
+    a, b = list(ts_by_pid.values())[:2]
+    # skew-corrected: both ranks' spans land on the same driver instant
+    assert a == pytest.approx(b, abs=0.05 * 1e6)
+    # each rank got its own named request track
+    names = [e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("name") == "thread_name"]
+    assert names.count("req rA") == 2
+
+
+def test_device_memory_gauges(monkeypatch):
+    fake = [
+        {"device": "tpu:0", "bytes_in_use": 100, "peak_bytes": 200,
+         "bytes_limit": 1000},
+        {"device": "tpu:1", "bytes_in_use": 50, "peak_bytes": 300,
+         "bytes_limit": 1000},
+    ]
+    monkeypatch.setattr(obs_metrics, "device_memory_stats", lambda: fake)
+    obs.enable()
+    reg = obs.registry()
+    obs.sample_device_memory(force=True)
+    assert reg.get(
+        obs_metrics.HBM_IN_USE_METRIC, device="tpu:0"
+    ).value == 100
+    assert reg.get(obs_metrics.HBM_PEAK_METRIC, device="tpu:1").value == 300
+    # throttle: within the interval the cache answers, no device poll
+    calls = []
+    monkeypatch.setattr(
+        obs_metrics, "device_memory_stats",
+        lambda: calls.append(1) or fake,
+    )
+    obs.sample_device_memory()
+    assert calls == []
+    assert obs_metrics.last_device_memory() == fake
+    assert calls == []  # admission-path read never touches the device
+
+
+def test_aggregator_request_records_and_hbm_fold(tmp_path):
+    from ray_lightning_tpu.observability.aggregator import REQUESTS_FILE
+    from ray_lightning_tpu.observability import reqtrace
+
+    run_dir = str(tmp_path / "telemetry")
+    agg = DriverAggregator(run_dir, num_workers=1)
+    reg = obs_metrics.MetricsRegistry()
+    reg.gauge(obs_metrics.HBM_IN_USE_METRIC, device="tpu:0").set(100)
+    reg.gauge(obs_metrics.HBM_IN_USE_METRIC, device="tpu:1").set(900)
+    agg.on_beat(
+        0, 1, time.time(),
+        payload={
+            "m": reg.snapshot(),
+            "r": [{"request_id": "r1", "ttft_s": 0.5,
+                   "finish_reason": "eos"}],
+        },
+    )
+    summary = agg.summary()
+    assert summary["per_rank"]["0"]["hbm_bytes_in_use"] == 900  # worst device
+    assert summary["cluster"]["requests_total"] == 1
+    agg.finalize()
+    records = reqtrace.read_requests(os.path.join(run_dir, REQUESTS_FILE))
+    assert records[0]["request_id"] == "r1" and records[0]["rank"] == 0
+
+
+def test_check_metrics_docs_script():
+    """The docs-drift gate: every emitted rlt_* metric is documented."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "check_metrics_docs.py")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_requests_subcommand(tmp_path, capsys):
+    from ray_lightning_tpu.cli import main
+    from ray_lightning_tpu.observability import reqtrace
+
+    w = reqtrace.JsonlWriter(str(tmp_path / reqtrace.REQUESTS_FILE))
+    w.write({"request_id": "fast", "ttft_s": 0.1, "total_s": 0.2,
+             "prompt_len": 2, "tokens_out": 4, "finish_reason": "eos"})
+    w.write({"request_id": "slow", "ttft_s": 1.5, "total_s": 2.0,
+             "prompt_len": 8, "tokens_out": 16, "finish_reason": "length"})
+    w.close()
+    assert main(["requests", "--dir", str(tmp_path), "--limit", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "slow" in out and "fast" not in out  # sorted by ttft desc
+    assert main(["requests", "--dir", str(tmp_path), "--json"]) == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert [r["request_id"] for r in lines] == ["slow", "fast"]
+    assert main(["requests", "--dir", str(tmp_path / "empty")]) == 1
+    capsys.readouterr()

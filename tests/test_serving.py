@@ -608,3 +608,143 @@ def test_local_fleet_autoscales_up_and_drains_down(model):
         assert fleet.removed_total == fleet.added_total - 1
     finally:
         fleet.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# request-scoped tracing: the observability acceptance e2e
+# --------------------------------------------------------------------- #
+def test_engine_request_tracing_e2e(model, tmp_path):
+    """With telemetry on, every request lands a requests.jsonl record and
+    its own Perfetto track (queue/prefill/decode spans) in trace.json —
+    and the two-program zero-recompile contract holds with tracing on."""
+    import json
+    import os
+
+    from ray_lightning_tpu import observability as obs
+    from ray_lightning_tpu.observability import reqtrace
+    from ray_lightning_tpu.observability.aggregator import (
+        REQUESTS_FILE, TRACE_FILE, write_local_dump,
+    )
+
+    params, cfg = model
+    obs.reset()
+    obs.enable()
+    try:
+        engine = InferenceEngine(
+            params, cfg, EngineConfig(num_slots=2, max_prompt_len=8, max_len=32)
+        )
+        prompts = [[1, 2, 3], [4, 5], [6], [7, 8, 9, 10]]
+        cs = [
+            engine.submit(p, max_new_tokens=2 + i % 3, request_id=f"rq{i}")
+            for i, p in enumerate(prompts)
+        ]
+        engine.run_until_idle()
+        assert all(c.done for c in cs)
+        # tracing must not perturb the compiled-program contract
+        assert engine.compile_stats() == {
+            "prefill_compiles": 1, "decode_compiles": 1,
+        }
+        run_dir = write_local_dump(
+            str(tmp_path / "t"), obs.get_recorder(), obs.registry(),
+            requests=engine.drain_request_records(),
+        )
+        records = reqtrace.read_requests(os.path.join(run_dir, REQUESTS_FILE))
+        by_id = {r["request_id"]: r for r in records}
+        assert set(by_id) == {f"rq{i}" for i in range(4)}
+        for i, rec in ((i, by_id[f"rq{i}"]) for i in range(4)):
+            assert rec["prompt_len"] == len(prompts[i])
+            assert rec["tokens_out"] == 2 + i % 3
+            assert rec["finish_reason"] == "length"
+            assert rec["queue_wait_s"] >= 0
+            assert rec["prefill_s"] > 0
+            assert rec["ttft_s"] > 0
+            assert rec["slot"] in (0, 1)
+
+        trace = json.load(open(os.path.join(run_dir, TRACE_FILE)))
+        threads = {
+            e["args"]["name"]: e["tid"]
+            for e in trace["traceEvents"] if e.get("name") == "thread_name"
+        }
+        for i in range(4):
+            tid = threads.get(f"req rq{i}")
+            assert tid is not None and tid > 0, threads
+            spans = {
+                e["name"] for e in trace["traceEvents"]
+                if e["ph"] == "X" and e.get("tid") == tid
+            }
+            assert {"req/queue_wait", "req/prefill", "req/decode"} <= spans
+        # ttft histogram exemplars name the requests in their buckets
+        exemplars = obs.registry().get(
+            "rlt_serve_ttft_seconds"
+        ).bucket_exemplars()
+        assert set(exemplars) <= {f"rq{i}" for i in range(4)}
+        assert exemplars
+    finally:
+        obs.reset()
+
+
+def test_engine_tracing_off_is_attribute_check_only(model):
+    """Telemetry off: no tracer object exists and request/slot trace
+    attributes stay None — the per-token cost is one attribute check."""
+    params, cfg = model
+    engine = InferenceEngine(
+        params, cfg, EngineConfig(num_slots=1, max_prompt_len=8, max_len=16)
+    )
+    assert engine._tracer is None
+    c = engine.submit([1, 2, 3], max_new_tokens=2)
+    engine.run_until_idle()
+    assert c.done
+    assert all(s.trace is None for s in engine.pool.slots)
+    assert engine.drain_request_records() == []
+
+
+def test_engine_tracing_head_sampling_drops(model, monkeypatch):
+    """RLT_TRACE_SAMPLE=0: telemetry on but every request unsampled —
+    no records, no per-request spans, same completions."""
+    from ray_lightning_tpu import observability as obs
+    from ray_lightning_tpu.observability import reqtrace
+
+    monkeypatch.setenv(reqtrace.SAMPLE_ENV, "0")
+    params, cfg = model
+    obs.reset()
+    obs.enable()
+    try:
+        engine = InferenceEngine(
+            params, cfg, EngineConfig(num_slots=1, max_prompt_len=8, max_len=16)
+        )
+        c = engine.submit([1, 2, 3], max_new_tokens=2)
+        engine.run_until_idle()
+        assert c.done
+        assert engine._tracer is not None
+        assert engine._tracer.started_total == 1
+        assert engine._tracer.sampled_total == 0
+        assert engine.drain_request_records() == []
+    finally:
+        obs.reset()
+
+
+def test_scheduler_deferral_stamps_trace(model):
+    """A queued request that waits for capacity accumulates deferred
+    ticks on its trace and records the wait on admission."""
+    from ray_lightning_tpu.observability import reqtrace
+
+    _, cfg = model
+    pool = KVSlotPool(cfg, num_slots=1, max_len=16)
+    sched = ContinuousBatchScheduler(pool, max_queue=4)
+    a = Request("a", (1, 2), 2)
+    b = Request("b", (1, 2), 2, trace=reqtrace.RequestTrace("b", 2, 2))
+    sched.submit(a)
+    sched.submit(b)
+    sched.tick()  # admits "a" (one prefill per tick)
+    sched.tick()  # "b" defers against the full pool
+    sched.tick()
+    assert b.trace.deferred_ticks == 2  # one per tick while blocked
+    assert b.trace.queue_wait_s is None
+    pool.release(0)
+    plan = sched.tick()
+    assert [r.request_id for r, _ in plan.prefills] == ["b"]
+    assert b.trace.slot == 0
+    assert b.trace.queue_wait_s > 0
+    assert plan.prefills[0][1].trace is b.trace
+    rec = b.trace.record("eos")
+    assert rec["deferred_ticks"] == 2 and rec["deferred_wait_s"] > 0
