@@ -393,6 +393,28 @@ def _child(args: argparse.Namespace) -> int:
         result["detail"]["flash_autotune"] = autotune_note
     if remat_note:
         result["detail"]["remat_sweep"] = remat_note
+    # analytic cost accounting of the compiled step: FLOPs/bytes from XLA
+    # itself (vs the hand-derived flops_per_token above), collective byte
+    # volumes, and a roofline verdict. A second compile of the step;
+    # RLT_BENCH_COST=0 skips it. Never fatal to the bench row.
+    if os.environ.get("RLT_BENCH_COST", "1") != "0":
+        try:
+            from ray_lightning_tpu.observability import profiler as _profiler
+
+            rep = _profiler.analyze_jitted(
+                step, params, opt_state, tokens, program="bench_train_step"
+            )
+            if rep is not None:
+                cost = rep.to_dict()
+                cost["roofline"] = _profiler.roofline(
+                    rep,
+                    step_time_s=elapsed / args.steps,
+                    peak_tflops=peak,
+                )
+                cost["mfu"] = cost["roofline"].get("mfu")
+                result["detail"]["cost_analysis"] = cost
+        except Exception as exc:  # noqa: BLE001 — accounting is best-effort
+            print(f"cost analysis failed: {exc!r}", file=sys.stderr)
     print(json.dumps(result))
     return 0
 

@@ -2,6 +2,8 @@
 
 The reference has no first-party profiler (SURVEY §5); this provides
 TensorBoard-compatible XLA traces, the idiomatic TPU observability tool.
+For driver-coordinated fleet-wide capture (all ranks, same global step)
+see :mod:`ray_lightning_tpu.observability.profiler` and ``cli profile``.
 """
 from __future__ import annotations
 
@@ -24,10 +26,25 @@ class ProfilerCallback(Callback):
         self.start_step = start_step
         self.num_steps = num_steps
         self._active = False
+        self._rank_suffixed = False
 
     def setup(self, trainer, module, stage: str) -> None:
         if self.log_dir is None:
             self.log_dir = os.path.join(trainer.default_root_dir, "profile")
+        if not self._rank_suffixed:
+            # multi-worker captures often share a filesystem — without a
+            # rank suffix every rank writes into the same trace directory
+            rank = getattr(trainer.strategy, "global_rank", 0) or 0
+            self.log_dir = os.path.join(self.log_dir, f"rank{int(rank)}")
+            self._rank_suffixed = True
+
+    def _stop(self) -> None:
+        if self._active:
+            self._active = False
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
 
     def on_train_batch_start(self, trainer, module, batch, batch_idx) -> None:
         if trainer.global_step == self.start_step and not self._active:
@@ -37,10 +54,14 @@ class ProfilerCallback(Callback):
 
     def on_train_batch_end(self, trainer, module, outputs, batch, batch_idx) -> None:
         if self._active and trainer.global_step >= self.start_step + self.num_steps:
-            jax.profiler.stop_trace()
-            self._active = False
+            self._stop()
 
     def on_train_end(self, trainer, module) -> None:
-        if self._active:
-            jax.profiler.stop_trace()
-            self._active = False
+        self._stop()
+
+    def on_exception(self, trainer, module, err) -> None:
+        # a crash mid-window must not leave the device tracer running
+        self._stop()
+
+    def teardown(self, trainer, module, stage: str) -> None:
+        self._stop()

@@ -32,6 +32,10 @@ _CPU_ESTIMATE_TFLOPS = 0.1  # so tests on CPU produce finite MFU numbers
 
 PEAK_TFLOPS_ENV = "RLT_PEAK_TFLOPS"
 
+SAMPLES_PER_SEC_METRIC = "rlt_samples_per_sec"
+TRAIN_MFU_METRIC = "rlt_train_mfu"
+TOKENS_PER_CHIP_METRIC = "rlt_tokens_per_sec_per_chip"
+
 
 def detect_peak_tflops() -> float:
     """Peak bf16 TFLOP/s per chip. ``RLT_PEAK_TFLOPS`` overrides detection
@@ -154,9 +158,9 @@ class ThroughputMonitor(Callback):
             return
         summary = self.summary(trainer)
         for name, key in (
-            ("rlt_samples_per_sec", "samples_per_sec"),
-            ("rlt_train_mfu", "train_mfu"),
-            ("rlt_tokens_per_sec_per_chip", "tokens_per_sec_per_chip"),
+            (SAMPLES_PER_SEC_METRIC, "samples_per_sec"),
+            (TRAIN_MFU_METRIC, "train_mfu"),
+            (TOKENS_PER_CHIP_METRIC, "tokens_per_sec_per_chip"),
         ):
             if key in summary:
                 reg.gauge(name).set(summary[key])

@@ -279,6 +279,9 @@ def _cmd_serve(args) -> int:
             engine.pool.block_utilization(), 4
         )
     print(json.dumps({"summary": summary}))
+    if args.cost:
+        # second compile of both serving programs; off the serving loop
+        print(json.dumps({"cost_summary": engine.cost_summary()}))
     if args.telemetry:
         reg = _obs.registry()
         if reg is not None:
@@ -296,6 +299,57 @@ def _cmd_serve(args) -> int:
             )
             print(json.dumps({"telemetry_dir": args.telemetry_dir}))
     engine.shutdown(drain=False)
+    return 0
+
+
+def _cmd_profile(args) -> int:
+    """Coordinate a fleet profile capture, or render the profile report.
+
+    Without ``--report``: write ``profile_cmd.json`` into the run's
+    telemetry directory. Every rank polls the file from its train loop
+    and starts ``jax.profiler`` at the same absolute global step
+    (``--at-step``, or the cluster's latest step plus ``--lead``).
+    With ``--report``: render the cost/capture/attribution tables folded
+    into ``summary.json`` by the driver aggregator."""
+    import json
+
+    from ray_lightning_tpu.observability import profiler as _profiler
+    from ray_lightning_tpu.observability.aggregator import _read_summary
+
+    if args.report:
+        print(_profiler.format_profile_report(_read_summary(args.dir)))
+        return 0
+
+    start = args.at_step
+    if start is None:
+        summary = _read_summary(args.dir)
+        steps_max = (summary or {}).get("cluster", {}).get("steps_max")
+        if steps_max is None:
+            if summary is None:
+                print(
+                    f"no live summary under {args.dir} to anchor the start "
+                    "step — pass --at-step N (absolute global step), or "
+                    "start the run with RLT_TELEMETRY=1"
+                )
+            else:
+                print(
+                    f"summary under {args.dir} has no live worker step "
+                    "counter (finished or in-process run) — pass --at-step "
+                    "N (absolute global step) to arm a future window"
+                )
+            return 1
+        start = int(steps_max) + args.lead
+    cmd = _profiler.write_profile_command(
+        args.dir, num_steps=args.steps, start_step=start
+    )
+    print(
+        json.dumps(
+            {
+                "profile_cmd": f"{args.dir}/{_profiler.PROFILE_CMD_FILE}",
+                **cmd,
+            }
+        )
+    )
     return 0
 
 
@@ -410,6 +464,45 @@ def main(argv: Optional[list] = None) -> int:
         help="with --telemetry: write trace.json / summary.json / "
         "requests.jsonl to this directory on exit",
     )
+    serve.add_argument(
+        "--cost",
+        action="store_true",
+        help="print analytic HLO cost accounting (flops/bytes/collectives) "
+        "for the compiled prefill and decode programs",
+    )
+    profile_p = sub.add_parser(
+        "profile",
+        help="coordinate a fleet jax.profiler capture, or show the report",
+    )
+    profile_p.add_argument(
+        "--dir",
+        required=True,
+        help="telemetry directory of the live run "
+        "(e.g. <default_root_dir>/telemetry)",
+    )
+    profile_p.add_argument(
+        "--steps", type=int, default=3, help="capture window length in steps"
+    )
+    profile_p.add_argument(
+        "--at-step",
+        type=int,
+        default=None,
+        help="absolute global step to start at (default: the cluster's "
+        "latest step from summary.json plus --lead)",
+    )
+    profile_p.add_argument(
+        "--lead",
+        type=int,
+        default=20,
+        help="steps of headroom past the latest observed step, so every "
+        "rank sees the command before the window opens",
+    )
+    profile_p.add_argument(
+        "--report",
+        action="store_true",
+        help="render cost accounting / captures / step-time attribution "
+        "from summary.json instead of arming a capture",
+    )
     requests_p = sub.add_parser(
         "requests",
         help="slowest finished requests from a run's requests.jsonl",
@@ -441,6 +534,8 @@ def main(argv: Optional[list] = None) -> int:
         return render_top(args.dir, follow=args.follow, interval=args.interval)
     if args.command == "serve":
         return _cmd_serve(args)
+    if args.command == "profile":
+        return _cmd_profile(args)
     if args.command == "requests":
         return _cmd_requests(args)
     parser.print_help()

@@ -232,6 +232,7 @@ class Trainer:
         # flight recorder handle: None when telemetry is off, so every
         # instrumented hot path reduces to one attribute check (`if rec`)
         self._obs = None
+        self._profiler = None
         self._first_step_dispatched = False
         self._restored_ckpt: Optional[Dict[str, Any]] = None
         # set by the launcher on a max_failures relaunch: newest checkpoint
@@ -845,6 +846,23 @@ class Trainer:
         self._step_log_buffer = []
         self._input_prefetcher = None
         self._input_stats = {"starved_s": 0.0, "batches": 0}
+        # fleet profiler: armed by telemetry (driver command file) or by
+        # RLT_PROFILE_AT_STEP; fully absent otherwise so the hot loop keeps
+        # its single-attribute-check fast path
+        self._profiler = None
+        if self._obs is not None or os.environ.get(
+            obs.profiler.PROFILE_AT_STEP_ENV
+        ):
+            from ray_lightning_tpu.observability.aggregator import telemetry_dir
+
+            try:
+                self._profiler = obs.profiler.FleetProfiler(
+                    telemetry_dir(self.default_root_dir),
+                    rank=getattr(self.strategy, "global_rank", 0) or 0,
+                    recorder=self._obs,
+                )
+            except Exception:
+                self._profiler = None
         _setup_wall, _setup_t0 = time.time(), time.perf_counter()
         seed = seed_everything(self.seed)
         self._seed_used = seed
@@ -1029,6 +1047,11 @@ class Trainer:
                 self._input_stats["starved_s"] += self._input_prefetcher.starved_s
                 self._input_stats["batches"] += self._input_prefetcher.batches
                 self._input_prefetcher = None
+            if self._profiler is not None:
+                # a window cut short by should_stop/exception still stops
+                # the device trace and ships its records
+                self._profiler.close()
+                self._profiler = None
             self._hook("on_train_end")
             self._hook("on_fit_end")
             if self.logger is not None:
@@ -1039,11 +1062,14 @@ class Trainer:
                 datamodule.teardown("fit")
 
         model._params = self._params
-        if (
-            self._obs is not None
-            and getattr(self.strategy, "launcher", None) is None
+        in_process = (
+            getattr(self.strategy, "launcher", None) is None
             and not getattr(self.strategy, "_is_remote", False)
-        ):
+        )
+        # worker processes leave pending profile records for the final
+        # heartbeat flush; in-process runs must drain them here or lose them
+        profile_records = obs.profiler.drain_pending() if in_process else None
+        if in_process and (self._obs is not None or profile_records):
             # in-process strategies have no driver aggregator: dump this
             # process's ring + registry directly so single-host runs still
             # produce trace.json/metrics.json under the root dir
@@ -1056,7 +1082,8 @@ class Trainer:
             write_local_dump(
                 telemetry_dir(self.default_root_dir),
                 self._obs,
-                _obs_metrics.get_registry(),
+                _obs_metrics.get_registry() if self._obs is not None else None,
+                profile=profile_records,
             )
         return None
 
@@ -1413,8 +1440,10 @@ class Trainer:
                 )
 
         # hoisted handles: the telemetry-off hot loop pays exactly one
-        # `rec is not None` check per batch, nothing else
+        # `rec is not None` check per batch (plus one for the profiler,
+        # which is only non-None when telemetry or a profile env is armed)
         rec = self._obs
+        prof = self._profiler
         step_hist = (
             obs.metrics.get_registry().histogram("rlt_step_time_seconds")
             if rec is not None
@@ -1423,8 +1452,10 @@ class Trainer:
         for batch_idx, batch, device_batch in self._prefetch_shard(
             train_loader, limit_train
         ):
-            if rec is not None:
+            if rec is not None or prof is not None:
                 _it_wall, _it_t0 = time.time(), time.perf_counter()
+                if prof is not None:
+                    prof.before_step(self.global_step, device_batch)
             self._health_tick(train=True)
             self._cb("on_train_batch_start", batch, batch_idx)
             self._params, self._opt_state, logs = train_step(
@@ -1439,18 +1470,47 @@ class Trainer:
             self._cb("on_train_batch_end", logs, batch, batch_idx)
             self.global_step += 1
             n_batches += 1
-            if rec is not None:
+            if rec is not None or prof is not None:
                 _dt = time.perf_counter() - _it_t0
-                if self._first_step_dispatched:
-                    # host-side step interval: equals device step time once
-                    # the dispatch pipeline backpressures
-                    rec.add_span("step", _it_wall, _dt, step=self.global_step - 1)
-                    step_hist.observe(_dt)
-                else:
-                    # the first dispatch blocks on jit trace + XLA compile;
-                    # keep it out of the step-time histogram
-                    self._first_step_dispatched = True
-                    rec.add_span("compile", _it_wall, _dt, step=self.global_step - 1)
+                _first = not self._first_step_dispatched
+                self._first_step_dispatched = True
+                if rec is not None:
+                    if _first:
+                        # the first dispatch blocks on jit trace + XLA
+                        # compile; keep it out of the step-time histogram
+                        rec.add_span(
+                            "compile", _it_wall, _dt, step=self.global_step - 1
+                        )
+                    else:
+                        # host-side step interval: equals device step time
+                        # once the dispatch pipeline backpressures
+                        rec.add_span("step", _it_wall, _dt, step=self.global_step - 1)
+                        step_hist.observe(_dt)
+                if prof is not None:
+                    if _first:
+                        # one-time AOT cost analysis of the compiled step
+                        prof.analyze(
+                            "train_step",
+                            train_step,
+                            (
+                                self._params,
+                                self._opt_state,
+                                device_batch,
+                                self._rng_root,
+                                np.int32(self.global_step),
+                            ),
+                        )
+                    else:
+                        prof.after_step(
+                            self.global_step - 1,
+                            _dt,
+                            sync=logs,
+                            starved_s=(
+                                self._input_prefetcher.starved_s
+                                if self._input_prefetcher is not None
+                                else 0.0
+                            ),
+                        )
 
             if val_loader is not None and (
                 (

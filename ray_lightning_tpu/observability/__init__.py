@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from . import metrics, reqtrace, slo, trace
+from . import metrics, profiler, reqtrace, slo, trace
 from .trace import (  # noqa: F401  (re-exported API)
     DRIVER,
     NOOP_SPAN,
@@ -49,6 +49,7 @@ __all__ = [
     "maybe_enable_from_env",
     "merge_traces",
     "metrics",
+    "profiler",
     "registry",
     "reqtrace",
     "reset",
@@ -74,17 +75,23 @@ def collect_beat_payload(final: bool = False) -> Optional[Dict[str, Any]]:
     ``None`` when telemetry is disabled or (unless ``final``) there is
     nothing new to ship. ``final=True`` forces a full cumulative metrics
     snapshot so the driver's last view is complete even if some earlier
-    delta beats were dropped.
+    delta beats were dropped. Pending profile records (cost / capture /
+    attribution) ride along under ``"p"`` — and ship even with telemetry
+    off, so an env-armed profile window on a bare run still reports.
     """
     rec = trace.get_recorder()
+    prof = profiler.drain_pending()
     if rec is None:
-        return None
+        return {"p": prof} if prof else None
     events = rec.drain()
     reg = metrics.get_registry()
     snap = reg.snapshot(delta=not final)
-    if not final and not events and reg.is_empty_snapshot(snap):
+    if not final and not events and not prof and reg.is_empty_snapshot(snap):
         return None
-    return {"m": snap, "t": events}
+    payload: Dict[str, Any] = {"m": snap, "t": events}
+    if prof:
+        payload["p"] = prof
+    return payload
 
 
 def sample_device_memory(force: bool = False) -> None:
@@ -100,3 +107,4 @@ def reset() -> None:
     """Disable telemetry and drop all recorded state (test isolation)."""
     trace.disable()
     metrics.reset_registry()
+    profiler.reset_pending()

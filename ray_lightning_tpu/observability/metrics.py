@@ -229,6 +229,17 @@ class MetricsRegistry:
     def items(self):
         return self._metrics.items()
 
+    def drop_series(self, **labels) -> int:
+        """Remove every series whose label set contains all given pairs
+        (e.g. ``drop_series(rank=3)`` after an elastic shrink evicts a
+        rank, so summaries/Prometheus stop reporting the dead worker).
+        Returns the number of series removed."""
+        match = {(k, str(v)) for k, v in labels.items()}
+        doomed = [key for key in self._metrics if match <= set(key[1])]
+        for key in doomed:
+            del self._metrics[key]
+        return len(doomed)
+
     # ----------------------------------------------------------------- #
     # serialization
     # ----------------------------------------------------------------- #

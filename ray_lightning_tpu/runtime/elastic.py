@@ -685,7 +685,14 @@ class ElasticController:
             t0 = time.monotonic()
             if self.supervisor is not None:
                 try:
-                    self.supervisor.forget_rank(boot_id)
+                    # permanent eviction: drop the rank's telemetry rows too,
+                    # so summary.json/Prometheus stop reporting the dead worker
+                    self.supervisor.forget_rank(boot_id, drop_telemetry=True)
+                except Exception:  # pragma: no cover
+                    pass
+            elif self._aggregator is not None:
+                try:
+                    self._aggregator.drop_rank(boot_id)
                 except Exception:  # pragma: no cover
                     pass
             try:

@@ -262,10 +262,19 @@ class Supervisor:
             out[rank] = verdict
         return out
 
-    def forget_rank(self, rank: int) -> None:
+    def forget_rank(self, rank: int, drop_telemetry: bool = False) -> None:
         """Stop watching ``rank`` (evicted by an elastic shrink, or merely
-        mid-transition — a later heartbeat re-arms it via :meth:`observe`)."""
+        mid-transition — a later heartbeat re-arms it via :meth:`observe`).
+
+        ``drop_telemetry=True`` additionally evicts the rank's aggregator
+        state (gauges, step samples, Prometheus series) — only pass it on
+        *permanent* eviction, never on a transient mid-transition forget."""
         self.health.pop(rank, None)
+        if drop_telemetry and self._aggregator is not None:
+            try:
+                self._aggregator.drop_rank(rank)
+            except Exception:
+                pass
 
     def track_rank(self, rank: int) -> None:
         """Start watching a newly-admitted rank (elastic grow). The fresh

@@ -708,3 +708,54 @@ class InferenceEngine:
             )
         out["queue_depth"] = self.scheduler.queue_depth
         return out
+
+    def cost_summary(self) -> Dict[str, Any]:
+        """Analytic HLO cost of the two compiled serving programs.
+
+        AOT-lowers prefill and decode with dummy arguments matching the
+        :meth:`step` call-site shapes/dtypes (a second compile — call off
+        the serving loop, e.g. at startup or from ``cli serve --cost``),
+        publishes the ``rlt_step_flops``/``rlt_step_bytes``/collective
+        gauges labeled ``program=serve_prefill|serve_decode``, and returns
+        the per-program reports with analytic roofline verdicts."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_lightning_tpu import observability as _obs2
+        from ray_lightning_tpu.observability import profiler as _profiler
+
+        ecfg = self.engine_config
+        ck, cv = self.pool.cache["k"], self.pool.cache["v"]
+        prompt = jnp.zeros((1, ecfg.max_prompt_len), jnp.int32)
+        token = jnp.zeros((self.pool.num_slots,), jnp.int32)
+        pos = jnp.zeros((self.pool.num_slots,), jnp.int32)
+        key = jax.random.key(0)
+        if self.kv_layout == "paged":
+            wt = jnp.zeros((self._n_prompt_blocks,), jnp.int32)
+            programs = (
+                ("serve_prefill", self._prefill_fn,
+                 (self.params, ck, cv, prompt, wt)),
+                ("serve_decode", self._decode_fn,
+                 (self.params, ck, cv, token, pos,
+                  jnp.asarray(self.pool.block_tables), key)),
+            )
+        else:
+            programs = (
+                ("serve_prefill", self._prefill_fn,
+                 (self.params, ck, cv, prompt, jnp.int32(0))),
+                ("serve_decode", self._decode_fn,
+                 (self.params, ck, cv, token, pos, key)),
+            )
+        out: Dict[str, Any] = {}
+        reg = _obs2.registry()
+        for name, fn, args in programs:
+            rep = _profiler.analyze_jitted(fn, *args, program=name)
+            if rep is None:
+                out[name] = None
+                continue
+            if reg is not None:
+                _profiler.publish_cost_report(reg, rep)
+            d = rep.to_dict()
+            d["roofline"] = _profiler.roofline(rep)
+            out[name] = d
+        return out

@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """Fail when a ``rlt_*`` metric emitted by the package is missing from
-the metric table in docs/observability.md.
+the metric table in docs/observability.md — and, in the other direction,
+when a metric-table ROW names a metric that no longer exists in code.
 
 Run directly (``python scripts/check_metrics_docs.py``) or via the
 tier-1 test that wraps it (tests/test_observability.py) so metric/docs
@@ -32,6 +33,8 @@ _EMIT_CALL = re.compile(
 _METRIC_CONST = re.compile(
     r"""[A-Z][A-Z0-9_]*METRIC[A-Z0-9_]*\s*=\s*["'](rlt_[a-z0-9_]+)["']"""
 )
+# a metric-reference TABLE row: the line's first cell is a backticked name
+_DOC_ROW = re.compile(r"^\s*\|\s*`(rlt_[a-z0-9_]+)`", re.MULTILINE)
 
 
 def emitted_metrics(package: Path = PACKAGE) -> set:
@@ -50,6 +53,12 @@ def documented_metrics(docs: Path = DOCS) -> set:
     }
 
 
+def documented_rows(docs: Path = DOCS) -> set:
+    """Names claimed by the metric-reference tables specifically — these
+    must exist in code (docs->code direction), unlike prose mentions."""
+    return set(_DOC_ROW.findall(docs.read_text(encoding="utf-8")))
+
+
 def main() -> int:
     emitted = emitted_metrics()
     documented = documented_metrics()
@@ -66,14 +75,28 @@ def main() -> int:
             "the metric)."
         )
         return 1
-    stale = sorted(documented - emitted)
+    rows = documented_rows()
+    stale_rows = sorted(rows - emitted)
+    if stale_rows:
+        print(
+            f"metric table rows in {DOCS.relative_to(REPO)} that no longer "
+            "exist in ray_lightning_tpu:"
+        )
+        for name in stale_rows:
+            print(f"  {name}")
+        print("\nremove each stale row (or restore the metric in code).")
+        return 1
+    stale = sorted(documented - emitted - rows)
     if stale:
-        # documented-but-not-emitted is a warning, not a failure: docs may
-        # legitimately mention label values or externally-derived names
+        # documented-but-not-emitted PROSE is a warning, not a failure:
+        # docs may legitimately mention label values or derived names
         print("note: documented but not found as a literal in the package:")
         for name in stale:
             print(f"  {name}")
-    print(f"ok: {len(emitted)} emitted metrics all documented")
+    print(
+        f"ok: {len(emitted)} emitted metrics all documented, "
+        f"{len(rows)} table rows all emitted"
+    )
     return 0
 
 

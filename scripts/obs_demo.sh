@@ -11,6 +11,12 @@ export RLT_TELEMETRY=1
 # CPU is logical scheduling bookkeeping (same default as tests/conftest.py);
 # cramped containers would otherwise refuse to place two workers
 export RLT_NUM_CPUS="${RLT_NUM_CPUS:-64}"
+# arm a coordinated profile window: every rank starts jax.profiler at
+# global step 3 and captures 2 steps (for a live long-running fit you'd
+# instead run `cli profile --dir <telemetry> --steps N`, which writes the
+# same command through profile_cmd.json)
+export RLT_PROFILE_AT_STEP="${RLT_PROFILE_AT_STEP:-3}"
+export RLT_PROFILE_STEPS="${RLT_PROFILE_STEPS:-2}"
 
 ROOT="${1:-$(mktemp -d /tmp/rlt_obs_demo.XXXXXX)}"
 
@@ -35,3 +41,10 @@ EOF
 ls -l "$ROOT/telemetry"
 echo
 python -m ray_lightning_tpu.cli top --dir "$ROOT/telemetry"
+echo
+# the coordinated capture above shipped per-rank trace dirs + cost
+# accounting + step-time attribution back to the driver aggregator
+python -m ray_lightning_tpu.cli profile --dir "$ROOT/telemetry" --report
+echo
+echo "per-rank jax.profiler captures:"
+ls -d "$ROOT"/telemetry/profile/rank* 2>/dev/null || echo "  (none captured)"

@@ -448,7 +448,7 @@ class _StubSupervisor:
         self.forgotten = []
         self.tracked = []
 
-    def forget_rank(self, rank):
+    def forget_rank(self, rank, drop_telemetry=False):
         self.forgotten.append(rank)
 
     def track_rank(self, rank):
