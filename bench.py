@@ -801,6 +801,75 @@ def _serve_microbench(
     return out
 
 
+def _serve_chaos_bench(params, cfg) -> dict:
+    """RLT_BENCH_SERVE_CHAOS=1: goodput under a sustained replica-kill
+    loop. A 2-replica LocalReplicaFleet serves the request batch while
+    RLT_BENCH_SERVE_FAULT (default ``replica0:crash@every:8``) keeps
+    killing replica 0; the journal retries the orphaned requests on the
+    survivor. Reports retries, sheds, relaunches, and completed tokens/s
+    under fault ("goodput") — the serving-resilience regression number.
+    """
+    import numpy as np
+
+    import ray_lightning_tpu.runtime.faults as _faults
+    from ray_lightning_tpu.serving.replica import LocalReplicaFleet
+
+    num_requests = int(os.environ.get("RLT_BENCH_SERVE_REQUESTS", "12"))
+    prev_fault = os.environ.get("RLT_FAULT")
+    os.environ["RLT_FAULT"] = os.environ.get(
+        "RLT_BENCH_SERVE_FAULT", "replica0:crash@every:8"
+    )
+    _faults._serve_cache = None
+    # max_prompt_len must fit the RESUME prefill (prompt + tokens already
+    # delivered), not just the original prompt: <= 7 prompt + 8 new - 1
+    fleet = LocalReplicaFleet(
+        lambda: (params, cfg),
+        engine_kwargs=dict(num_slots=4, max_prompt_len=16, max_len=32),
+        initial_replicas=2,
+        max_retries=8,
+        breaker_threshold=2,
+        breaker_cooldown_s=0.2,
+    )
+    try:
+        rng = np.random.default_rng(0)
+        t0 = time.perf_counter()
+        entries = []
+        rejected = 0
+        for _ in range(num_requests):
+            plen = int(rng.integers(3, 8))
+            prompt = [
+                int(t) for t in rng.integers(1, cfg.vocab_size, size=plen)
+            ]
+            try:
+                entries.append(fleet.submit(prompt, max_new_tokens=8))
+            except Exception:
+                rejected += 1
+        tokens = 0
+        completed = 0
+        for e in entries:
+            try:
+                tokens += len(e.result(timeout=120))
+                completed += 1
+            except Exception:
+                pass
+        wall = time.perf_counter() - t0
+        stats = fleet.stats()
+    finally:
+        fleet.shutdown()
+        if prev_fault is None:
+            os.environ.pop("RLT_FAULT", None)
+        else:
+            os.environ["RLT_FAULT"] = prev_fault
+        _faults._serve_cache = None
+    return {
+        "retries": stats["retries"],
+        "shed": stats["shed"] + rejected,
+        "relaunches": stats["relaunches"],
+        "completed_under_kill": completed,
+        "goodput_under_kill": round(tokens / max(wall, 1e-9), 2),
+    }
+
+
 def _serve_sweep(args: argparse.Namespace) -> int:
     """Child: the continuous-batching serving sweep (--_serve_sweep).
 
@@ -808,7 +877,8 @@ def _serve_sweep(args: argparse.Namespace) -> int:
     across RLT_BENCH_SERVE_RATES (default "4,16,64" req/s), reporting
     tokens/s, TTFT p50/p95 and slot utilization at each level. CPU-pinned
     like the other sweeps — this measures the batching/scheduling path,
-    not chip FLOPs.
+    not chip FLOPs. RLT_BENCH_SERVE_CHAOS=1 appends the replica-kill-loop
+    goodput numbers (see :func:`_serve_chaos_bench`).
     """
     import dataclasses
 
@@ -854,21 +924,20 @@ def _serve_sweep(args: argparse.Namespace) -> int:
         compiles = engine.compile_stats()
     finally:
         engine.shutdown(drain=False)
-    print(
-        json.dumps(
-            {
-                "platform": "cpu",
-                "num_slots": 4,
-                "kv_layout": kv_layout,
-                "levels": levels,
-                "peak_tokens_per_sec": max(
-                    lvl["tokens_per_sec"] for lvl in levels
-                ),
-                "compile_stats": compiles,
-                "compile_ms": compile_ms,
-            }
-        )
-    )
+    payload = {
+        "platform": "cpu",
+        "num_slots": 4,
+        "kv_layout": kv_layout,
+        "levels": levels,
+        "peak_tokens_per_sec": max(
+            lvl["tokens_per_sec"] for lvl in levels
+        ),
+        "compile_stats": compiles,
+        "compile_ms": compile_ms,
+    }
+    if os.environ.get("RLT_BENCH_SERVE_CHAOS", "0") == "1":
+        payload.update(_serve_chaos_bench(params, cfg))
+    print(json.dumps(payload))
     return 0
 
 
@@ -878,7 +947,9 @@ def _attach_serve_sweep(result: dict, here: str, env: dict) -> None:
     child never acquires the chip. RLT_BENCH_SERVE_SWEEP=0 disables;
     RLT_BENCH_SERVE_RATES / RLT_BENCH_SERVE_REQUESTS shape the ramp and
     RLT_BENCH_SERVE_KV_LAYOUT ("slot" | "paged") picks the KV layout
-    recorded in detail.serving.kv_layout."""
+    recorded in detail.serving.kv_layout. RLT_BENCH_SERVE_CHAOS=1 adds
+    detail.serving.retries / .shed / .goodput_under_kill from a
+    replica-kill-loop run (see _serve_chaos_bench)."""
     if os.environ.get("RLT_BENCH_SERVE_SWEEP", "1") == "0":
         return
     sweep_env = dict(env)

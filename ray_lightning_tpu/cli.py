@@ -230,26 +230,69 @@ def _cmd_serve(args) -> int:
             f"prompt(s) {too_long} exceed --max-prompt-len {args.max_prompt_len}"
         )
 
+    from ray_lightning_tpu.observability.reqtrace import disposition_for
+    from ray_lightning_tpu.serving import RequestShed
+
     params = init_params(jax.random.PRNGKey(args.seed), cfg)
-    engine = InferenceEngine(
-        params,
-        cfg,
-        EngineConfig(
-            num_slots=args.num_slots,
-            max_prompt_len=args.max_prompt_len,
-            max_len=args.max_len,
-            temperature=args.temperature,
-            eos_id=args.eos_id,
-            seed=args.seed,
-            kv_layout=args.kv_layout,
-            block_size=args.block_size,
-        ),
+    engine_cfg = EngineConfig(
+        num_slots=args.num_slots,
+        max_prompt_len=args.max_prompt_len,
+        max_len=args.max_len,
+        temperature=args.temperature,
+        eos_id=args.eos_id,
+        seed=args.seed,
+        kv_layout=args.kv_layout,
+        block_size=args.block_size,
     )
+    fleet = None
+    if args.max_retries > 0:
+        # retries need the request journal: serve through a one-replica
+        # fleet so a replica fault re-runs the request transparently
+        from ray_lightning_tpu.serving import LocalReplicaFleet
+
+        fleet = LocalReplicaFleet(
+            lambda: (params, cfg),
+            engine_kwargs=dataclasses.asdict(engine_cfg),
+            initial_replicas=1,
+            max_retries=args.max_retries,
+        )
+        engine = fleet._replicas[0]
+    else:
+        engine = InferenceEngine(params, cfg, engine_cfg)
+
     t0 = _time.perf_counter()
-    completions = [
-        engine.submit(p, max_new_tokens=args.max_new_tokens) for p in prompts
-    ]
-    engine.run_until_idle()
+    completions = []
+    shed_rows = []
+    submit = fleet.submit if fleet is not None else engine.submit
+    for i, p in enumerate(prompts):
+        try:
+            completions.append(
+                submit(
+                    p,
+                    max_new_tokens=args.max_new_tokens,
+                    deadline_ms=args.deadline_ms,
+                    priority=args.priority,
+                )
+            )
+        except RequestShed:
+            shed_rows.append(
+                {
+                    "request_id": f"prompt-{i}",
+                    "finish_reason": "shed",
+                    "disposition": "shed",
+                    "retries": 0,
+                    "ttft_s": None,
+                    "tokens": [],
+                }
+            )
+    if fleet is not None:
+        for c in completions:
+            try:
+                c.result(timeout=300)
+            except Exception:
+                pass  # disposition reported per-row below
+    else:
+        engine.run_until_idle()
     wall = _time.perf_counter() - t0
 
     for c in completions:
@@ -258,15 +301,24 @@ def _cmd_serve(args) -> int:
                 {
                     "request_id": c.request_id,
                     "finish_reason": c.finish_reason,
+                    "disposition": (
+                        c.disposition
+                        if fleet is not None
+                        else disposition_for(c.finish_reason)
+                    ),
+                    "retries": c.retries if fleet is not None else 0,
                     "ttft_s": round(c.ttft_s, 6) if c.ttft_s else None,
                     "tokens": list(c.tokens),
                 }
             )
         )
+    for row in shed_rows:
+        print(json.dumps(row))
     total_tokens = sum(len(c.tokens) for c in completions)
     summary = {
-        "requests": len(completions),
+        "requests": len(completions) + len(shed_rows),
         "generated_tokens": total_tokens,
+        "shed": len(shed_rows),
         "wall_s": round(wall, 3),
         "tokens_per_sec": round(total_tokens / wall, 2) if wall > 0 else None,
         "kv_layout": engine.kv_layout,
@@ -274,6 +326,8 @@ def _cmd_serve(args) -> int:
         "compile_stats": engine.compile_stats(),
         "pool": engine.pool.stats(),
     }
+    if fleet is not None:
+        summary["journal"] = fleet.stats()
     if engine.kv_layout == "paged":
         summary["block_utilization"] = round(
             engine.pool.block_utilization(), 4
@@ -298,7 +352,10 @@ def _cmd_serve(args) -> int:
                 requests=engine.drain_request_records(),
             )
             print(json.dumps({"telemetry_dir": args.telemetry_dir}))
-    engine.shutdown(drain=False)
+    if fleet is not None:
+        fleet.shutdown()
+    else:
+        engine.shutdown(drain=False)
     return 0
 
 
@@ -375,7 +432,8 @@ def _cmd_requests(args) -> int:
             print(json.dumps(r))
         return 0
     cols = (
-        ("request_id", 14), ("finish_reason", 8), ("prompt_len", 6),
+        ("request_id", 14), ("finish_reason", 8), ("disposition", 11),
+        ("retries", 7), ("prompt_len", 6),
         ("tokens_out", 6), ("queue_wait_s", 12), ("prefill_s", 9),
         ("ttft_s", 8), ("total_s", 8), ("itl_p50_ms", 10),
         ("itl_max_ms", 10), ("deferred_ticks", 8), ("replica", 7),
@@ -450,6 +508,21 @@ def main(argv: Optional[list] = None) -> int:
     serve.add_argument("--temperature", type=float, default=0.0)
     serve.add_argument("--eos-id", type=int, default=None)
     serve.add_argument("--seed", type=int, default=0)
+    serve.add_argument(
+        "--deadline-ms", type=float, default=None,
+        help="per-request TTL: past it the request is evicted (queued or "
+        "decoding) with finish_reason=expired",
+    )
+    serve.add_argument(
+        "--priority", type=int, default=0,
+        help="admission class: 0 is never shed; >= 1 is sheddable under "
+        "queue pressure or SLO burn",
+    )
+    serve.add_argument(
+        "--max-retries", type=int, default=0,
+        help="> 0 serves through the request journal (one-replica fleet): "
+        "a replica fault re-runs the request up to this many times",
+    )
     serve.add_argument(
         "--fp32", action="store_true", help="force float32 params/activations"
     )

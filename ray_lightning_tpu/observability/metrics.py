@@ -32,6 +32,14 @@ PENDING_CAP = 4096
 # Last-N exemplar ids kept per histogram bucket.
 EXEMPLAR_CAP = 3
 
+# Serving-resilience metric names, shared by serving/resilience.py, the
+# engine's shed/expiry paths and the replica router so emit sites and the
+# docs gate agree on one spelling.
+SERVE_RETRIES_METRIC = "rlt_serve_retries_total"
+SERVE_SHED_METRIC = "rlt_serve_shed_total"
+SERVE_DEADLINE_EXPIRED_METRIC = "rlt_serve_deadline_expired_total"
+SERVE_BREAKER_STATE_METRIC = "rlt_serve_breaker_state"
+
 # `# HELP` text for the exposition; metrics not listed fall back to a
 # name-derived placeholder so every family still carries a HELP line.
 HELP: Dict[str, str] = {
@@ -46,6 +54,10 @@ HELP: Dict[str, str] = {
     "rlt_slo_breached": "1 while the objective's multi-window burn-rate alert is firing.",
     "rlt_hbm_bytes_in_use": "Device (HBM) bytes currently allocated, per local device.",
     "rlt_hbm_peak_bytes": "Peak device (HBM) bytes allocated, per local device.",
+    "rlt_serve_retries_total": "Journaled serving requests resubmitted after replica failure.",
+    "rlt_serve_shed_total": "Serving requests rejected by the load-shed policy.",
+    "rlt_serve_deadline_expired_total": "Serving requests evicted past their deadline (queued or decoding).",
+    "rlt_serve_breaker_state": "Replica circuit-breaker state (0 closed, 1 half-open, 2 open).",
 }
 
 

@@ -69,6 +69,16 @@ def head_sampled(request_id: str, rate: float) -> bool:
     return h < rate * 2.0**32
 
 
+def disposition_for(finish_reason: str) -> str:
+    """Collapse a finish reason into the client-facing disposition
+    (completed / shed / expired / cancelled / failed)."""
+    if finish_reason in ("eos", "length"):
+        return "completed"
+    if finish_reason in ("shed", "expired", "cancelled"):
+        return finish_reason
+    return "failed"
+
+
 def jsonl_max_bytes(environ=os.environ) -> int:
     try:
         return int(environ.get(EVENTS_MAX_ENV, DEFAULT_MAX_JSONL_BYTES))
@@ -143,7 +153,7 @@ class RequestTrace:
         "submitted_wall", "_submitted", "_admitted", "_first_deferred",
         "deferred_ticks", "prefill_s", "_prefill_done", "_first_token",
         "_last_token", "tokens", "token_stamps", "slot",
-        "hbm_bytes_in_use",
+        "hbm_bytes_in_use", "retries",
     )
 
     def __init__(
@@ -152,11 +162,13 @@ class RequestTrace:
         prompt_len: int = 0,
         max_new_tokens: int = 0,
         replica: Optional[Any] = None,
+        retries: int = 0,
     ):
         self.request_id = str(request_id)
         self.prompt_len = int(prompt_len)
         self.max_new_tokens = int(max_new_tokens)
         self.replica = replica
+        self.retries = int(retries)
         self.submitted_wall = time.time()
         self._submitted = time.perf_counter()
         self._admitted: Optional[float] = None
@@ -249,6 +261,8 @@ class RequestTrace:
             "prompt_len": self.prompt_len,
             "tokens_out": self.tokens,
             "finish_reason": finish_reason,
+            "disposition": disposition_for(finish_reason),
+            "retries": self.retries,
             "deferred_ticks": self.deferred_ticks,
             "total_s": round(self.total_s, 6),
         }
@@ -345,6 +359,7 @@ class RequestTracer:
         prompt_len: int = 0,
         max_new_tokens: int = 0,
         replica: Optional[Any] = None,
+        retries: int = 0,
     ) -> Optional[RequestTrace]:
         """Mint a trace for a new request, or ``None`` when head sampling
         drops it (the request then costs one attribute check per tick)."""
@@ -352,7 +367,9 @@ class RequestTracer:
         if not head_sampled(request_id, self.rate):
             return None
         self.sampled_total += 1
-        return RequestTrace(request_id, prompt_len, max_new_tokens, replica)
+        return RequestTrace(
+            request_id, prompt_len, max_new_tokens, replica, retries=retries
+        )
 
     def finish(self, tr: RequestTrace, finish_reason: str) -> Dict[str, Any]:
         recorder = trace.get_recorder()

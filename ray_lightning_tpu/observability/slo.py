@@ -258,6 +258,17 @@ class SLOMonitor:
             return bool(m and m.breached)
         return any(m.breached for m in self.monitors.values())
 
+    def serving_breached(self) -> bool:
+        """Breach verdict restricted to serving-path objectives (metric
+        name ``rlt_serve_*``, e.g. TTFT/ITL) — the signal the engine's
+        shed policy couples to, so a TRAINER objective burning budget
+        (step time, input starvation) never sheds serving traffic."""
+        return any(
+            m.breached
+            for m in self.monitors.values()
+            if m.objective.metric.startswith("rlt_serve_")
+        )
+
     def burn_rates(self, now: Optional[float] = None) -> Dict[str, Dict[str, float]]:
         out: Dict[str, Dict[str, float]] = {}
         for name, m in self.monitors.items():

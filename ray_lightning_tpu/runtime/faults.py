@@ -44,6 +44,29 @@ actors). Step faults default to rank 0 when unset so in-process trainers
 can be chaos-tested too; boot faults require the env var — queue actors,
 node agents and trial runners boot through the same ``serve_instance`` and
 must never inherit rank-0 faults.
+
+Serving fault points share the same env vars with a ``replica``-prefixed
+grammar (``serving/engine.py`` hooks them per scheduler tick and per
+admitted request)::
+
+    replica<R>:<kind>@<where>[:<arg>]
+
+    replica0:crash@tick8           # engine loop dies at scheduler tick 8
+    replica0:crash@every:8         # sustained kill loop, every 8th tick
+    replica1:hang@tick5            # decode loop blocks forever at tick 5
+    replica0:slow-decode@every:4:0.05  # 50ms stall every 4th tick
+    replica1:crash@req3            # die while admitting the 3rd request
+    replica0:drop-stream@req2:4    # the 2nd admitted request loses its
+                                   # stream after 4 generated tokens
+
+Serving ``crash`` raises inside the engine loop instead of ``os._exit``:
+``LocalReplicaFleet`` replicas are threads in the driver process, so a
+process kill would take out the whole fleet (and the test). The raise
+kills exactly one replica's engine — the supervised-death the journal
+and circuit breaker must recover from. Training specs (``rank...``) and
+serving specs (``replica...``) coexist in one ``RLT_FAULT`` value; each
+parser skips the other family. ``RLT_FAULT_FUSE`` at-most-once semantics
+are identical (``@every`` burns one fuse per firing tick).
 """
 from __future__ import annotations
 
@@ -112,6 +135,8 @@ def parse_faults(text: Optional[str]) -> List[FaultSpec]:
         raw = raw.strip()
         if not raw:
             continue
+        if raw.startswith("replica"):
+            continue  # serving-family spec; parse_serve_faults owns it
         m = _SPEC_RE.match(raw)
         if m is None:
             raise ValueError(
@@ -254,6 +279,191 @@ def fire_boot_faults() -> None:
     for spec in specs:
         if spec.rank == rank and spec.at == BOOT and not _fuse_blown(spec):
             _fire(spec)
+
+
+# --------------------------------------------------------------------------
+# serving fault points
+# --------------------------------------------------------------------------
+
+SERVE_KINDS = ("crash", "hang", "slow-decode", "drop-stream")
+
+_SERVE_SPEC_RE = re.compile(
+    r"^replica(?P<replica>\d+):"
+    r"(?P<kind>crash|hang|slow-decode|drop-stream)"
+    r"@(?:tick(?P<tick>\d+)|req(?P<req>\d+)|every:(?P<every>\d+))"
+    r"(?::(?P<arg>[0-9.]+))?$"
+)
+
+
+class ServeFault(RuntimeError):
+    """Raised by a serving ``crash`` fault inside the engine loop.
+
+    Deliberately an exception, not ``os._exit``: LocalReplicaFleet
+    replicas are threads, and the contract under test is "one replica's
+    engine dies, the journal recovers its requests" — not "the driver
+    process dies"."""
+
+
+@dataclass(frozen=True)
+class ServeFaultSpec:
+    """One scripted serving fault for ``replica``. Tick faults (``tick``/
+    ``every``) fire at the start of the matching scheduler tick; request
+    faults (``req``) fire while admitting the Nth request (1-based, per
+    engine lifetime). ``arg`` is the slow-decode stall in seconds, or the
+    drop-stream survival budget in generated tokens."""
+
+    replica: int
+    kind: str
+    tick: Optional[int] = None
+    req: Optional[int] = None
+    every: Optional[int] = None
+    arg: float = 0.0
+
+    @property
+    def fuse_id(self) -> str:
+        if self.every is not None:
+            where = f"every{self.every}"
+        elif self.tick is not None:
+            where = f"tick{self.tick}"
+        else:
+            where = f"req{self.req}"
+        return f"replica{self.replica}-{self.kind}-{where}"
+
+    def fuse_id_at(self, step: int) -> str:
+        if self.every is not None:
+            return f"{self.fuse_id}-s{step}"
+        return self.fuse_id
+
+    def matches_tick(self, tick: int) -> bool:
+        if self.every is not None:
+            return tick > 0 and tick % self.every == 0
+        return self.tick is not None and self.tick == tick
+
+
+def parse_serve_faults(text: Optional[str]) -> List[ServeFaultSpec]:
+    """Parse the serving specs out of an ``RLT_FAULT`` value; training
+    (``rank...``) specs are skipped. Raises ValueError naming a bad
+    ``replica...`` spec."""
+    if not text:
+        return []
+    specs: List[ServeFaultSpec] = []
+    for raw in text.split(","):
+        raw = raw.strip()
+        if not raw or raw.startswith("rank"):
+            continue
+        m = _SERVE_SPEC_RE.match(raw)
+        if m is None:
+            raise ValueError(
+                f"bad {FAULT_ENV} serving spec {raw!r}: expected "
+                "replica<R>:<crash|hang|slow-decode|drop-stream>"
+                "@<tick<N>|req<N>|every:<N>>[:<arg>]"
+            )
+        kind = m.group("kind")
+        tick = int(m.group("tick")) if m.group("tick") is not None else None
+        req = int(m.group("req")) if m.group("req") is not None else None
+        every = int(m.group("every")) if m.group("every") is not None else None
+        if every is not None and every < 1:
+            raise ValueError(
+                f"bad {FAULT_ENV} serving spec {raw!r}: @every needs N >= 1"
+            )
+        if kind == "drop-stream" and req is None:
+            raise ValueError(
+                f"bad {FAULT_ENV} serving spec {raw!r}: drop-stream targets "
+                "a request, e.g. replica0:drop-stream@req2:4"
+            )
+        if kind in ("hang", "slow-decode") and req is not None:
+            raise ValueError(
+                f"bad {FAULT_ENV} serving spec {raw!r}: {kind} is a tick "
+                "fault; use @tick<N> or @every:<N>"
+            )
+        if kind == "slow-decode" and m.group("arg") is None:
+            raise ValueError(
+                f"bad {FAULT_ENV} serving spec {raw!r}: slow-decode needs a "
+                "stall length, e.g. replica0:slow-decode@every:4:0.05"
+            )
+        specs.append(
+            ServeFaultSpec(
+                replica=int(m.group("replica")),
+                kind=kind,
+                tick=tick,
+                req=req,
+                every=every,
+                arg=float(m.group("arg") or 0.0),
+            )
+        )
+    return specs
+
+
+_serve_cache: Tuple[Optional[str], List[ServeFaultSpec]] = (None, [])
+
+
+def _serve_env_specs() -> List[ServeFaultSpec]:
+    global _serve_cache
+    text = os.environ.get(FAULT_ENV)
+    if _serve_cache is None or text != _serve_cache[0]:
+        _serve_cache = (text, parse_serve_faults(text))
+    return _serve_cache[1]
+
+
+def fire_serve_tick_faults(replica: Optional[int], tick: int) -> None:
+    """Engine-loop hook, called at the start of every scheduler tick.
+    crash raises ServeFault (engine loop dies, completions fail); hang
+    blocks the loop thread forever (drain/relaunch timeout food);
+    slow-decode sleeps ``arg`` seconds (straggler replica). No-op when
+    ``replica`` is None or no serving specs are scripted."""
+    if replica is None:
+        return
+    specs = _serve_env_specs()
+    if not specs:
+        return
+    for spec in specs:
+        if (
+            spec.replica == replica
+            and spec.kind in ("crash", "hang", "slow-decode")
+            and spec.req is None
+            and spec.matches_tick(tick)
+            and not _fuse_blown(spec, tick)
+        ):
+            _blow_fuse(spec, tick)
+            if spec.kind == "crash":
+                raise ServeFault(
+                    f"scripted serving fault: replica{replica} crash at "
+                    f"tick {tick}"
+                )
+            if spec.kind == "hang":
+                while True:
+                    time.sleep(60)
+            time.sleep(spec.arg)
+
+
+def serve_request_fault(
+    replica: Optional[int], req_seq: int
+) -> Optional[ServeFaultSpec]:
+    """Engine admission hook: ``req_seq`` is the 1-based count of requests
+    this engine has admitted. A matching ``crash`` raises ServeFault
+    mid-admission; a matching ``drop-stream`` returns its spec (the engine
+    arms the stream cut — the request loses its token stream after
+    ``spec.arg`` generated tokens). Returns None otherwise."""
+    if replica is None:
+        return None
+    specs = _serve_env_specs()
+    if not specs:
+        return None
+    for spec in specs:
+        if (
+            spec.replica == replica
+            and spec.req is not None
+            and spec.req == req_seq
+            and not _fuse_blown(spec)
+        ):
+            _blow_fuse(spec)
+            if spec.kind == "crash":
+                raise ServeFault(
+                    f"scripted serving fault: replica{replica} crash while "
+                    f"admitting request #{req_seq}"
+                )
+            return spec
+    return None
 
 
 def heartbeats_dropped(step: int) -> bool:

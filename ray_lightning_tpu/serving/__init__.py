@@ -18,6 +18,13 @@ Five layers, bottom-up:
   runtime: least-loaded routing, heartbeat-driven relaunch, and an
   :class:`~.replica.Autoscaler` scaling the fleet on queue depth and
   TTFT p95 with graceful drain on scale-down.
+- :mod:`.resilience` — the serving-resilience primitives threaded
+  through all of the above: a driver-side :class:`~.resilience.
+  RequestJournal` that makes requests survive replica deaths (resubmit
+  from ``prompt + delivered``), per-replica
+  :class:`~.resilience.CircuitBreaker` routing health, the deadline/
+  priority-aware :class:`~.resilience.ShedPolicy`, and the SIGTERM
+  preemption drain.
 """
 from ray_lightning_tpu.serving.engine import (  # noqa: F401
     Completion,
@@ -42,6 +49,14 @@ from ray_lightning_tpu.serving.replica import (  # noqa: F401
     needs_relaunch,
     pick_least_loaded,
 )
+from ray_lightning_tpu.serving.resilience import (  # noqa: F401
+    CircuitBreaker,
+    JournalEntry,
+    RequestJournal,
+    RequestShed,
+    ShedPolicy,
+    install_sigterm_drain,
+)
 from ray_lightning_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchScheduler,
     Plan,
@@ -53,11 +68,13 @@ __all__ = [
     "Autoscaler",
     "BlockAllocation",
     "BlockAllocator",
+    "CircuitBreaker",
     "Completion",
     "ContinuousBatchScheduler",
     "EngineClosed",
     "EngineConfig",
     "InferenceEngine",
+    "JournalEntry",
     "KVSlotPool",
     "LocalReplicaFleet",
     "OutOfBlocks",
@@ -65,11 +82,15 @@ __all__ = [
     "Plan",
     "ReplicaGroup",
     "Request",
+    "RequestJournal",
     "RequestQueueFull",
+    "RequestShed",
     "ServeFuture",
     "ServeReplicaActor",
+    "ShedPolicy",
     "Slot",
     "autoscale_decision",
+    "install_sigterm_drain",
     "needs_relaunch",
     "pick_least_loaded",
 ]

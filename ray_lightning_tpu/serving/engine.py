@@ -63,9 +63,12 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ray_lightning_tpu import observability as _obs
+from ray_lightning_tpu.observability import metrics as _metrics
 from ray_lightning_tpu.observability import reqtrace as _reqtrace
+from ray_lightning_tpu.runtime import faults as _faults
 from ray_lightning_tpu.serving.kv_pool import KVSlotPool
 from ray_lightning_tpu.serving.paged_kv import PagedKVPool
+from ray_lightning_tpu.serving.resilience import RequestShed, ShedPolicy
 from ray_lightning_tpu.serving.scheduler import (
     ContinuousBatchScheduler,
     Request,
@@ -78,6 +81,7 @@ __all__ = [
     "EngineClosed",
     "InferenceEngine",
     "RequestQueueFull",
+    "RequestShed",
 ]
 
 # TTFT/ITL land in seconds; the default step/IO bounds start at 100 µs
@@ -109,6 +113,12 @@ class EngineConfig:
     ``num_kv_blocks`` sizes the block pool (default: the slot-
     equivalent ``num_slots * max_len / block_size`` + trash);
     ``prefix_cache`` toggles shared-prefix matching.
+
+    Resilience knobs: ``shed_watermark`` is the queue-fill fraction at
+    which priority >= 1 requests are shed (priority 0 never sheds;
+    see ``serving/resilience.py``). ``head_skip_limit`` /
+    ``head_aging_ticks`` bound the scheduler's skip-ahead window behind
+    a block-deferred FIFO head (0 = strict FIFO, the default).
     """
 
     num_slots: int = 4
@@ -125,6 +135,9 @@ class EngineConfig:
     block_size: Optional[int] = None  # None -> RLT_SERVE_BLOCK_SIZE or 16
     num_kv_blocks: Optional[int] = None
     prefix_cache: bool = True
+    shed_watermark: float = 0.9
+    head_skip_limit: int = 0
+    head_aging_ticks: int = 16
 
     def resolved_block_size(self) -> int:
         if self.block_size is not None:
@@ -142,6 +155,10 @@ class EngineConfig:
                 f"max_prompt_len ({self.max_prompt_len}) must be < max_len "
                 f"({self.max_len}): a full-length prompt still needs room "
                 "for at least one generated token"
+            )
+        if not 0.0 < self.shed_watermark:
+            raise ValueError(
+                f"shed_watermark must be > 0, got {self.shed_watermark}"
             )
         if self.kv_layout not in ("slot", "paged"):
             raise ValueError(
@@ -197,6 +214,11 @@ class Completion:
         return list(self.tokens)
 
     def _finish(self, reason: str, error: Optional[BaseException] = None):
+        # idempotent: a completion finished by the step loop must not be
+        # re-finished (and its reason clobbered) by a concurrent
+        # shutdown(drain=False) racing the same request
+        if self._done.is_set():
+            return
         self.finish_reason = reason
         self.error = error
         self._done.set()
@@ -212,6 +234,7 @@ class InferenceEngine:
         cfg,
         engine_config: Optional[EngineConfig] = None,
         kv_layout: Optional[str] = None,
+        replica_index: Optional[int] = None,
     ):
         import jax
 
@@ -238,7 +261,23 @@ class InferenceEngine:
             self.pool,
             max_queue=ecfg.max_queue,
             max_prefills_per_tick=ecfg.max_prefills_per_tick,
+            head_skip_limit=ecfg.head_skip_limit,
+            head_aging_ticks=ecfg.head_aging_ticks,
         )
+        self.scheduler.on_evict = self._on_queue_expired
+        # serving fault-injection identity (RLT_FAULT replica<N> specs);
+        # None = not a fleet member, serve faults never fire
+        self.replica_index = replica_index
+        self.shed_policy = ShedPolicy(queue_watermark=ecfg.shed_watermark)
+        # optional SLOMonitor whose serving breach couples into shedding
+        self.slo_monitor: Optional[Any] = None
+        # set by _fail_all: the error that killed the engine loop — the
+        # journal pump reads it (via `alive`) to trigger relaunch
+        self.failed: Optional[BaseException] = None
+        self._ticks = 0
+        self._admit_seq = 0
+        # request_id -> remaining-token budget armed by a drop-stream fault
+        self._drop_stream: Dict[str, int] = {}
         self._completions: Dict[str, Completion] = {}
         self._on_token: Dict[str, Callable[[str, int], Any]] = {}
         self._rng = jax.random.key(ecfg.seed)
@@ -393,10 +432,20 @@ class InferenceEngine:
         request_id: Optional[str] = None,
         eos_id: Any = "__default__",
         on_token: Optional[Callable[[str, int], Any]] = None,
+        deadline_ms: Optional[float] = None,
+        priority: int = 0,
+        retries: int = 0,
     ) -> Completion:
         """Enqueue one request; returns its :class:`Completion` handle.
 
+        ``deadline_ms`` is a TTL from now: once past it the request is
+        evicted (queued or decoding) with ``finish_reason="expired"``.
+        ``priority`` 0 is the protected class; >= 1 is sheddable (see
+        ``EngineConfig.shed_watermark``). ``retries`` is the journal's
+        attempt number, threaded into trace records.
+
         Raises :class:`RequestQueueFull` (bounded queue back-pressure),
+        :class:`RequestShed` (load-shed verdict on sheddable work),
         :class:`EngineClosed` after drain/shutdown, and ``ValueError``
         for prompts that do not fit the compiled shapes.
         """
@@ -411,6 +460,20 @@ class InferenceEngine:
             )
         if eos_id == "__default__":
             eos_id = self.engine_config.eos_id
+        if self.shed_policy.should_shed(
+            priority=int(priority),
+            queue_depth=self.scheduler.queue_depth,
+            max_queue=self.engine_config.max_queue,
+            slo_breached=self._slo_breached(),
+        ):
+            reg = _obs.registry()
+            if reg is not None:
+                reg.counter(_metrics.SERVE_SHED_METRIC).inc()
+            raise RequestShed(
+                f"request shed (priority={priority}): the engine is past "
+                "its queue watermark or burning SLO budget; retry later or "
+                "raise the request's priority class"
+            )
         rid = request_id or f"req-{next(self._req_counter)}"
         completion = Completion(rid)
         req = Request(
@@ -419,10 +482,17 @@ class InferenceEngine:
             max_new_tokens=int(max_new_tokens),
             eos_id=eos_id,
             on_token=on_token,
+            deadline=(
+                time.perf_counter() + float(deadline_ms) / 1e3
+                if deadline_ms is not None
+                else None
+            ),
+            priority=int(priority),
+            retries=int(retries),
         )
         if self._tracer is not None:
             req.trace = self._tracer.start(
-                rid, len(tokens), int(max_new_tokens)
+                rid, len(tokens), int(max_new_tokens), retries=int(retries)
             )
         with self._work:
             if self._closed:
@@ -453,12 +523,27 @@ class InferenceEngine:
         import jax
         import jax.numpy as jnp
 
+        self._ticks += 1
+        # scripted serving faults (RLT_FAULT replica<N> specs): crash
+        # raises out of step() -> the loop fails every in-flight request
+        # and dies, which is exactly the replica death the journal and
+        # breakers must recover from
+        _faults.fire_serve_tick_faults(self.replica_index, self._ticks)
+        self._evict_expired_slots()
         plan = self.scheduler.tick()
         ecfg = self.engine_config
         ck, cv = self.pool.cache["k"], self.pool.cache["v"]
 
         paged = self.kv_layout == "paged"
         for req, slot in plan.prefills:
+            self._admit_seq += 1
+            fspec = _faults.serve_request_fault(
+                self.replica_index, self._admit_seq
+            )
+            if fspec is not None and fspec.kind == "drop-stream":
+                self._drop_stream[req.request_id] = max(
+                    1, int(fspec.arg or 1)
+                )
             padded = np.zeros((1, ecfg.max_prompt_len), np.int32)
             padded[0, : req.prompt_len] = req.tokens
             tr = req.trace
@@ -512,9 +597,32 @@ class InferenceEngine:
             now = time.perf_counter()
             reg = _obs.registry()
             for slot in plan.decode_slots:
+                rid = slot.request_id
+                if rid is None:
+                    # released mid-step (re-entrant shutdown from an
+                    # on_token callback): nothing to deliver
+                    continue
                 tok = int(sampled_host[slot.index])
-                completion = self._completions.get(slot.request_id)
-                if completion is not None:
+                drop_after = self._drop_stream.get(rid)
+                if drop_after is not None and slot.generated >= drop_after:
+                    # scripted drop-stream fault: the request's stream
+                    # dies here — this token is never delivered, the
+                    # journal resumes from the tokens the client has
+                    self._drop_stream.pop(rid, None)
+                    completed.append(rid)
+                    self._finish(
+                        rid, "error",
+                        _faults.ServeFault(
+                            f"scripted serving fault: {rid} stream dropped "
+                            f"after {slot.generated} tokens"
+                        ),
+                    )
+                    if slot.trace is not None:
+                        self._tracer.finish(slot.trace, "error")
+                    self.pool.release(slot.index)
+                    continue
+                completion = self._completions.get(rid)
+                if completion is not None and not completion.done:
                     completion.tokens.append(tok)
                     if completion.ttft_s is None:
                         completion.ttft_s = now - completion.submitted_at
@@ -524,20 +632,24 @@ class InferenceEngine:
                                 "rlt_serve_ttft_seconds",
                                 bounds=LATENCY_BOUNDS,
                             ).observe(
-                                completion.ttft_s, exemplar=slot.request_id
+                                completion.ttft_s, exemplar=rid
                             )
                     elif reg is not None and slot.last_token_at is not None:
                         reg.histogram(
                             "rlt_serve_itl_seconds", bounds=LATENCY_BOUNDS
                         ).observe(
-                            now - slot.last_token_at, exemplar=slot.request_id
+                            now - slot.last_token_at, exemplar=rid
                         )
-                cb = self._on_token.get(slot.request_id)
-                if cb is not None:
-                    try:
-                        cb(slot.request_id, tok)
-                    except Exception:
-                        pass  # a broken stream consumer must not stall decode
+                    cb = self._on_token.get(rid)
+                    if cb is not None:
+                        try:
+                            cb(rid, tok)
+                        except Exception:
+                            pass  # broken stream consumer must not stall decode
+                    if slot.request_id != rid:
+                        # the callback re-entrantly shut down / finished
+                        # this request; the slot is no longer its tenant
+                        continue
                 if slot.first_token_at is None:
                     slot.first_token_at = now
                 slot.last_token_at = now
@@ -556,8 +668,8 @@ class InferenceEngine:
                 elif slot.generated >= slot.max_new_tokens:
                     reason = "length"
                 if reason is not None:
-                    completed.append(slot.request_id)
-                    self._finish(slot.request_id, reason)
+                    completed.append(rid)
+                    self._finish(rid, reason)
                     if tr is not None:
                         self._tracer.finish(tr, reason)
                     self.pool.release(slot.index)
@@ -587,6 +699,40 @@ class InferenceEngine:
             reg.counter("rlt_serve_completions_total", reason=reason).inc()
 
     # ------------------------------------------------------------------ #
+    # deadlines + shedding
+    # ------------------------------------------------------------------ #
+    def _slo_breached(self) -> bool:
+        mon = self.slo_monitor
+        if mon is None:
+            return False
+        try:
+            return bool(mon.serving_breached())
+        except AttributeError:
+            return bool(mon.breached())
+
+    def _on_queue_expired(self, req: Request) -> None:
+        """Scheduler evicted a queued request past its deadline."""
+        self._expire(req.request_id, req.trace)
+
+    def _evict_expired_slots(self) -> None:
+        """Evict decoding requests past their deadline: fail the
+        completion with ``finish_reason="expired"`` (partial tokens stay
+        readable) and recycle the slot's KV capacity immediately."""
+        now = time.perf_counter()
+        for slot in self.pool.active_slots():
+            if slot.deadline is not None and now > slot.deadline:
+                self._expire(slot.request_id, slot.trace)
+                self.pool.release(slot.index)
+
+    def _expire(self, request_id: str, trace: Optional[Any]) -> None:
+        self._finish(request_id, "expired")
+        if trace is not None:
+            self._tracer.finish(trace, "expired")
+        reg = _obs.registry()
+        if reg is not None:
+            reg.counter(_metrics.SERVE_DEADLINE_EXPIRED_METRIC).inc()
+
+    # ------------------------------------------------------------------ #
     # loop thread + lifecycle
     # ------------------------------------------------------------------ #
     def start(self) -> None:
@@ -613,6 +759,7 @@ class InferenceEngine:
                 return
 
     def _fail_all(self, error: BaseException) -> None:
+        self.failed = error
         for req in self.scheduler.drain_queue():
             self._finish(req.request_id, "error", error)
             if req.trace is not None:
@@ -622,6 +769,44 @@ class InferenceEngine:
             if slot.trace is not None:
                 self._tracer.finish(slot.trace, "error")
             self.pool.release(slot.index)
+
+    @property
+    def alive(self) -> bool:
+        """False once the engine loop has died (``_fail_all`` ran) — the
+        replica is unusable and must be discarded/relaunched. A never-
+        started engine (single-threaded driving) counts as alive."""
+        if self.failed is not None:
+            return False
+        thread = self._thread
+        return thread is None or thread.is_alive()
+
+    def handback_queued(self) -> List[Dict[str, Any]]:
+        """Preemption/drain-timeout path: stop admission and hand back
+        every queued (not yet admitted) request as a resubmittable spec.
+
+        Their completions finish with ``finish_reason="cancelled"`` (no
+        error): the journal treats that as "resubmit elsewhere, no
+        failure charged", so a drained replica's backlog migrates
+        instead of being silently dropped."""
+        with self._work:
+            self._closed = True
+        out: List[Dict[str, Any]] = []
+        for req in self.scheduler.drain_queue():
+            self._finish(req.request_id, "cancelled")
+            if req.trace is not None:
+                self._tracer.finish(req.trace, "cancelled")
+            out.append(
+                {
+                    "request_id": req.request_id,
+                    "prompt": list(req.tokens),
+                    "max_new_tokens": req.max_new_tokens,
+                    "eos_id": req.eos_id,
+                    "priority": req.priority,
+                    "deadline": req.deadline,
+                    "retries": req.retries,
+                }
+            )
+        return out
 
     def run_until_idle(self, max_steps: int = 100_000) -> None:
         """Single-threaded drive: step until queue and pool are empty."""

@@ -54,6 +54,12 @@ class Slot:
     admitted_at: float = 0.0
     first_token_at: Optional[float] = None
     last_token_at: Optional[float] = None
+    # absolute deadline (time.perf_counter domain) and priority class of
+    # the tenant — the engine evicts expired slots at tick start so a
+    # dead-on-arrival stream stops burning decode flops and its KV
+    # capacity recycles immediately
+    deadline: Optional[float] = None
+    priority: int = 0
     # the tenant's RequestTrace (None when telemetry is off or the
     # request was not head-sampled) — the decode loop's only per-token
     # tracing cost is reading this attribute
@@ -74,6 +80,8 @@ class Slot:
         self.admitted_at = 0.0
         self.first_token_at = None
         self.last_token_at = None
+        self.deadline = None
+        self.priority = 0
         self.trace = None
 
 
@@ -130,6 +138,8 @@ class KVSlotPool:
         max_new_tokens: int,
         eos_id: Optional[int] = None,
         prompt_tokens: Optional[Sequence[int]] = None,
+        deadline: Optional[float] = None,
+        priority: int = 0,
     ) -> Optional[Slot]:
         """Claim a free slot for a request; ``None`` when the pool is full.
 
@@ -160,6 +170,8 @@ class KVSlotPool:
         slot.prompt_len = int(prompt_len)
         slot.max_new_tokens = int(max_new_tokens)
         slot.eos_id = eos_id
+        slot.deadline = deadline
+        slot.priority = int(priority)
         slot.generated = 0
         slot.admitted_at = time.perf_counter()
         slot.first_token_at = None

@@ -491,6 +491,8 @@ class PagedKVPool:
         max_new_tokens: int,
         eos_id: Optional[int] = None,
         prompt_tokens: Optional[Sequence[int]] = None,
+        deadline: Optional[float] = None,
+        priority: int = 0,
     ) -> Optional[Slot]:
         """Admit by slot AND block availability; ``None`` when either is
         exhausted (the scheduler keeps the request queued)."""
@@ -521,6 +523,8 @@ class PagedKVPool:
         slot.prompt_len = int(prompt_len)
         slot.max_new_tokens = int(max_new_tokens)
         slot.eos_id = eos_id
+        slot.deadline = deadline
+        slot.priority = int(priority)
         slot.generated = 0
         slot.admitted_at = time.perf_counter()
         slot.first_token_at = None

@@ -26,11 +26,21 @@ later call.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_lightning_tpu import observability as _obs
+from ray_lightning_tpu.observability import metrics as _metrics
+from ray_lightning_tpu.serving.resilience import (
+    BREAKER_CLOSED,
+    CircuitBreaker,
+    JournalEntry,
+    RequestJournal,
+    RequestShed,
+    publish_breaker_states,
+)
 
 __all__ = [
     "Autoscaler",
@@ -276,17 +286,42 @@ class _LoadTap:
 # threads-as-replicas fleet (single process; the autoscaler's CPU target)
 # --------------------------------------------------------------------- #
 class LocalReplicaFleet:
-    """An elastic fleet of in-process engines, one loop THREAD each.
+    """An elastic, self-healing fleet of in-process engines, one loop
+    THREAD each.
 
     Same routing/scaling surface as :class:`ReplicaGroup` but without
     actors: every replica shares this process's params (free on CPU,
-    where the autoscaler e2e runs), so ``add_replica`` costs one engine
-    construction and ``remove_replica`` is a true graceful drain — the
-    replica leaves the routing set immediately, its engine finishes
-    every admitted request, and only then is it discarded. Submissions
-    return the engine's own :class:`~.engine.Completion`, which stays
-    valid across the owning replica's drain — that is the zero-dropped-
-    requests guarantee the autoscaler e2e asserts.
+    where the autoscaler and chaos e2es run), so ``add_replica`` costs
+    one engine construction and ``remove_replica`` is a true graceful
+    drain.
+
+    Every submission is recorded in a :class:`RequestJournal` and the
+    returned handle is a :class:`JournalEntry` (Completion-compatible:
+    ``result()`` / ``tokens`` / ``done`` / ``finish_reason``), which is
+    what makes the request survive its replica:
+
+    - a replica that crashes mid-stream fails the attempt, not the
+      request — the pump resubmits ``prompt + delivered`` to a healthy
+      replica with the remaining budget, and the greedy continuation is
+      bitwise-identical to the unfaulted stream. Size ``max_prompt_len``
+      for the RESUME prefill: a request is recoverable at any point of
+      its stream only when ``prompt_len + max_new_tokens - 1`` fits the
+      compiled prefill shape (otherwise a mid-stream death past the
+      prefill limit fails the request rather than resuming it);
+    - each replica index owns a :class:`CircuitBreaker`: consecutive
+      failures eject it from routing, and it only re-earns traffic by
+      passing the single half-open probe after cooldown. The breaker is
+      keyed by INDEX, so it survives a relaunch — a crash-looping
+      replica stays ejected no matter how fresh its engine is;
+    - dead engines (loop thread killed by a fault) are discarded and,
+      with ``relaunch=True``, rebuilt under the same index;
+    - :meth:`preempt_replica` / SIGTERM (via
+      :func:`~.resilience.install_sigterm_drain`) drain gracefully: the
+      queued backlog is handed back and migrates, in-flight work
+      finishes.
+
+    The recovery loop lives in a pump thread; tests call
+    :meth:`pump_once` directly for deterministic stepping.
     """
 
     def __init__(
@@ -294,6 +329,12 @@ class LocalReplicaFleet:
         builder: Callable[[], Tuple[Any, Any]],
         engine_kwargs: Optional[Dict[str, Any]] = None,
         initial_replicas: int = 1,
+        max_retries: int = 2,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 5.0,
+        relaunch: bool = True,
+        drain_timeout: float = 60.0,
+        pump_interval_s: float = 0.02,
     ):
         self._builder = builder
         self._engine_kwargs = dict(engine_kwargs or {})
@@ -306,8 +347,25 @@ class LocalReplicaFleet:
         self._lock = threading.Lock()
         self.added_total = 0
         self.removed_total = 0
+        self.max_retries = int(max_retries)
+        self.relaunch = bool(relaunch)
+        self.drain_timeout = float(drain_timeout)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.journal = RequestJournal()
+        self.breakers: Dict[int, CircuitBreaker] = {}
+        self.routed_total: Dict[int, int] = {}
+        self.relaunches_total = 0
+        self._pending: List[JournalEntry] = []
+        self._pump_interval = max(float(pump_interval_s), 0.005)
+        self._pump_gate = threading.Lock()
+        self._pump_stop = threading.Event()
         for _ in range(int(initial_replicas)):
             self.add_replica()
+        self._pump_thread = threading.Thread(
+            target=self._pump_loop, daemon=True, name="rlt-fleet-pump"
+        )
+        self._pump_thread.start()
 
     # ---------------- fleet surface (Autoscaler duck type) ------------- #
     @property
@@ -320,7 +378,22 @@ class LocalReplicaFleet:
             replicas = dict(self._replicas)
         return {i: eng.load() for i, eng in replicas.items()}
 
-    def add_replica(self) -> int:
+    def _breaker(self, index: int) -> CircuitBreaker:
+        with self._lock:
+            breaker = self.breakers.get(index)
+            if breaker is None:
+                breaker = CircuitBreaker(
+                    failure_threshold=self.breaker_threshold,
+                    open_cooldown_s=self.breaker_cooldown_s,
+                )
+                self.breakers[index] = breaker
+            return breaker
+
+    def add_replica(self, index: Optional[int] = None) -> int:
+        """Build + start one engine. ``index=None`` allocates a fresh
+        index (scale-up); an explicit index is the relaunch path — the
+        new engine inherits the index's circuit breaker, so a replica
+        that died with an open breaker still has to pass its probe."""
         from ray_lightning_tpu.serving.engine import (
             EngineConfig,
             InferenceEngine,
@@ -331,14 +404,21 @@ class LocalReplicaFleet:
             # params, and on CPU duplicate weights would be pure waste
             self._params_cfg = self._builder()
         params, cfg = self._params_cfg
+        with self._lock:
+            if index is None:
+                index = self._next_index
+                self._next_index += 1
+            else:
+                self._next_index = max(self._next_index, index + 1)
         engine = InferenceEngine(
-            params, cfg, EngineConfig(**self._engine_kwargs)
+            params, cfg, EngineConfig(**self._engine_kwargs),
+            replica_index=index,
         )
         engine.start()
         with self._lock:
-            index = self._next_index
-            self._next_index += 1
             self._replicas[index] = engine
+            self.routed_total.setdefault(index, 0)
+        self._breaker(index)
         self.added_total += 1
         self._publish_size()
         return index
@@ -356,7 +436,15 @@ class LocalReplicaFleet:
             self._draining[index] = engine
 
         def drain_and_discard():
-            engine.drain()  # finishes queued + in-flight, stops the loop
+            engine.drain(timeout=self.drain_timeout)
+            if engine.scheduler.has_work():
+                # drain timed out with work still held (wedged replica):
+                # hand the queued backlog back (cancelled -> the pump
+                # migrates it, no failure charged) and fail what was
+                # already admitted so it retries elsewhere — nothing is
+                # silently dropped
+                engine.handback_queued()
+                engine.shutdown(drain=False)
             with self._lock:
                 self._draining.pop(index, None)
 
@@ -370,40 +458,293 @@ class LocalReplicaFleet:
         self._publish_size()
         return index
 
+    def preempt_replica(self, index: int) -> bool:
+        """Graceful preemption of one replica: it leaves routing now,
+        its queued backlog is handed back (and migrates via the pump),
+        its admitted requests finish, then the engine is discarded."""
+        with self._lock:
+            engine = self._replicas.pop(index, None)
+            if engine is None:
+                return False
+            self._draining[index] = engine
+        self._publish_size()
+        engine.handback_queued()
+
+        def finish_and_discard():
+            engine.drain(timeout=self.drain_timeout)
+            with self._lock:
+                self._draining.pop(index, None)
+
+        t = threading.Thread(
+            target=finish_and_discard, daemon=True,
+            name=f"rlt-fleet-preempt-{index}",
+        )
+        t.start()
+        self._drain_threads.append(t)
+        return True
+
+    def preempt_all(self) -> None:
+        """Whole-fleet preemption notice (the SIGTERM handler's target):
+        stop admission and drain everything — in-flight and queued work
+        finishes before the process exits."""
+        self.shutdown()
+
     # ---------------- request path ------------------------------------- #
     def submit(
         self,
         prompt_tokens: Sequence[int],
         max_new_tokens: int = 16,
         eos_id: Any = "__default__",
-    ):
-        """Route to the least-loaded routable replica; returns the
-        engine's Completion handle (valid across drains)."""
+        on_token: Optional[Callable[[str, int], Any]] = None,
+        deadline_ms: Optional[float] = None,
+        priority: int = 0,
+        request_id: Optional[str] = None,
+        max_retries: Optional[int] = None,
+    ) -> JournalEntry:
+        """Journal the request and route it to the least-loaded replica
+        whose breaker admits traffic. Returns the journal entry — a
+        Completion-compatible handle that stays valid across replica
+        drains, deaths, and retries."""
+        deadline = (
+            time.perf_counter() + float(deadline_ms) / 1e3
+            if deadline_ms is not None
+            else None
+        )
+        entry = self.journal.open(
+            tuple(int(t) for t in prompt_tokens),
+            max_new_tokens,
+            eos_id=eos_id,
+            deadline=deadline,
+            priority=int(priority),
+            on_token=on_token,
+            max_retries=(
+                self.max_retries if max_retries is None else int(max_retries)
+            ),
+            request_id=request_id,
+        )
+        self._dispatch(entry)
+        if entry.done and entry.error is not None:
+            # shed / rejected at the front door: surface the engine's
+            # back-pressure semantics to the submitter
+            raise entry.error
+        return entry
+
+    def _dispatch(self, entry: JournalEntry, exclude: Tuple[int, ...] = ()) -> bool:
+        """Route one journal attempt. True when the attempt is live on
+        an engine (or the entry reached a terminal disposition); False
+        when no replica can take it right now — the entry is parked and
+        the pump retries it."""
+        if entry.done:
+            return True
+        if entry.deadline_exceeded():
+            self._expire(entry)
+            return True
+        if entry.remaining_budget() <= 0:
+            # the dying replica delivered the full budget before its
+            # failure was observed — nothing left to run
+            self.journal.finish(entry, "completed", finish_reason="length")
+            return True
         with self._lock:
-            if not self._replicas:
-                raise RuntimeError("fleet has no replicas")
             replicas = dict(self._replicas)
             rr = self._rr
             self._rr += 1
-        loads = {i: eng.load() for i, eng in replicas.items()}
-        index = pick_least_loaded(loads, 0, rr, indices=list(replicas))
-        completion = replicas[index].submit(
-            prompt_tokens, max_new_tokens=max_new_tokens, eos_id=eos_id
+        live = {
+            i: eng
+            for i, eng in replicas.items()
+            if i not in exclude and eng.alive
+        }
+        closed: List[int] = []
+        probe: Optional[int] = None
+        for i in sorted(live):
+            breaker = self._breaker(i)
+            if breaker.state == BREAKER_CLOSED:
+                closed.append(i)
+            elif probe is None and breaker.allow_request():
+                # the one post-cooldown probe: this request IS the canary
+                probe = i
+        if probe is not None:
+            index = probe
+        elif closed:
+            loads = {i: live[i].load() for i in closed}
+            index = pick_least_loaded(loads, 0, rr, indices=closed)
+        else:
+            # nothing routable this instant (all dead/open/draining):
+            # park for the pump — relaunch or a cooldown will free a slot
+            with self._lock:
+                self._pending.append(entry)
+            return False
+        rid, prompt, budget = self.journal.begin_attempt(entry, index)
+        remaining_ms = (
+            max((entry.deadline - time.perf_counter()) * 1e3, 0.0)
+            if entry.deadline is not None
+            else None
         )
+        try:
+            completion = live[index].submit(
+                prompt,
+                max_new_tokens=budget,
+                request_id=rid,
+                eos_id=entry.eos_id,
+                on_token=self.journal.stream_guard(entry, rid),
+                deadline_ms=remaining_ms,
+                priority=entry.priority,
+                retries=entry.attempts - 1,
+            )
+        except RequestShed as e:
+            self.journal.abort_attempt(entry)
+            self.journal.finish(entry, "shed", finish_reason="shed", error=e)
+            return True
+        except ValueError as e:
+            # malformed for EVERY replica (e.g. resumed prompt exceeds
+            # the compiled prefill shape): retrying elsewhere cannot help
+            self.journal.abort_attempt(entry)
+            self.journal.finish(
+                entry, "failed", finish_reason="error", error=e
+            )
+            return True
+        except Exception as e:  # EngineClosed, RequestQueueFull, dying replica
+            self.journal.abort_attempt(entry)
+            nxt = tuple(exclude) + (index,)
+            if any(i not in nxt for i in live):
+                return self._dispatch(entry, exclude=nxt)
+            self.journal.finish(
+                entry, "failed", finish_reason="error", error=e
+            )
+            return True
+        self.journal.bind(entry, completion)
+        with self._lock:
+            self.routed_total[index] = self.routed_total.get(index, 0) + 1
         _obs.event(
-            "req/route", request_id=completion.request_id, replica=index,
-            track=f"req {completion.request_id}",
+            "req/route", request_id=rid, replica=index,
+            attempt=entry.attempts, track=f"req {entry.request_id}",
         )
-        return completion
+        return True
+
+    def _expire(self, entry: JournalEntry) -> None:
+        self.journal.finish(entry, "expired", finish_reason="expired")
+        reg = _obs.registry()
+        if reg is not None:
+            reg.counter(_metrics.SERVE_DEADLINE_EXPIRED_METRIC).inc()
+
+    def _retry_or_fail(
+        self,
+        entry: JournalEntry,
+        error: Optional[BaseException],
+        exclude: Tuple[int, ...] = (),
+    ) -> None:
+        if entry.attempts > entry.max_retries:
+            self.journal.finish(
+                entry,
+                "failed",
+                finish_reason="error",
+                error=error
+                or RuntimeError(
+                    f"request {entry.request_id!r}: retries exhausted "
+                    f"after {entry.attempts} attempts"
+                ),
+            )
+            return
+        self._dispatch(
+            entry, exclude=tuple(i for i in exclude if i is not None)
+        )
+
+    # ---------------- recovery pump ------------------------------------ #
+    def _pump_loop(self) -> None:
+        while not self._pump_stop.wait(self._pump_interval):
+            try:
+                self.pump_once()
+            except Exception:
+                pass  # the pump is the fleet's heart — it must not die
+
+    def pump_once(self) -> None:
+        """One recovery sweep: settle finished attempts (feeding the
+        breakers), relaunch dead engines, redispatch parked work, and
+        publish breaker gauges. The pump thread calls this continuously;
+        tests call it directly for deterministic stepping."""
+        with self._pump_gate:
+            self._pump_locked()
+
+    def _pump_locked(self) -> None:
+        # 1) settle finished attempts
+        for entry in self.journal.inflight():
+            with entry._lock:
+                completion = entry.attempt_completion
+                replica = entry.replica
+            if completion is None or not completion.done:
+                continue
+            reason = completion.finish_reason
+            if completion.error is None and reason in ("eos", "length"):
+                if replica is not None:
+                    self._breaker(replica).record_success()
+                self.journal.finish(entry, "completed", finish_reason=reason)
+            elif reason == "expired":
+                self._expire(entry)
+            elif reason == "cancelled":
+                # handback from a draining/preempted replica: migrate,
+                # no failure charged against the breaker
+                self._dispatch(entry)
+            else:
+                if replica is not None:
+                    self._breaker(replica).record_failure()
+                self._retry_or_fail(
+                    entry, completion.error, exclude=(replica,)
+                )
+        # 2) discard + relaunch dead engines under the SAME index: the
+        #    breaker (and its open state) survives the relaunch
+        with self._lock:
+            dead = [
+                (i, e) for i, e in self._replicas.items() if not e.alive
+            ]
+            for i, _ in dead:
+                self._replicas.pop(i, None)
+        for index, engine in dead:
+            self.relaunches_total += 1
+            _obs.event(
+                "serve/replica_dead", replica=index,
+                error=repr(engine.failed),
+            )
+            if self.relaunch:
+                self.add_replica(index=index)
+            else:
+                self._publish_size()
+        # 3) redispatch parked entries
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for entry in pending:
+            if not entry.done:
+                self._dispatch(entry)
+        # 4) breaker state gauges
+        with self._lock:
+            breakers = dict(self.breakers)
+        publish_breaker_states(breakers)
+
+    def stats(self) -> Dict[str, Any]:
+        """Journal dispositions + fleet recovery counters."""
+        out: Dict[str, Any] = self.journal.stats()
+        out["relaunches"] = self.relaunches_total
+        out["routed"] = dict(self.routed_total)
+        out["breakers"] = {i: b.state for i, b in self.breakers.items()}
+        return out
 
     def shutdown(self) -> None:
         with self._lock:
             engines = list(self._replicas.values())
             self._replicas.clear()
         for engine in engines:
-            engine.drain()
+            engine.drain(timeout=self.drain_timeout)
         for t in self._drain_threads:
             t.join(timeout=30)
+        self._pump_stop.set()
+        if self._pump_thread.is_alive():
+            self._pump_thread.join(timeout=5)
+        self.pump_once()  # settle the final completions
+        for entry in self.journal.inflight():
+            self.journal.finish(
+                entry,
+                "failed",
+                finish_reason="error",
+                error=RuntimeError("fleet shut down"),
+            )
 
     def _publish_size(self) -> None:
         reg = _obs.registry()
@@ -437,8 +778,11 @@ class ServeReplicaActor:
             _obs.enable()
         params, cfg = builder()
         self.replica_index = int(replica_index)
+        # replica_index arms this replica's RLT_FAULT serving specs
+        # (``replica<N>:crash@...``) inside the actor process
         self.engine = InferenceEngine(
-            params, cfg, EngineConfig(**(engine_kwargs or {}))
+            params, cfg, EngineConfig(**(engine_kwargs or {})),
+            replica_index=self.replica_index,
         )
         self._finished: Dict[str, Dict[str, Any]] = {}
         self._install_finish_hook()
@@ -480,11 +824,27 @@ class ServeReplicaActor:
         prompt_tokens: Sequence[int],
         max_new_tokens: int = 16,
         eos_id: Any = "__default__",
+        request_id: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+        priority: int = 0,
+        retries: int = 0,
     ) -> str:
         completion = self.engine.submit(
-            prompt_tokens, max_new_tokens=max_new_tokens, eos_id=eos_id
+            prompt_tokens,
+            max_new_tokens=max_new_tokens,
+            request_id=request_id,
+            eos_id=eos_id,
+            deadline_ms=deadline_ms,
+            priority=int(priority),
+            retries=int(retries),
         )
         return completion.request_id
+
+    def handback(self) -> List[Dict[str, Any]]:
+        """Stop admission and return the queued (not yet admitted)
+        backlog as resubmittable specs — the driver migrates it to the
+        surviving replicas on a drain timeout or preemption notice."""
+        return self.engine.handback_queued()
 
     def poll(self, request_id: str) -> Dict[str, Any]:
         completion = self.engine._completions.get(request_id)
@@ -592,6 +952,9 @@ class ReplicaGroup:
         env: Optional[Dict[str, str]] = None,
         telemetry: bool = False,
         actor_timeout: float = 180.0,
+        max_retries: int = 2,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 10.0,
     ):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
@@ -617,6 +980,27 @@ class ReplicaGroup:
         self._lock = threading.Lock()
         self._queue = None
         self._supervisor = None
+        # request recovery: driver-owned ids + per-request resubmission
+        # records, and a circuit breaker per replica index
+        self.max_retries = int(max_retries)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.breakers: Dict[int, CircuitBreaker] = {}
+        self.routed_total: Dict[int, int] = {}
+        self.retries_total = 0
+        self._meta: Dict[str, Dict[str, Any]] = {}
+        self._req_seq = itertools.count()
+
+    def _breaker(self, index: int) -> CircuitBreaker:
+        with self._lock:
+            breaker = self.breakers.get(index)
+            if breaker is None:
+                breaker = CircuitBreaker(
+                    failure_threshold=self.breaker_threshold,
+                    open_cooldown_s=self.breaker_cooldown_s,
+                )
+                self.breakers[index] = breaker
+            return breaker
 
     @property
     def num_replicas(self) -> int:
@@ -711,7 +1095,15 @@ class ReplicaGroup:
             try:
                 handle.drain.remote().result(timeout=self._actor_timeout)
             except Exception:
-                pass
+                # the drain timed out or the actor died mid-drain: pull
+                # the queued (never admitted) backlog back to the driver
+                # and mark it for redispatch — scale-down must never
+                # silently drop a request
+                try:
+                    specs = handle.handback.remote().result(timeout=10.0)
+                except Exception:
+                    specs = []
+                self._recover_handback(index, specs)
             # futures poll by index: hold the actor until every
             # outstanding result() has been served
             deadline = time.monotonic() + self._actor_timeout
@@ -788,56 +1180,251 @@ class ReplicaGroup:
                 pass
             self._queue = None
 
+    def preempt_all(self) -> None:
+        """Preemption notice (the SIGTERM handler's target): drain every
+        replica — each finishes its admitted work — then release them."""
+        self.shutdown()
+
     # ------------------------------ routing ---------------------------- #
     def submit(
         self,
         prompt_tokens: Sequence[int],
         max_new_tokens: int = 16,
         eos_id: Any = "__default__",
+        deadline_ms: Optional[float] = None,
+        priority: int = 0,
     ) -> ServeFuture:
+        """Route one request; returns a :class:`ServeFuture`.
+
+        The request id is DRIVER-minted and the submission parameters are
+        journaled in ``_meta``, so if the owning replica dies, hangs, or
+        times out its drain, :meth:`_poll` resubmits ``prompt + tokens
+        delivered so far`` to another replica (bounded by
+        ``max_retries``) and the caller's future resolves as if nothing
+        happened."""
         if not self.handles:
             raise RuntimeError("ReplicaGroup.start() first")
+        rid = f"g{next(self._req_seq)}"
+        meta: Dict[str, Any] = {
+            "prompt": [int(t) for t in prompt_tokens],
+            "max_new_tokens": int(max_new_tokens),
+            "eos_id": eos_id,
+            "deadline": (
+                time.monotonic() + float(deadline_ms) / 1e3
+                if deadline_ms is not None
+                else None
+            ),
+            "priority": int(priority),
+            "prefix": [],     # tokens recovered from completed attempts
+            "last_seen": [],  # current attempt's tokens at last poll
+            "attempts": 0,
+            "exclude": (),
+        }
         with self._lock:
-            routable = [i for i in self.handles if i not in self._draining]
-            replica = pick_least_loaded(
-                self.tap.snapshot(), 0, self._rr, indices=routable
-            )
+            self._meta[rid] = meta
+        replica = self._dispatch_rid(rid, meta)
+        return ServeFuture(self, replica, rid)
+
+    def _dispatch_rid(
+        self, rid: str, meta: Dict[str, Any], exclude: Sequence[int] = ()
+    ) -> int:
+        """(Re)submit one journaled request to a breaker-approved
+        replica. Raises when nothing is routable right now (the caller's
+        next poll retries)."""
+        with self._lock:
+            routable = [
+                i for i in self.handles
+                if i not in self._draining and i not in exclude
+            ]
+            rr = self._rr
             self._rr += 1
+        closed: List[int] = []
+        probe: Optional[int] = None
+        for i in sorted(routable):
+            breaker = self._breaker(i)
+            if breaker.state == BREAKER_CLOSED:
+                closed.append(i)
+            elif probe is None and breaker.allow_request():
+                probe = i
+        if probe is not None:
+            replica = probe
+        elif closed:
+            replica = pick_least_loaded(
+                self.tap.snapshot(), 0, rr, indices=closed
+            )
+        elif routable:
+            # every breaker refuses and no probe is due: the group has
+            # no parking pump, so availability beats purity here
+            replica = pick_least_loaded(
+                self.tap.snapshot(), 0, rr, indices=routable
+            )
+        else:
+            raise RuntimeError("no routable replicas")
+        meta["attempts"] += 1
+        attempt = meta["attempts"]
+        attempt_rid = rid if attempt == 1 else f"{rid}~r{attempt - 1}"
+        prompt = meta["prompt"] + meta["prefix"]
+        budget = meta["max_new_tokens"] - len(meta["prefix"])
+        remaining_ms = None
+        if meta["deadline"] is not None:
+            remaining_ms = max(
+                (meta["deadline"] - time.monotonic()) * 1e3, 0.0
+            )
+        with self._lock:
             # count the routed request locally so a burst between two
             # heartbeats does not all land on the same replica
             entry = self.tap.loads.setdefault(replica, {})
             entry["queue_depth"] = float(entry.get("queue_depth", 0)) + 1
             handle = self.handles[replica]
-        rid = (
-            handle
-            .submit.remote(list(prompt_tokens), max_new_tokens, eos_id)
-            .result(timeout=30)
-        )
+        handle.submit.remote(
+            list(prompt), budget, meta["eos_id"], attempt_rid,
+            remaining_ms, meta["priority"], attempt - 1,
+        ).result(timeout=30)
+        with self._lock:
+            self._inflight[rid] = replica
+            meta["attempt_rid"] = attempt_rid
+            meta["last_seen"] = []
+            self.routed_total[replica] = (
+                self.routed_total.get(replica, 0) + 1
+            )
+        if attempt > 1:
+            self.retries_total += 1
+            reg = _obs.registry()
+            if reg is not None:
+                reg.counter(_metrics.SERVE_RETRIES_METRIC).inc()
         # routing leg of the request trace: an instant on the request's
         # own track in the DRIVER process (the engine-side spans live in
         # the replica's process)
         _obs.event(
             "req/route", request_id=rid, replica=replica,
-            track=f"req {rid}",
+            attempt=attempt, track=f"req {rid}",
         )
-        with self._lock:
-            self._inflight[rid] = replica
-        return ServeFuture(self, replica, rid)
+        return replica
 
     def _poll(self, replica: int, request_id: str) -> Dict[str, Any]:
         with self._lock:
+            replica = self._inflight.get(request_id, replica)
             handle = self.handles.get(replica)
+            meta = self._meta.get(request_id)
+        if meta is None:
+            # direct actor-submitted request (no driver journal): the
+            # original non-recovering semantics
+            if handle is None:
+                raise RuntimeError(
+                    f"replica {replica} is gone with request "
+                    f"{request_id!r} unresolved (released before "
+                    "collection — drain accounting bug)"
+                )
+            state = handle.poll.remote(request_id).result(timeout=30)
+            if state.get("done"):
+                with self._lock:
+                    self._inflight.pop(request_id, None)
+            return state
+        terminal = meta.get("terminal")
+        if terminal is not None:
+            return terminal
+        if meta.get("needs_dispatch"):
+            # a relaunch/handback invalidated the last attempt before a
+            # poll observed it — redispatch from the journaled record
+            try:
+                self._dispatch_rid(
+                    request_id, meta, exclude=meta.get("exclude", ())
+                )
+                meta["needs_dispatch"] = False
+                with self._lock:
+                    replica = self._inflight.get(request_id, replica)
+                    handle = self.handles.get(replica)
+            except Exception:
+                return {"done": False, "tokens": list(meta["prefix"])}
+        attempt_rid = meta.get("attempt_rid", request_id)
+        state: Optional[Dict[str, Any]] = None
+        failure: Optional[str] = None
         if handle is None:
-            raise RuntimeError(
-                f"replica {replica} is gone with request "
-                f"{request_id!r} unresolved (released before collection "
-                "— drain accounting bug)"
+            failure = f"replica {replica} is gone"
+        else:
+            try:
+                state = handle.poll.remote(attempt_rid).result(timeout=30)
+            except Exception as e:
+                failure = repr(e)
+        if state is not None and state.get("done"):
+            if state.get("finish_reason") == "cancelled":
+                # drained/preempted replica handed the request back:
+                # migrate without charging the breaker
+                return self._reroute(
+                    request_id, meta, replica,
+                    charge=False, last_error="cancelled",
+                )
+            if state.get("error"):
+                failure = str(state["error"])
+        if failure is not None:
+            return self._reroute(
+                request_id, meta, replica, charge=True, last_error=failure
             )
-        state = handle.poll.remote(request_id).result(timeout=30)
+        tokens = meta["prefix"] + list(state.get("tokens", ()))
         if state.get("done"):
+            self._breaker(replica).record_success()
+            out = dict(state)
+            out["tokens"] = tokens
+            out["retries"] = meta["attempts"] - 1
             with self._lock:
                 self._inflight.pop(request_id, None)
-        return state
+                meta["prefix"] = list(tokens)
+                meta["terminal"] = out
+            return out
+        with self._lock:
+            meta["last_seen"] = list(state.get("tokens", ()))
+        return {"done": False, "tokens": tokens}
+
+    def _reroute(
+        self,
+        rid: str,
+        meta: Dict[str, Any],
+        failed_replica: int,
+        charge: bool,
+        last_error: str,
+    ) -> Dict[str, Any]:
+        """One attempt died (or was handed back): roll the delivered
+        tokens into the resubmission prefix and redispatch elsewhere."""
+        if charge:
+            self._breaker(failed_replica).record_failure()
+        with self._lock:
+            meta["prefix"] = meta["prefix"] + list(meta.get("last_seen", []))
+            meta["last_seen"] = []
+            self._inflight.pop(rid, None)
+        if charge and meta["attempts"] > self.max_retries:
+            out = {
+                "done": True,
+                "tokens": list(meta["prefix"]),
+                "finish_reason": "error",
+                "error": (
+                    f"retries exhausted after {meta['attempts']} attempts"
+                    f" (last: {last_error})"
+                ),
+            }
+            with self._lock:
+                meta["terminal"] = out
+            return out
+        self.tap.record_event(
+            "serve_request_reroute", request_id=rid,
+            from_replica=failed_replica, reason=last_error,
+        )
+        if len(meta["prefix"]) >= meta["max_new_tokens"]:
+            # the dead replica had already produced the full budget
+            out = {
+                "done": True,
+                "tokens": list(meta["prefix"]),
+                "finish_reason": "length",
+                "retries": meta["attempts"] - 1,
+            }
+            with self._lock:
+                meta["terminal"] = out
+            return out
+        try:
+            self._dispatch_rid(rid, meta, exclude=(failed_replica,))
+        except Exception:
+            meta["needs_dispatch"] = True
+            meta["exclude"] = (failed_replica,)
+        return {"done": False, "tokens": list(meta["prefix"])}
 
     def loads(self) -> Dict[int, Dict[str, float]]:
         return self.tap.snapshot()
@@ -901,3 +1488,38 @@ class ReplicaGroup:
         self._supervisor.health[index] = WorkerHealth(rank=index)
         with self.tap._lock:
             self.tap.loads.pop(index, None)
+        # the old actor died with requests on it: charge the breaker once
+        # and mark every inflight request of this index for redispatch
+        # (the relaunched actor is fresh, so it stays a candidate)
+        self._breaker(index).record_failure()
+        with self._lock:
+            victims = [
+                rid for rid, idx in self._inflight.items() if idx == index
+            ]
+            for rid in victims:
+                meta = self._meta.get(rid)
+                if meta is not None:
+                    meta["prefix"] = (
+                        meta["prefix"] + list(meta.get("last_seen", []))
+                    )
+                    meta["last_seen"] = []
+                    meta["needs_dispatch"] = True
+                    meta["exclude"] = ()
+                    self._inflight.pop(rid, None)
+
+    def _recover_handback(
+        self, failed_index: int, specs: Sequence[Dict[str, Any]]
+    ) -> None:
+        """Mark handed-back queued requests for redispatch elsewhere."""
+        for spec in specs:
+            base = str(spec.get("request_id", "")).split("~", 1)[0]
+            with self._lock:
+                meta = self._meta.get(base)
+                if meta is not None and meta.get("terminal") is None:
+                    meta["needs_dispatch"] = True
+                    meta["exclude"] = (failed_index,)
+                    self._inflight.pop(base, None)
+            self.tap.record_event(
+                "serve_request_handback",
+                request_id=base, replica=failed_index,
+            )

@@ -1,0 +1,509 @@
+"""Serving resilience primitives: request journal, circuit breaker,
+load-shed policy.
+
+This is the serving analogue of the trainer's elastic-recovery stack
+(PR 7): the goal is that a replica death, hang, or drain timeout costs
+the *replica*, never the *request*. Three pure-host pieces, each
+unit-testable without a model:
+
+- :class:`RequestJournal` — the driver-side source of truth for every
+  submitted request: prompt, sampling budget, deadline, priority, and
+  the tokens streamed so far. When a replica dies mid-stream, the fleet
+  resubmits from ``prompt + delivered`` with ``max_new - len(delivered)``
+  remaining, so a greedy decode continues bitwise-identically and the
+  client stream resumes without a dropped or duplicated token. Each
+  dispatch gets its own attempt id; the :meth:`RequestJournal.stream_guard`
+  callback drops tokens from any attempt that is no longer current, which
+  is the idempotent on_token dedup guard (a half-dead replica can keep
+  calling the old callback — it lands nowhere).
+- :class:`CircuitBreaker` — per-replica health as a closed → open →
+  half-open state machine. Consecutive failures open the breaker, which
+  ejects the replica from routing; after a cooldown, exactly ONE probe
+  request is allowed through (half-open), and its outcome decides
+  between closing the breaker and re-opening it for another cooldown.
+- :class:`ShedPolicy` — deadline-aware admission control. Priority 0 is
+  never shed; lower classes (priority >= 1) are rejected while the SLO
+  burn-rate alert is firing or the admission queue is past its
+  watermark — load shedding BEFORE the queue melts down, rather than
+  queue-full errors after.
+
+The journal holds requests, not replicas: it composes with
+``LocalReplicaFleet`` (threads) and ``ReplicaGroup`` (actor processes)
+identically, because all it needs from the routing layer is "dispatch
+this (prompt, budget) somewhere and wire my guard as on_token".
+"""
+from __future__ import annotations
+
+import itertools
+import signal
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_lightning_tpu import observability as _obs
+from ray_lightning_tpu.observability import metrics as _metrics
+from ray_lightning_tpu.serving.scheduler import RequestQueueFull
+
+__all__ = [
+    "BREAKER_CLOSED",
+    "BREAKER_HALF_OPEN",
+    "BREAKER_OPEN",
+    "CircuitBreaker",
+    "JournalEntry",
+    "RequestJournal",
+    "RequestShed",
+    "ShedPolicy",
+    "install_sigterm_drain",
+]
+
+DISPOSITIONS = ("completed", "shed", "expired", "failed")
+
+
+class RequestShed(RequestQueueFull):
+    """Rejected by the load-shed policy (SLO burn or queue watermark).
+
+    Subclasses :class:`RequestQueueFull` so existing back-pressure
+    handling (retry with backoff, count as rejected) applies unchanged.
+    """
+
+
+# --------------------------------------------------------------------------
+# circuit breaker
+# --------------------------------------------------------------------------
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+_BREAKER_GAUGE_VALUE = {
+    BREAKER_CLOSED: 0,
+    BREAKER_HALF_OPEN: 1,
+    BREAKER_OPEN: 2,
+}
+
+
+class CircuitBreaker:
+    """Per-replica request-outcome health, closed → open → half-open.
+
+    ``failure_threshold`` consecutive failures open the breaker. While
+    open, :meth:`allow_request` refuses everything until
+    ``open_cooldown_s`` has elapsed, then lends exactly one probe
+    (transitioning to half-open); further requests are refused while the
+    probe is outstanding. A successful probe closes the breaker; a
+    failed one re-opens it for a fresh cooldown.
+
+    ``clock`` is injectable so tests can script cooldown expiry without
+    sleeping. All methods are thread-safe (router + journal pump).
+    """
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        open_cooldown_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got {failure_threshold}"
+            )
+        self.failure_threshold = int(failure_threshold)
+        self.open_cooldown_s = float(open_cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = BREAKER_CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_outstanding = False
+        # (ts, from_state, to_state) history — chaos tests assert on it
+        self.transitions: List[Tuple[float, str, str]] = []
+        self.failures_total = 0
+        self.successes_total = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def state_value(self) -> int:
+        """Gauge encoding: 0 closed, 1 half-open, 2 open."""
+        return _BREAKER_GAUGE_VALUE[self.state]
+
+    def _transition(self, to: str) -> None:
+        if to != self._state:
+            self.transitions.append((self._clock(), self._state, to))
+            self._state = to
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.successes_total += 1
+            self._consecutive_failures = 0
+            self._probe_outstanding = False
+            if self._state != BREAKER_CLOSED:
+                self._transition(BREAKER_CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.failures_total += 1
+            self._consecutive_failures += 1
+            if self._state == BREAKER_HALF_OPEN:
+                # failed probe: straight back to open, fresh cooldown
+                self._probe_outstanding = False
+                self._opened_at = self._clock()
+                self._transition(BREAKER_OPEN)
+            elif (
+                self._state == BREAKER_CLOSED
+                and self._consecutive_failures >= self.failure_threshold
+            ):
+                self._opened_at = self._clock()
+                self._transition(BREAKER_OPEN)
+
+    def allow_request(self) -> bool:
+        """May a request be routed to this replica right now?
+
+        The one ``True`` returned after an open breaker's cooldown IS
+        the half-open probe: the caller must route that request and
+        report its outcome, or the breaker stays half-open forever.
+        """
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                return True
+            if self._state == BREAKER_OPEN:
+                if self._clock() - self._opened_at >= self.open_cooldown_s:
+                    self._transition(BREAKER_HALF_OPEN)
+                    self._probe_outstanding = True
+                    return True
+                return False
+            # half-open: one probe at a time
+            if not self._probe_outstanding:
+                self._probe_outstanding = True
+                return True
+            return False
+
+
+def publish_breaker_states(breakers: Dict[int, CircuitBreaker]) -> None:
+    """Publish each breaker's state gauge (labeled by replica index)."""
+    reg = _obs.registry()
+    if reg is None:
+        return
+    for index, breaker in breakers.items():
+        reg.gauge(
+            _metrics.SERVE_BREAKER_STATE_METRIC, replica=str(index)
+        ).set(breaker.state_value())
+
+
+# --------------------------------------------------------------------------
+# load shedding
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ShedPolicy:
+    """When to reject low-priority work at the front door.
+
+    Priority 0 (the default class) is never shed — it only ever sees
+    queue-full back-pressure. Priority >= ``shed_priority_floor`` is
+    rejected while the serving SLO burn-rate alert is firing, or once
+    the admission queue crosses ``queue_watermark`` of its capacity:
+    shedding the sheddable BEFORE the queue is full keeps headroom for
+    the traffic that must not fail.
+    """
+
+    queue_watermark: float = 0.9
+    shed_priority_floor: int = 1
+
+    def should_shed(
+        self,
+        priority: int,
+        queue_depth: int,
+        max_queue: int,
+        slo_breached: bool = False,
+    ) -> bool:
+        if priority < self.shed_priority_floor:
+            return False
+        if slo_breached:
+            return True
+        return queue_depth >= self.queue_watermark * max_queue
+
+
+# --------------------------------------------------------------------------
+# request journal
+# --------------------------------------------------------------------------
+
+
+class JournalEntry:
+    """One journaled request: the durable record plus the caller-facing
+    handle (``result()`` / ``tokens`` / ``done``, mirroring
+    ``engine.Completion`` so fleet callers are oblivious to retries).
+
+    ``delivered`` is the client-visible token stream — the merge of every
+    attempt's output, appended only through the journal's stream guard,
+    so it can never hold a duplicated or out-of-order token. ``attempts``
+    counts dispatches; ``retries == attempts - 1``.
+    """
+
+    __slots__ = (
+        "request_id", "prompt", "max_new_tokens", "eos_id", "priority",
+        "deadline", "max_retries", "on_token", "delivered", "attempts",
+        "replica", "replica_history", "attempt_rid", "attempt_completion",
+        "disposition", "finish_reason", "error", "submitted_at",
+        "first_token_at", "_done", "_lock",
+    )
+
+    def __init__(
+        self,
+        request_id: str,
+        prompt: Tuple[int, ...],
+        max_new_tokens: int,
+        eos_id: Optional[int],
+        deadline: Optional[float],
+        priority: int,
+        on_token: Optional[Callable[[str, int], Any]],
+        max_retries: int,
+    ):
+        self.request_id = request_id
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.priority = int(priority)
+        self.on_token = on_token
+        self.max_retries = int(max_retries)
+        self.delivered: List[int] = []
+        self.attempts = 0
+        self.replica: Optional[int] = None
+        self.replica_history: List[int] = []
+        self.attempt_rid: Optional[str] = None
+        self.attempt_completion: Optional[Any] = None
+        self.disposition: Optional[str] = None
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+
+    # -- Completion-compatible surface ---------------------------------- #
+    @property
+    def tokens(self) -> List[int]:
+        return self.delivered
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def retries(self) -> int:
+        return max(0, self.attempts - 1)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until finished; returns the full delivered stream."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id!r} not finished within {timeout}s"
+            )
+        if self.error is not None:
+            raise self.error
+        return list(self.delivered)
+
+    def remaining_budget(self) -> int:
+        return self.max_new_tokens - len(self.delivered)
+
+    def deadline_exceeded(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.perf_counter() if now is None else now) > self.deadline
+
+
+class RequestJournal:
+    """Driver-side journal of every submitted request.
+
+    The routing layer (fleet or group) owns dispatch; the journal owns
+    the record: what was asked for, what has been delivered, how many
+    attempts were spent, and the final disposition (one of
+    ``completed`` / ``shed`` / ``expired`` / ``failed``).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[str, JournalEntry] = {}
+        self._auto_id = itertools.count()
+        self.retries_total = 0
+        self.dispositions: Dict[str, int] = {d: 0 for d in DISPOSITIONS}
+
+    # -- lifecycle ------------------------------------------------------- #
+    def open(
+        self,
+        prompt: Tuple[int, ...],
+        max_new_tokens: int,
+        eos_id: Optional[int] = None,
+        deadline: Optional[float] = None,
+        priority: int = 0,
+        on_token: Optional[Callable[[str, int], Any]] = None,
+        max_retries: int = 2,
+        request_id: Optional[str] = None,
+    ) -> JournalEntry:
+        rid = request_id or f"jreq-{next(self._auto_id)}"
+        entry = JournalEntry(
+            rid, tuple(int(t) for t in prompt), max_new_tokens, eos_id,
+            deadline, priority, on_token, max_retries,
+        )
+        with self._lock:
+            if rid in self._entries:
+                raise ValueError(f"duplicate request_id {rid!r}")
+            self._entries[rid] = entry
+        return entry
+
+    def begin_attempt(
+        self, entry: JournalEntry, replica: int
+    ) -> Tuple[str, Tuple[int, ...], int]:
+        """Start (re)dispatch of ``entry`` to ``replica``.
+
+        Returns ``(attempt_rid, attempt_prompt, attempt_budget)``: the
+        resubmission prompt is ``prompt + delivered`` (re-prefill from
+        everything the client already has) and the budget is whatever is
+        left of ``max_new_tokens`` — under greedy sampling the
+        continuation is bitwise-identical to the unfaulted stream.
+        """
+        with entry._lock:
+            entry.attempts += 1
+            entry.replica = replica
+            entry.replica_history.append(replica)
+            rid = (
+                entry.request_id
+                if entry.attempts == 1
+                else f"{entry.request_id}~r{entry.attempts - 1}"
+            )
+            entry.attempt_rid = rid
+            entry.attempt_completion = None
+            prompt = entry.prompt + tuple(entry.delivered)
+            budget = entry.remaining_budget()
+        return rid, prompt, budget
+
+    def bind(self, entry: JournalEntry, completion: Any) -> None:
+        """The attempt reached an engine queue: it is now live. Retries
+        are counted here (not at begin_attempt) so a dispatch that never
+        landed — engine closed, queue full, replica gone — can be
+        aborted and re-tried without inflating the retry metrics."""
+        with entry._lock:
+            entry.attempt_completion = completion
+            attempts = entry.attempts
+        if attempts > 1:
+            with self._lock:
+                self.retries_total += 1
+            reg = _obs.registry()
+            if reg is not None:
+                reg.counter(_metrics.SERVE_RETRIES_METRIC).inc()
+
+    def abort_attempt(self, entry: JournalEntry) -> None:
+        """Roll back a begin_attempt whose dispatch never reached an
+        engine (submit raised before any work happened)."""
+        with entry._lock:
+            entry.attempts = max(0, entry.attempts - 1)
+            entry.attempt_rid = None
+            entry.attempt_completion = None
+
+    def stream_guard(
+        self, entry: JournalEntry, attempt_rid: str
+    ) -> Callable[[str, int], None]:
+        """The on_token callback wired into the engine for one attempt.
+
+        Tokens are accepted only while ``attempt_rid`` is still the
+        entry's CURRENT attempt and the entry is not finished — a stale
+        attempt (superseded after a replica death, or a zombie replica
+        still decoding) streams into the void instead of duplicating
+        tokens. The client callback always sees the journal-level
+        request id and the merged stream.
+        """
+
+        def on_token(_rid: str, token: int) -> None:
+            with entry._lock:
+                if entry.done or entry.attempt_rid != attempt_rid:
+                    return
+                entry.delivered.append(int(token))
+                if entry.first_token_at is None:
+                    entry.first_token_at = time.perf_counter()
+                cb = entry.on_token
+            if cb is not None:
+                try:
+                    cb(entry.request_id, int(token))
+                except Exception:
+                    pass  # a broken consumer must not stall the stream
+
+        return on_token
+
+    def finish(
+        self,
+        entry: JournalEntry,
+        disposition: str,
+        finish_reason: Optional[str] = None,
+        error: Optional[BaseException] = None,
+    ) -> None:
+        if disposition not in DISPOSITIONS:
+            raise ValueError(f"unknown disposition {disposition!r}")
+        with entry._lock:
+            if entry._done.is_set():
+                return
+            entry.disposition = disposition
+            entry.finish_reason = finish_reason or disposition
+            entry.error = error
+            entry._done.set()
+        with self._lock:
+            self.dispositions[disposition] += 1
+
+    # -- views ----------------------------------------------------------- #
+    def get(self, request_id: str) -> Optional[JournalEntry]:
+        with self._lock:
+            return self._entries.get(request_id)
+
+    def inflight(self) -> List[JournalEntry]:
+        with self._lock:
+            return [e for e in self._entries.values() if not e.done]
+
+    def entries(self) -> List[JournalEntry]:
+        with self._lock:
+            return list(self._entries.values())
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self.dispositions)
+            out["retries"] = self.retries_total
+            out["open"] = sum(
+                1 for e in self._entries.values() if not e.done
+            )
+        return out
+
+
+# --------------------------------------------------------------------------
+# preemption drain
+# --------------------------------------------------------------------------
+
+
+def install_sigterm_drain(
+    target: Any, signum: int = signal.SIGTERM
+) -> Callable[[int, Any], None]:
+    """Install a SIGTERM handler that drains ``target`` gracefully.
+
+    On preemption notice the handler calls ``target.preempt_all()`` when
+    available (fleet/group: stop admission, migrate backlog, finish
+    in-flight work) and falls back to ``target.drain()``. Returns the
+    handler so tests — and embedders that multiplex signals — can invoke
+    it directly. Only callable from the main thread (CPython signal
+    rule); replica threads/actors never install their own.
+    """
+
+    def _handler(_signum: int, _frame: Any) -> None:
+        drain = getattr(target, "preempt_all", None) or getattr(
+            target, "drain", None
+        )
+        if drain is not None:
+            drain()
+
+    signal.signal(signum, _handler)
+    return _handler

@@ -18,6 +18,21 @@ Admission order is FIFO. The queue is bounded — a full queue raises
 :class:`RequestQueueFull` at submit time rather than buffering
 unboundedly, which is the back-pressure signal a front door needs to
 shed load instead of silently growing latency.
+
+Deadline awareness: a request may carry an absolute ``deadline``
+(``time.perf_counter`` domain). Each tick sweeps expired requests out of
+the queue BEFORE admission — there is no point prefilling work whose
+client already gave up — and reports them through ``on_evict`` so the
+engine can fail their completions with ``finish_reason="expired"``.
+
+Head-of-line policy: strict FIFO by default (``head_skip_limit=0``) — a
+deferred head admits nothing behind it, so long prompts cannot be
+starved by a stream of short ones. Setting ``head_skip_limit=N`` allows
+up to N later requests to be scanned for admission while the head is
+deferred, bounded by ``head_aging_ticks``: once the head has been
+deferred that many ticks, skip-ahead is suspended (the tick admits
+nothing past it) until the head finally fits — an aging bound that
+converts possible starvation into bounded extra latency.
 """
 from __future__ import annotations
 
@@ -45,6 +60,18 @@ class Request:
     eos_id: Optional[int] = None
     on_token: Optional[Callable[[str, int], Any]] = None
     submitted_at: float = field(default_factory=time.perf_counter)
+    # absolute deadline (perf_counter domain); None = no TTL. Expired
+    # requests are swept from the queue each tick and evicted from decode
+    # slots by the engine.
+    deadline: Optional[float] = None
+    # priority class: 0 = highest. The shed policy drops priority >= 1
+    # work first when the queue or the SLO budget is melting down.
+    priority: int = 0
+    # attempt number (0 = first submission) — stamped by the request
+    # journal on resubmission so traces/records expose the retry count
+    retries: int = 0
+    # ticks this request spent as a deferred queue head (aging signal)
+    deferred_ticks: int = 0
     # request-scoped trace context (reqtrace.RequestTrace), minted at
     # engine submit; None when telemetry is off or head sampling dropped it
     trace: Optional[Any] = None
@@ -74,6 +101,8 @@ class ContinuousBatchScheduler:
         pool: KVSlotPool,
         max_queue: int = 256,
         max_prefills_per_tick: int = 1,
+        head_skip_limit: int = 0,
+        head_aging_ticks: int = 16,
     ):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
@@ -82,14 +111,29 @@ class ContinuousBatchScheduler:
                 "max_prefills_per_tick must be >= 1, got "
                 f"{max_prefills_per_tick}"
             )
+        if head_skip_limit < 0:
+            raise ValueError(
+                f"head_skip_limit must be >= 0, got {head_skip_limit}"
+            )
+        if head_aging_ticks < 1:
+            raise ValueError(
+                f"head_aging_ticks must be >= 1, got {head_aging_ticks}"
+            )
         self.pool = pool
         self.max_queue = int(max_queue)
         self.max_prefills_per_tick = int(max_prefills_per_tick)
+        self.head_skip_limit = int(head_skip_limit)
+        self.head_aging_ticks = int(head_aging_ticks)
         self._queue: Deque[Request] = deque()
         self._lock = threading.Lock()
         self.queued_total = 0
         self.rejected_total = 0
         self.deferred_total = 0  # ticks the queue head waited for capacity
+        self.expired_total = 0  # queued requests swept past their deadline
+        self.skipped_total = 0  # admissions that jumped a deferred head
+        # engine hook: called (outside the lock) with each queued Request
+        # swept past its deadline so its Completion can be failed
+        self.on_evict: Optional[Callable[[Request], Any]] = None
 
     # ------------------------------------------------------------------ #
     # producer side (any thread)
@@ -131,36 +175,72 @@ class ContinuousBatchScheduler:
         Admission is peek-then-acquire: the pool may refuse the queue
         head (no free slot, or — paged layout — not enough KV blocks for
         the prompt plus its worst-case growth reservation), in which
-        case the head stays queued and this tick admits nothing more.
-        Strict FIFO head-of-line blocking is deliberate: skipping ahead
-        to a smaller request would starve long prompts under sustained
-        short-request load."""
+        case the head stays queued and, by default, this tick admits
+        nothing more. Strict FIFO head-of-line blocking is deliberate:
+        skipping ahead to a smaller request would starve long prompts
+        under sustained short-request load. ``head_skip_limit`` opens a
+        bounded skip-ahead window behind a deferred head, and
+        ``head_aging_ticks`` closes it again once the head has waited
+        too long (see the module docstring)."""
         prefills: List[Tuple[Request, Slot]] = []
+        expired: List[Request] = []
         with self._lock:
+            if any(r.deadline is not None for r in self._queue):
+                now = time.perf_counter()
+                kept: Deque[Request] = deque()
+                for req in self._queue:
+                    if req.deadline is not None and now > req.deadline:
+                        expired.append(req)
+                        self.expired_total += 1
+                    else:
+                        kept.append(req)
+                self._queue = kept
+            i = 0
             while (
-                self._queue
+                i < len(self._queue)
                 and len(prefills) < self.max_prefills_per_tick
             ):
-                req = self._queue[0]
+                req = self._queue[i]
+                # aging bound: an over-deferred head closes the
+                # skip-ahead window — nothing may jump it until it admits
+                if i > 0 and (
+                    self._queue[0].deferred_ticks > self.head_aging_ticks
+                ):
+                    break
                 slot = self.pool.acquire(
                     req.request_id,
                     req.prompt_len,
                     req.max_new_tokens,
                     eos_id=req.eos_id,
                     prompt_tokens=req.tokens,
+                    deadline=req.deadline,
+                    priority=req.priority,
                 )
-                if slot is None:  # back-pressure: keep the head queued
-                    self.deferred_total += 1
-                    if req.trace is not None:
-                        req.trace.deferred()
-                    break
-                self._queue.popleft()
+                if slot is None:  # back-pressure: keep the request queued
+                    if i == 0:
+                        req.deferred_ticks += 1
+                        self.deferred_total += 1
+                        if req.trace is not None:
+                            req.trace.deferred()
+                        if self.head_skip_limit == 0:
+                            break
+                    i += 1
+                    if i > self.head_skip_limit:
+                        break
+                    continue
+                del self._queue[i]
+                if i > 0:
+                    self.skipped_total += 1
                 if req.trace is not None:
                     req.trace.admitted(slot.index)
                     slot.trace = req.trace
                 prefills.append((req, slot))
+                # do not advance i: the next element shifted into place
             depth = len(self._queue)
         self._publish_depth(depth)
+        if expired and self.on_evict is not None:
+            for req in expired:
+                self.on_evict(req)
         return Plan(prefills=prefills, decode_slots=self.pool.active_slots())
 
     def has_work(self) -> bool:

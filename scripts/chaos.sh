@@ -15,6 +15,11 @@ echo "== elastic membership (shrink/grow, incl. sustained kill loop) =="
 # RLT_CHAOS_KILL_EVERY tunes the @every:<N> kill cadence of the loop test
 python -m pytest tests/test_elastic.py -v -m elastic -p no:cacheprovider "$@"
 
+echo "== serving resilience (journal recovery, breakers, kill loops) =="
+# RLT_CHAOS_KILL_EVERY also tunes the serving replica-kill cadence
+python -m pytest tests/test_resilience.py -v -m serving_chaos \
+    -p no:cacheprovider "$@"
+
 echo "== legacy relaunch/retry path (slow) =="
 python -m pytest tests/test_cli_and_checkpointing.py -v -m slow \
     -k "retries or relaunch" -p no:cacheprovider "$@"

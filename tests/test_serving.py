@@ -597,6 +597,12 @@ def test_local_fleet_autoscales_up_and_drains_down(model):
                 params, cfg, prompt, n_new
             )
         assert all(c.finish_reason == "length" for c in comps)
+        # zero-drop: scale-down must never lose a request to any
+        # non-completed disposition (shed / expired / failed)
+        stats = fleet.stats()
+        assert stats["completed"] == len(reqs)
+        assert stats["failed"] == 0 and stats["shed"] == 0
+        assert stats["expired"] == 0
 
         # idle: consecutive quiet ticks drain the fleet back to one
         deadline = time.time() + 60
@@ -748,3 +754,88 @@ def test_scheduler_deferral_stamps_trace(model):
     assert plan.prefills[0][1].trace is b.trace
     rec = b.trace.record("eos")
     assert rec["deferred_ticks"] == 2 and rec["deferred_wait_s"] > 0
+
+
+# --------------------------------------------------------------------- #
+# head-of-line aging: the skip-ahead window is BOUNDED
+# --------------------------------------------------------------------- #
+def test_scheduler_head_aging_closes_skip_window(model):
+    """``head_skip_limit`` lets small requests jump a deferred head, but
+    only until the head has waited ``head_aging_ticks`` — past that the
+    window closes and nothing may pass it, even work that would fit.
+    Regression for unbounded starvation of long prompts."""
+    _, cfg = model
+    # 6 data blocks (one is the trash block): a 4-block hog in residence
+    # leaves 2 free — the 4-block head cannot admit, 1-block tinies can
+    pool = PagedKVPool(cfg, num_slots=4, max_len=16, block_size=4,
+                       num_blocks=7, prefix_cache=False)
+    sched = ContinuousBatchScheduler(pool, max_queue=8,
+                                     max_prefills_per_tick=4,
+                                     head_skip_limit=2, head_aging_ticks=3)
+    sched.submit(Request("hog", tuple(range(1, 9)), max_new_tokens=8))
+    plan = sched.tick()
+    assert [r.request_id for r, _ in plan.prefills] == ["hog"]
+    hog_slot = plan.prefills[0][1]
+
+    sched.submit(Request("big", tuple(range(1, 9)), max_new_tokens=8))
+    sched.submit(Request("tiny1", (1, 2, 3), max_new_tokens=1))
+    sched.submit(Request("tiny2", (4, 5, 6), max_new_tokens=1))
+    sched.submit(Request("tiny3", (7, 8, 9), max_new_tokens=1))
+
+    plan = sched.tick()  # the window is open: two tinies jump the head
+    assert [r.request_id for r, _ in plan.prefills] == ["tiny1", "tiny2"]
+    assert sched.skipped_total == 2
+    tiny1_slot = plan.prefills[0][1]
+
+    for _ in range(3):  # the head keeps deferring against 0 free blocks
+        assert sched.tick().prefills == []
+
+    # head now aged past head_aging_ticks: tiny3 FITS in the freed
+    # block, but the closed window refuses to let it jump the queue
+    pool.release(tiny1_slot.index)
+    assert sched.tick().prefills == []
+    assert sched.skipped_total == 2
+    assert sched.queue_depth == 2
+
+    # capacity for the head itself: strict order resumes behind it
+    pool.release(hog_slot.index)
+    plan = sched.tick()
+    assert [r.request_id for r, _ in plan.prefills] == ["big", "tiny3"]
+    assert sched.queue_depth == 0
+
+
+# --------------------------------------------------------------------- #
+# shutdown vs streaming: the re-entrant race stays idempotent
+# --------------------------------------------------------------------- #
+def test_shutdown_mid_stream_suppresses_late_tokens(model):
+    """shutdown(drain=False) fired from INSIDE an on_token callback (the
+    engine loop thread): the completion finishes exactly once, tokens
+    already delivered stay readable, and nothing streams after the
+    shutdown — no duplicate delivery, no exception out of the loop."""
+    params, cfg = model
+    engine = InferenceEngine(
+        params, cfg, EngineConfig(num_slots=2, max_prompt_len=8, max_len=32)
+    )
+    engine.start()
+    streamed = []
+
+    def kill_switch(rid, tok):
+        streamed.append(tok)
+        engine.shutdown(drain=False)  # re-entrant from the loop thread
+
+    comp = engine.submit([2, 3, 5], max_new_tokens=8, on_token=kill_switch)
+    deadline = time.time() + 120
+    while not comp.done and time.time() < deadline:
+        time.sleep(0.01)
+    assert comp.done and comp.finish_reason == "error"
+    assert isinstance(comp.error, EngineClosed)
+    # exactly the one pre-shutdown token, delivered exactly once, and it
+    # is the true greedy token (the stream died clean, not corrupted)
+    assert streamed == _reference(params, cfg, [2, 3, 5], 1)
+    assert comp.tokens == streamed
+    time.sleep(0.3)
+    assert streamed == comp.tokens and len(streamed) == 1  # nothing late
+    assert not engine.alive
+    with pytest.raises(EngineClosed):
+        engine.submit([1, 2], max_new_tokens=2)
+    engine.shutdown(drain=False)  # second shutdown: idempotent no-op
