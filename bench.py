@@ -85,7 +85,18 @@ def _env_timeout(name: str, default: float) -> float:
 
 
 def _probe() -> int:
-    """Child: touch the native backend; print its platform if alive."""
+    """Child: touch the native backend; print its platform if alive.
+
+    The probe rides the shared persistent compile cache
+    (runtime/compile_cache.py's dir resolution), so a previously-probed
+    machine loads its matmul instead of compiling — the 600s probe
+    timeout was burning on compile time, not tunnel health."""
+    from ray_lightning_tpu.runtime.compile_cache import (
+        configure_jax_persistent_cache,
+        resolve_cache_dir,
+    )
+
+    configure_jax_persistent_cache(resolve_cache_dir())
     import jax
     import jax.numpy as jnp
 
@@ -970,6 +981,128 @@ def _attach_serve_sweep(result: dict, here: str, env: dict) -> None:
         }
 
 
+def _compile_sweep(args: argparse.Namespace) -> int:
+    """Child: the compile-time microbenchmark (--_compile_sweep).
+
+    Measures cold vs warm build time of the three real programs — the
+    llama train step and the engine's serve_prefill/serve_decode pair —
+    through the persistent executable cache (runtime/compile_cache.py),
+    against a fresh cache dir so "cold" is honest. Three passes per
+    program: cold (XLA compile + persist), warm (in-memory hit — the
+    second-engine / rebuilt-step path), disk (memory cleared, load the
+    serialized executable — the relaunched-process path). All compiles
+    happen before any executable load, so the CPU load-taint hazard
+    (tests/conftest.py) cannot fire. Reported as detail.compile_cache;
+    the long-standing pjit-microbenchmark TODO (SNIPPETS.md [1-2])."""
+    import dataclasses
+    import tempfile as _tempfile
+
+    sweep_dir = _tempfile.mkdtemp(prefix="rlt-compile-sweep-")
+    os.environ["RLT_XLA_CACHE_DIR"] = sweep_dir
+    os.environ["RLT_COMPILE_CACHE"] = "1"
+    os.environ["RLT_COMPILE_CACHE_EXEC"] = "1"  # dedicated child: loads OK
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_lightning_tpu.models.llama import LlamaConfig, init_params, lm_loss
+    from ray_lightning_tpu.runtime import compile_cache as _cc
+    from ray_lightning_tpu.serving import EngineConfig, InferenceEngine
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    tx = optax.adamw(3e-4)
+    opt_state = tx.init(params)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)), jnp.int32
+    )
+
+    def train_step(p, s, toks):
+        (loss, _), grads = jax.value_and_grad(
+            lambda q: lm_loss(q, toks, cfg), has_aux=True
+        )(p)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    engine = InferenceEngine(
+        params, cfg, EngineConfig(num_slots=2, max_prompt_len=8, max_len=32)
+    )
+    programs = [
+        (
+            "train_step",
+            _cc.wrap(jax.jit(train_step, donate_argnums=(0, 1)), "train_step"),
+            (params, opt_state, tokens),
+        ),
+    ] + [(name, fn, a) for name, fn, a in engine._program_specs()]
+
+    cache = _cc.get_cache()
+
+    def resolve_ms(fn, a):
+        t0 = time.perf_counter()
+        fn.cached_compiled(*a)
+        return (time.perf_counter() - t0) * 1e3
+
+    report = {name: {} for name, _, _ in programs}
+    for phase in ("cold_ms", "warm_ms", "disk_ms"):
+        if phase != "cold_ms":
+            # model a FRESH build (new engine / rebuilt step): drop the
+            # wrapper handles so warm pays the real lower+hash+lookup...
+            for _, fn, _a in programs:
+                fn._compiled = None
+        if phase == "disk_ms":
+            # ...and a FRESH PROCESS: drop the memory layer so the resolve
+            # deserializes the persisted executable (the relaunch path)
+            cache.clear_memory()
+        for name, fn, a in programs:
+            report[name][phase] = round(resolve_ms(fn, a), 2)
+    for name in report:
+        cold = max(report[name]["cold_ms"], 1e-9)
+        report[name]["warm_over_cold"] = round(report[name]["warm_ms"] / cold, 4)
+        report[name]["disk_over_cold"] = round(report[name]["disk_ms"] / cold, 4)
+    st = cache.stats
+    total = st["hits"] + st["misses"]
+    print(json.dumps({
+        "platform": "cpu",
+        "programs": report,
+        "hits": st["hits"],
+        "misses": st["misses"],
+        "disk_hits": st["disk_hits"],
+        "hit_rate": round(st["hits"] / total, 4) if total else 0.0,
+        "warm_over_cold": max(p["warm_over_cold"] for p in report.values()),
+        "compile_ms_total": round(st["compile_ms_total"], 2),
+    }))
+    return 0
+
+
+def _attach_compile_sweep(result: dict, here: str, env: dict) -> None:
+    """Attach detail.compile_cache (cold vs warm build ms per program, hit
+    rate) to a fresh measurement. CPU-pinned like the other sweeps; with
+    detail.compile_ms this is the tracked compile-time regression surface.
+    RLT_BENCH_COMPILE_SWEEP=0 disables."""
+    if os.environ.get("RLT_BENCH_COMPILE_SWEEP", "1") == "0":
+        return
+    sweep_env = dict(env)
+    sweep_env["JAX_PLATFORMS"] = "cpu"
+    ok, sweep, serr = _run(
+        [sys.executable, here, "--_compile_sweep"],
+        _env_timeout("RLT_BENCH_COMPILE_TIMEOUT", 300.0),
+        sweep_env,
+    )
+    detail = result.setdefault("detail", {})
+    if ok and isinstance(sweep, dict) and "programs" in sweep:
+        detail["compile_cache"] = sweep
+    else:
+        detail["compile_cache"] = {
+            "error": (sweep or {}).get("error")
+            or serr
+            or "sweep produced no JSON"
+        }
+
+
 def _last_json_dict(stdout: str):
     for line in reversed((stdout or "").strip().splitlines()):
         try:
@@ -1095,6 +1228,38 @@ def _save_probe_verdict(error: str) -> None:
         pass
 
 
+def _load_probe_ok():
+    """Return (platform, age_s) for a fresh cached POSITIVE probe verdict,
+    else (None, None). Healthy machines skip the probe subprocess entirely
+    inside RLT_BENCH_PROBE_OK_TTL (default 900s — short, because a cached
+    'healthy' that outlives a tunnel wedge sends the bench child into the
+    full timeout). ``--platform native`` always probes live."""
+    try:
+        with open(_probe_cache_path()) as f:
+            payload = json.load(f)
+        platform = payload.get("ok_platform")
+        age = time.time() - float(payload.get("saved_at") or 0)
+        if platform and 0 <= age < _env_timeout("RLT_BENCH_PROBE_OK_TTL", 900.0):
+            return str(platform), age
+    except (OSError, ValueError, TypeError):
+        pass
+    return None, None
+
+
+def _save_probe_ok(platform: str) -> None:
+    """Record a probe success (overwrites any negative verdict)."""
+    try:
+        path = _probe_cache_path()
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"saved_at": time.time(), "ok_platform": str(platform)}, f
+            )
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def _clear_probe_verdict() -> None:
     try:
         os.unlink(_probe_cache_path())
@@ -1198,6 +1363,7 @@ def main() -> int:
     parser.add_argument("--_dcn_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_input_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_serve_sweep", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--_compile_sweep", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
 
     if args._probe:
@@ -1210,6 +1376,8 @@ def main() -> int:
         return _input_sweep(args)
     if args._serve_sweep:
         return _serve_sweep(args)
+    if args._compile_sweep:
+        return _compile_sweep(args)
 
     probe_timeout = _env_timeout("RLT_BENCH_PROBE_TIMEOUT", 600.0)
     bench_timeout = _env_timeout("RLT_BENCH_TIMEOUT", 1800.0)
@@ -1275,11 +1443,24 @@ def main() -> int:
                 f"age {verdict_age:.0f}s; --platform native re-probes live)"
             )
         else:
-            ok, probe_res, perr = _run(
-                [sys.executable, here, "--_probe"], probe_timeout, env
+            # a fresh POSITIVE verdict skips the probe subprocess outright:
+            # a healthy machine goes straight to the measurement. Explicit
+            # --platform native still probes live (both verdict polarities
+            # answer the operator's "is it back?" question wrongly).
+            ok_platform, ok_age = (
+                (None, None) if args.platform == "native" else _load_probe_ok()
             )
+            if ok_platform is not None:
+                ok, probe_res, perr = True, {"platform": ok_platform}, None
+            else:
+                ok, probe_res, perr = _run(
+                    [sys.executable, here, "--_probe"], probe_timeout, env
+                )
             if ok:
-                _clear_probe_verdict()
+                if ok_platform is None:
+                    # success overwrites any negative verdict and lets the
+                    # next bare invocation inside the TTL skip the probe
+                    _save_probe_ok((probe_res or {}).get("platform") or "native")
                 # all on-chip work (flash autotune, ceiling, measurement)
                 # happens inside ONE child — see module docstring
                 ok, result, berr = _run(
@@ -1290,11 +1471,16 @@ def main() -> int:
                     _attach_dcn_sweep(result, here, env)
                     _attach_input_sweep(result, here, env)
                     _attach_serve_sweep(result, here, env)
+                    _attach_compile_sweep(result, here, env)
                     if _is_on_chip(result):
                         _save_tpu_cache(result, _args_key(args))
                     print(json.dumps(result))
                     return 0
                 error = f"native bench failed ({berr})"
+                if ok_platform is not None:
+                    # the cached "healthy" may have been the lie that sent
+                    # us into the failed bench — force a live re-probe next
+                    _clear_probe_verdict()
             else:
                 error = f"native backend probe failed ({perr})"
                 _save_probe_verdict(perr)
@@ -1334,6 +1520,7 @@ def main() -> int:
         _attach_dcn_sweep(result, here, env)
         _attach_input_sweep(result, here, env)
         _attach_serve_sweep(result, here, env)
+        _attach_compile_sweep(result, here, env)
     if error:
         result.setdefault("detail", {})["error"] = error
     print(json.dumps(result))

@@ -38,6 +38,7 @@ from ray_lightning_tpu.core.data import DataLoader, DistributedSampler, ensure_l
 from ray_lightning_tpu.core.module import LightningModule
 from ray_lightning_tpu.loggers.base import Logger
 from ray_lightning_tpu.loggers.csv_logger import CSVLogger
+from ray_lightning_tpu.runtime import compile_cache as _compile_cache
 from ray_lightning_tpu.strategies.base import Strategy, XLAStrategy
 from ray_lightning_tpu.utils.precision import cast_floats, parse_precision
 from ray_lightning_tpu.utils.seed import seed_everything
@@ -692,7 +693,12 @@ class Trainer:
             out_specs=(P(), opt_spec, P()),
             check_rep=False,
         )
-        return jax.jit(mapped, donate_argnums=(0, 1))
+        # first dispatch resolves through the shared executable cache:
+        # an elastic resize back to a seen topology, or a relaunch on a
+        # warm cache dir, skips XLA entirely (runtime/compile_cache.py)
+        return _compile_cache.wrap(
+            jax.jit(mapped, donate_argnums=(0, 1)), "train_step"
+        )
 
     # ------------------------------------------------------------------ #
     # compiled steps
@@ -743,7 +749,9 @@ class Trainer:
             logs.setdefault("loss", loss)
             return new_params, new_opt_state, logs
 
-        return jax.jit(train_step, donate_argnums=(0, 1))
+        return _compile_cache.wrap(
+            jax.jit(train_step, donate_argnums=(0, 1)), "train_step"
+        )
 
     def _build_alternating_train_step(self):
         """PTL multiple-optimizer semantics, compiled: training_step is
@@ -809,7 +817,9 @@ class Trainer:
             )
             return params, tuple(new_states), logs_all
 
-        return jax.jit(train_step, donate_argnums=(0, 1))
+        return _compile_cache.wrap(
+            jax.jit(train_step, donate_argnums=(0, 1)), "train_step"
+        )
 
     def _build_eval_step(self, phase: str):
         module = self._module
@@ -833,7 +843,7 @@ class Trainer:
                     logs.setdefault(k, jnp.asarray(v))
             return logs
 
-        return jax.jit(eval_step)
+        return _compile_cache.wrap(jax.jit(eval_step), f"{phase}_step")
 
     # ------------------------------------------------------------------ #
     # fit implementation (runs on driver, or inside a worker actor)

@@ -244,13 +244,27 @@ def analyze_compiled(compiled: Any, program: str = "program") -> CostReport:
 def analyze_jitted(fn: Any, *args: Any, program: str = "program") -> Optional[CostReport]:
     """Lower + AOT-compile a jitted callable and account its cost.
 
-    The AOT path does not share the jit dispatch cache, so this is a
-    second compile of the program — call it once, off the hot path, and
-    gate behind telemetry / ``RLT_COST_ANALYSIS``.  Lowering only reads
-    shapes/dtypes, so passing live (even donated-and-reassigned) arrays
-    is safe.  Returns ``None`` on any failure."""
+    A :class:`~ray_lightning_tpu.runtime.compile_cache.CachedProgram` (or
+    anything exposing ``cached_compiled``) hands back the executable it
+    already resolved, so analysis is free on a warm cache. For a raw jitted
+    fn the AOT path does not share the jit dispatch cache — that is a
+    second compile of the program, so route it through the shared cache;
+    call it once, off the hot path, and gate behind telemetry /
+    ``RLT_COST_ANALYSIS``. Lowering only reads shapes/dtypes, so passing
+    live (even donated-and-reassigned) arrays is safe. Returns ``None`` on
+    any failure."""
     try:
-        compiled = fn.lower(*args).compile()
+        if hasattr(fn, "cached_compiled"):
+            compiled = fn.cached_compiled(*args)
+        else:
+            from ray_lightning_tpu.runtime import compile_cache as _cc
+
+            if _cc.enabled():
+                compiled = _cc.get_cache().get_or_compile(
+                    fn, *args, program=program
+                )
+            else:
+                compiled = fn.lower(*args).compile()
     except Exception:
         return None
     return analyze_compiled(compiled, program=program)

@@ -67,16 +67,17 @@ def main() -> None:
             )
         # persistent XLA compilation cache: actors are fresh processes, so
         # without this every worker recompiles the train step from scratch.
-        # Opt-in via env (the test conftest sets it) because the cache dir
-        # must be shared/writable; config-level set because sitecustomize
-        # pre-imports jax before env vars can influence its config.
-        if os.environ.get("RLT_XLA_CACHE_DIR"):
-            import jax
+        # Opt-in via env (the launcher's worker_env / the test conftest set
+        # it) because the cache dir must be shared/writable. Actor processes
+        # only ever load programs sibling actors wrote, so deserializing
+        # persisted executables is safe here (compile_cache gates it out of
+        # driver/test processes on CPU).
+        os.environ.setdefault("RLT_ACTOR_PROCESS", "1")
+        from ray_lightning_tpu.runtime.compile_cache import (
+            configure_jax_persistent_cache,
+        )
 
-            jax.config.update(
-                "jax_compilation_cache_dir", os.environ["RLT_XLA_CACHE_DIR"]
-            )
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        configure_jax_persistent_cache()
         cls = cloudpickle.loads(_read_msg(stdin))
         args, kwargs = cloudpickle.loads(_read_msg(stdin))
         instance = cls(*args, **kwargs)

@@ -151,12 +151,16 @@ def main() -> int:
     if os.environ.get("RLT_FORCE_JAX_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["RLT_FORCE_JAX_PLATFORM"])
     # persistent XLA compilation cache for forked actor children (same
-    # opt-in as actor_boot; config survives the fork)
-    if os.environ.get("RLT_XLA_CACHE_DIR"):
-        jax.config.update(
-            "jax_compilation_cache_dir", os.environ["RLT_XLA_CACHE_DIR"]
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # opt-in as actor_boot; config survives the fork, so this pre-fork set
+    # is the "warm" half of the cold-start story: every child is born with
+    # the shared cache dir already wired). Children are actor processes —
+    # deserializing persisted executables is safe for them.
+    os.environ.setdefault("RLT_ACTOR_PROCESS", "1")
+    from ray_lightning_tpu.runtime.compile_cache import (
+        configure_jax_persistent_cache,
+    )
+
+    configure_jax_persistent_cache()
 
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)

@@ -65,6 +65,7 @@ import numpy as np
 from ray_lightning_tpu import observability as _obs
 from ray_lightning_tpu.observability import metrics as _metrics
 from ray_lightning_tpu.observability import reqtrace as _reqtrace
+from ray_lightning_tpu.runtime import compile_cache as _compile_cache
 from ray_lightning_tpu.runtime import faults as _faults
 from ray_lightning_tpu.serving.kv_pool import KVSlotPool
 from ray_lightning_tpu.serving.paged_kv import PagedKVPool
@@ -401,11 +402,60 @@ class InferenceEngine:
                 )
                 return sampled.astype(jnp.int32), cache["k"], cache["v"]
 
-            self._prefill_fn = jax.jit(prefill_into_paged)
-            self._decode_fn = jax.jit(decode_paged)
+            self._prefill_fn = _compile_cache.wrap(
+                jax.jit(prefill_into_paged), "serve_prefill"
+            )
+            self._decode_fn = _compile_cache.wrap(
+                jax.jit(decode_paged), "serve_decode"
+            )
         else:
-            self._prefill_fn = jax.jit(prefill_into)
-            self._decode_fn = jax.jit(decode)
+            self._prefill_fn = _compile_cache.wrap(
+                jax.jit(prefill_into), "serve_prefill"
+            )
+            self._decode_fn = _compile_cache.wrap(
+                jax.jit(decode), "serve_decode"
+            )
+
+    def _program_specs(self):
+        """(name, fn, dummy_args) for both serving programs, with dummy
+        arguments matching the :meth:`step` call-site shapes/dtypes exactly
+        — shared by :meth:`warmup` and :meth:`cost_summary` so the program
+        they build is the program the serving loop dispatches."""
+        import jax
+        import jax.numpy as jnp
+
+        ecfg = self.engine_config
+        ck, cv = self.pool.cache["k"], self.pool.cache["v"]
+        prompt = jnp.zeros((1, ecfg.max_prompt_len), jnp.int32)
+        token = jnp.zeros((self.pool.num_slots,), jnp.int32)
+        pos = jnp.zeros((self.pool.num_slots,), jnp.int32)
+        key = jax.random.key(0)
+        if self.kv_layout == "paged":
+            wt = jnp.zeros((self._n_prompt_blocks,), jnp.int32)
+            return (
+                ("serve_prefill", self._prefill_fn,
+                 (self.params, ck, cv, prompt, wt)),
+                ("serve_decode", self._decode_fn,
+                 (self.params, ck, cv, token, pos,
+                  jnp.asarray(self.pool.block_tables), key)),
+            )
+        return (
+            ("serve_prefill", self._prefill_fn,
+             (self.params, ck, cv, prompt, jnp.int32(0))),
+            ("serve_decode", self._decode_fn,
+             (self.params, ck, cv, token, pos, key)),
+        )
+
+    def warmup(self) -> Dict[str, int]:
+        """Resolve (load from the compile cache, or compile and persist)
+        both serving programs without executing them, so the first real
+        request pays dispatch cost only. Replica bring-up calls this before
+        reporting alive; a relaunch on a warm cache is load-bound, not
+        compile-bound. No-op when the cache is disabled."""
+        for _name, fn, args in self._program_specs():
+            if hasattr(fn, "warmup"):
+                fn.warmup(*args)
+        return self.compile_stats()
 
     def compile_stats(self) -> Dict[str, int]:
         """jit cache sizes — flat after warmup is the zero-steady-state-
@@ -898,39 +948,17 @@ class InferenceEngine:
         """Analytic HLO cost of the two compiled serving programs.
 
         AOT-lowers prefill and decode with dummy arguments matching the
-        :meth:`step` call-site shapes/dtypes (a second compile — call off
-        the serving loop, e.g. at startup or from ``cli serve --cost``),
-        publishes the ``rlt_step_flops``/``rlt_step_bytes``/collective
-        gauges labeled ``program=serve_prefill|serve_decode``, and returns
-        the per-program reports with analytic roofline verdicts."""
-        import jax
-        import jax.numpy as jnp
-
+        :meth:`step` call-site shapes/dtypes, publishes the
+        ``rlt_step_flops``/``rlt_step_bytes``/collective gauges labeled
+        ``program=serve_prefill|serve_decode``, and returns the per-program
+        reports with analytic roofline verdicts. With the compile cache on,
+        the analysis reuses the cached executable (the one the serving loop
+        dispatches), so this is near-free on a warm cache instead of paying
+        a second compile."""
         from ray_lightning_tpu import observability as _obs2
         from ray_lightning_tpu.observability import profiler as _profiler
 
-        ecfg = self.engine_config
-        ck, cv = self.pool.cache["k"], self.pool.cache["v"]
-        prompt = jnp.zeros((1, ecfg.max_prompt_len), jnp.int32)
-        token = jnp.zeros((self.pool.num_slots,), jnp.int32)
-        pos = jnp.zeros((self.pool.num_slots,), jnp.int32)
-        key = jax.random.key(0)
-        if self.kv_layout == "paged":
-            wt = jnp.zeros((self._n_prompt_blocks,), jnp.int32)
-            programs = (
-                ("serve_prefill", self._prefill_fn,
-                 (self.params, ck, cv, prompt, wt)),
-                ("serve_decode", self._decode_fn,
-                 (self.params, ck, cv, token, pos,
-                  jnp.asarray(self.pool.block_tables), key)),
-            )
-        else:
-            programs = (
-                ("serve_prefill", self._prefill_fn,
-                 (self.params, ck, cv, prompt, jnp.int32(0))),
-                ("serve_decode", self._decode_fn,
-                 (self.params, ck, cv, token, pos, key)),
-            )
+        programs = self._program_specs()
         out: Dict[str, Any] = {}
         reg = _obs2.registry()
         for name, fn, args in programs:

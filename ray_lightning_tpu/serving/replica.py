@@ -414,6 +414,10 @@ class LocalReplicaFleet:
             params, cfg, EngineConfig(**self._engine_kwargs),
             replica_index=index,
         )
+        # resolve both programs before the replica becomes routable: on a
+        # warm executable cache a relaunch (explicit index) or scale-up
+        # skips XLA and this is load-bound, not compile-bound
+        engine.warmup()
         engine.start()
         with self._lock:
             self._replicas[index] = engine
@@ -786,6 +790,10 @@ class ServeReplicaActor:
         )
         self._finished: Dict[str, Dict[str, Any]] = {}
         self._install_finish_hook()
+        # warm the two serving programs before the ready handshake: the
+        # actor reports alive with its executables resolved (from the
+        # shared cache when a sibling already compiled them)
+        self.engine.warmup()
         self.engine.start()
         self._hb = heartbeat
         self._hb_interval = max(float(heartbeat_interval), 0.05)

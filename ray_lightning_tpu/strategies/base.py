@@ -47,6 +47,7 @@ class Strategy:
         telemetry: Optional[bool] = None,
         prefetch_depth: Optional[int] = None,
         loader_num_workers: Optional[int] = None,
+        xla_cache_dir: Optional[str] = None,
     ):
         self.mesh_spec = mesh_spec or MeshSpec.data_parallel()
         self.sharding_policy = sharding_policy or ShardingPolicy.ddp()
@@ -56,6 +57,7 @@ class Strategy:
         self._telemetry = telemetry
         self._prefetch_depth = prefetch_depth
         self._loader_num_workers = loader_num_workers
+        self._xla_cache_dir = xla_cache_dir
         self._mesh: Optional[Mesh] = None
         self._trainer = None
         self._module = None
@@ -154,6 +156,17 @@ class Strategy:
                 f"got {value}"
             )
         return value
+
+    @property
+    def xla_cache_dir(self) -> Optional[str]:
+        """Directory of the persistent XLA compile/executable cache shared
+        by the driver and every worker it spawns (see
+        ``runtime/compile_cache.py``). Constructor argument wins; otherwise
+        the ``RLT_XLA_CACHE_DIR`` env var; otherwise a per-user
+        platformdirs default. ``"0"``/``"off"`` disables (returns None)."""
+        from ray_lightning_tpu.runtime.compile_cache import resolve_cache_dir
+
+        return resolve_cache_dir(self._xla_cache_dir)
 
     @property
     def telemetry(self) -> bool:
@@ -351,6 +364,7 @@ class XLAStrategy(Strategy):
         telemetry: Optional[bool] = None,
         prefetch_depth: Optional[int] = None,
         loader_num_workers: Optional[int] = None,
+        xla_cache_dir: Optional[str] = None,
     ):
         super().__init__(
             mesh_spec,
@@ -361,6 +375,7 @@ class XLAStrategy(Strategy):
             telemetry=telemetry,
             prefetch_depth=prefetch_depth,
             loader_num_workers=loader_num_workers,
+            xla_cache_dir=xla_cache_dir,
         )
         self._num_devices = devices
 

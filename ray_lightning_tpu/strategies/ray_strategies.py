@@ -65,6 +65,7 @@ class RayStrategy(XLAStrategy):
         telemetry: Optional[bool] = None,
         prefetch_depth: Optional[int] = None,
         loader_num_workers: Optional[int] = None,
+        xla_cache_dir: Optional[str] = None,
         **kwargs: Any,
     ):
         super().__init__(
@@ -76,6 +77,7 @@ class RayStrategy(XLAStrategy):
             telemetry=telemetry,
             prefetch_depth=prefetch_depth,
             loader_num_workers=loader_num_workers,
+            xla_cache_dir=xla_cache_dir,
         )
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
@@ -164,6 +166,20 @@ class RayStrategy(XLAStrategy):
         # telemetry=True would otherwise be invisible to the worker's boot
         # phase (spans start before the strategy payload is unpickled)
         env["RLT_TELEMETRY"] = "1" if self.telemetry else "0"
+        # Pre-seed the shared executable cache dir: every worker (and any
+        # relaunch/scale-up replacement) resolves the same path, so the
+        # first cohort's compiles become the next cohort's warm starts.
+        cache_dir = self.xla_cache_dir
+        if cache_dir:
+            try:
+                os.makedirs(cache_dir, exist_ok=True)
+            except OSError:
+                pass
+            env["RLT_XLA_CACHE_DIR"] = cache_dir
+        elif self._xla_cache_dir is not None:
+            # knob explicitly disabled ("" / "off"): force it off in workers
+            # even if the ambient env has RLT_XLA_CACHE_DIR set
+            env["RLT_XLA_CACHE_DIR"] = "0"
         return env
 
     # ------------------------------------------------------------------ #

@@ -33,6 +33,7 @@ def _isolated_cache_dir(tmp_path, monkeypatch):
     monkeypatch.setenv("RLT_BENCH_DCN_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_INPUT_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_SERVE_SWEEP", "0")
+    monkeypatch.setenv("RLT_BENCH_COMPILE_SWEEP", "0")
 
 
 def _result(value, **detail):
@@ -480,6 +481,174 @@ def test_serve_sweep_skippable(monkeypatch, capsys):
     assert not any("--_serve_sweep" in c for c in calls)
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "serving" not in out.get("detail", {})
+
+
+def test_compile_sweep_attaches_detail(monkeypatch, capsys):
+    """The compile-cache sweep child's JSON lands in detail.compile_cache
+    (cold/warm/disk build ms per program — the compile-time regression
+    surface), and its spawn is CPU-pinned (never the chip)."""
+    monkeypatch.setenv("RLT_BENCH_COMPILE_SWEEP", "1")
+    sweep = {
+        "platform": "cpu",
+        "programs": {
+            "train_step": {"cold_ms": 1900.0, "warm_ms": 13.0,
+                           "disk_ms": 72.0, "warm_over_cold": 0.007},
+        },
+        "hits": 6, "misses": 3, "hit_rate": 0.667,
+        "warm_over_cold": 0.009,
+    }
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append(list(cmd))
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        if "--_compile_sweep" in cmd:
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            return True, dict(sweep), None
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    assert any("--_compile_sweep" in c for c in calls)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 42.0
+    assert out["detail"]["compile_cache"]["warm_over_cold"] == 0.009
+    assert (
+        out["detail"]["compile_cache"]["programs"]["train_step"]["warm_ms"]
+        == 13.0
+    )
+
+
+def test_compile_sweep_failure_is_reported_not_fatal(monkeypatch, capsys):
+    """A failed compile sweep must not cost the measurement."""
+    monkeypatch.setenv("RLT_BENCH_COMPILE_SWEEP", "1")
+
+    def fake_run(cmd, timeout, env):
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        if "--_compile_sweep" in cmd:
+            return False, None, "timeout after 300s"
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 42.0
+    assert "timeout" in out["detail"]["compile_cache"]["error"]
+
+
+def test_compile_sweep_real_warm_build_under_20_percent_of_cold(tmp_path):
+    """ACCEPTANCE: the real CPU --_compile_sweep child — a warm-cache
+    rebuild of the train step and both serving programs must cost < 20%
+    of the cold build (it measures <1% in practice: lower+hash+lookup vs
+    a full XLA compile)."""
+    import subprocess
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "RLT_XLA_CACHE_DIR": str(tmp_path)}
+    res = subprocess.run(
+        [sys.executable, bench.__file__, "--_compile_sweep"],
+        capture_output=True, text=True, timeout=280, env=env,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    assert set(out["programs"]) == {"train_step", "serve_prefill", "serve_decode"}
+    assert out["warm_over_cold"] < 0.2
+    for name, prog in out["programs"].items():
+        assert prog["warm_over_cold"] < 0.2, (name, prog)
+        assert prog["disk_ms"] >= 0.0
+    assert out["misses"] == 3 and out["hits"] == 6  # 3 programs × (warm+disk)
+
+
+def test_probe_success_caches_positive_verdict(monkeypatch, capsys):
+    """A probe success is cached too: the NEXT bare invocation inside the
+    TTL goes straight to the measurement — a healthy machine should not
+    pay a probe subprocess (interpreter boot + device acquisition) per
+    invocation."""
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append(list(cmd))
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+    assert bench.main() == 0  # run 1: probes live, succeeds, caches ok
+    assert any("--_probe" in c for c in calls)
+    assert bench._load_probe_ok()[0] == "tpu"
+
+    calls.clear()
+    capsys.readouterr()
+    assert bench.main() == 0  # run 2: cached ok, no probe spawn
+    assert not any("--_probe" in c for c in calls)
+    assert calls and "--_child" in calls[0]
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 42.0
+
+
+def test_platform_native_bypasses_positive_verdict(monkeypatch, capsys):
+    """--platform native asks 'is it healthy NOW?': a cached 'healthy'
+    must not substitute for the live probe either."""
+    bench._save_probe_ok("tpu")
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append(list(cmd))
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--platform", "native"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    assert any("--_probe" in c for c in calls), "native pin skipped the probe"
+
+
+def test_positive_verdict_expires_by_ttl(monkeypatch):
+    """A cached 'healthy' that outlives a tunnel wedge would send the bench
+    child into the full timeout — it must expire on its own TTL."""
+    bench._save_probe_ok("tpu")
+    assert bench._load_probe_ok()[0] == "tpu"
+    monkeypatch.setenv("RLT_BENCH_PROBE_OK_TTL", "0")
+    assert bench._load_probe_ok() == (None, None)
+    monkeypatch.delenv("RLT_BENCH_PROBE_OK_TTL")
+    assert bench._load_probe_ok()[0] == "tpu"
+    bench._clear_probe_verdict()
+    assert bench._load_probe_ok() == (None, None)
+
+
+def test_failed_bench_after_cached_ok_forces_reprobe(monkeypatch, capsys):
+    """If the bench child fails under a cached 'healthy', that verdict may
+    be the lie that caused it: it must be cleared so the next invocation
+    probes live again."""
+    bench._save_probe_ok("tpu")
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append(list(cmd))
+        if "--_probe" in cmd:  # pragma: no cover - must not probe this run
+            raise AssertionError("cached ok should have skipped the probe")
+        if env.get("JAX_PLATFORMS") == "cpu":
+            return True, _result(10.0, platform="cpu"), None
+        return False, None, "tunnel wedged mid-run"
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    assert bench._load_probe_ok() == (None, None), "stale ok survived"
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 10.0  # CPU fallback still delivered a number
 
 
 def test_probe_failure_caches_negative_verdict(monkeypatch, capsys):
