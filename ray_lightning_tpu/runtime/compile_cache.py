@@ -77,6 +77,8 @@ _metrics.set_help(
 
 XLA_CACHE_DIR_ENV = "RLT_XLA_CACHE_DIR"
 ACTOR_PROCESS_ENV = "RLT_ACTOR_PROCESS"
+DISK_CAP_ENV = "RLT_XLA_CACHE_MAX_BYTES"
+_DEFAULT_DISK_CAP_BYTES = 4 << 30  # 4 GiB
 
 
 # --------------------------------------------------------------------- #
@@ -131,6 +133,53 @@ def configure_jax_persistent_cache(cache_dir: Optional[str] = None) -> Optional[
     return cache_dir
 
 
+def _disk_cap_bytes() -> Optional[int]:
+    """Disk-layer size cap (``RLT_XLA_CACHE_MAX_BYTES``, default 4 GiB;
+    ``0``/``off`` disables pruning)."""
+    raw = os.environ.get(DISK_CAP_ENV)
+    if raw is None:
+        return _DEFAULT_DISK_CAP_BYTES
+    if raw.strip().lower() in ("", "0", "off", "none"):
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return _DEFAULT_DISK_CAP_BYTES
+
+
+def _prune_disk(cache_dir: str, max_bytes: Optional[int]) -> None:
+    """LRU-by-mtime eviction of ``.rltx`` entries over the size cap.
+
+    Runs once at cache construction; ``_load_disk`` touches entries it
+    serves so live programs stay newest. The default dir is a per-user
+    platformdirs cache shared across model/config/version churn, so without
+    this it grows without bound.
+    """
+    if not max_bytes:
+        return
+    try:
+        with os.scandir(cache_dir) as it:
+            entries = [
+                (e.stat().st_mtime, e.stat().st_size, e.path)
+                for e in it
+                if e.name.endswith(".rltx")
+            ]
+    except OSError:
+        return
+    total = sum(size for _, size, _ in entries)
+    if total <= max_bytes:
+        return
+    entries.sort()  # oldest first
+    for _, size, path in entries:
+        if total <= max_bytes:
+            break
+        try:
+            os.unlink(path)
+            total -= size
+        except OSError:
+            pass
+
+
 # --------------------------------------------------------------------- #
 # key derivation
 # --------------------------------------------------------------------- #
@@ -170,6 +219,24 @@ def backend_fingerprint(backend: Optional[str] = None) -> Dict[str, Any]:
         "num_processes": num_processes,
         "xla_flags": os.environ.get("XLA_FLAGS", ""),
     }
+
+
+def _client_token_now() -> Optional[int]:
+    """Identity token of the live backend client, or None when no backend
+    is up yet. ``get_or_compile`` drops its memory layer when this changes:
+    an elastic reconnect tears down and rebuilds the client, and executables
+    bound to the old one carry identical-looking keys but dead device
+    handles. Module-level so tests can monkeypatch the token source."""
+    import jax
+
+    try:
+        return id(jax.devices()[0].client)
+    except (RuntimeError, IndexError, AttributeError):
+        # RuntimeError: no backend initialized; IndexError: zero devices;
+        # AttributeError: a device class without .client. Anything else
+        # (e.g. a NameError from a refactor) must propagate, not silently
+        # disable the client-change gate.
+        return None
 
 
 def _distributed_runtime_active() -> bool:
@@ -262,6 +329,7 @@ class CompileCache:
         self._persist = persist if persist is not None else self.cache_dir is not None
         self._mem: Dict[str, Any] = {}
         self._lock = threading.Lock()
+        self._key_locks: Dict[str, threading.Lock] = {}
         self._client_token: Optional[int] = None
         self._warned_persist = False
         self.stats: Dict[str, Any] = {
@@ -276,6 +344,8 @@ class CompileCache:
             "compile_ms_total": 0.0,
             "programs": {},
         }
+        if self._persist and self.cache_dir:
+            _prune_disk(self.cache_dir, _disk_cap_bytes())
 
     # ----------------------------------------------------------------- #
     def _entry_path(self, key: str) -> Optional[str]:
@@ -333,6 +403,10 @@ class CompileCache:
             self.stats["corrupt"] += 1
             self._unlink(path)
             return None
+        try:
+            os.utime(path)  # keep served entries newest for LRU pruning
+        except OSError:
+            pass
         if header.get("kind") != "exec":
             # StableHLO fallback entry: presence marker only; the recompile
             # below still rides jax's persistent cache when configured.
@@ -432,35 +506,45 @@ class CompileCache:
         # (same mesh, same fingerprint) but dead device handles. Drop the
         # memory layer whenever the live client changes — the disk layer
         # deserializes against the CURRENT client, so warm starts survive.
-        try:
-            token = id(jax.devices()[0].client)
-        except Exception:
-            token = None
+        token = _client_token_now()
         with self._lock:
             if token != self._client_token:
                 self._mem.clear()
                 self._client_token = token
             compiled = self._mem.get(key)
+            if compiled is None:
+                key_lock = self._key_locks.setdefault(key, threading.Lock())
         if compiled is not None:
             self._record("hits", program, "memory")
             return compiled
-        compiled = self._load_disk(key, program)
-        if compiled is not None:
-            self._record("hits", program, "disk")
+        # Per-key in-flight guard: concurrent misses on the SAME key wait
+        # here and find the winner's executable in the memory layer instead
+        # of paying a duplicate compile; different keys proceed in parallel.
+        with key_lock:
+            with self._lock:
+                compiled = self._mem.get(key)
+            if compiled is not None:
+                self._record("hits", program, "memory")
+                return compiled
+            compiled = self._load_disk(key, program)
+            if compiled is not None:
+                self._record("hits", program, "disk")
+                with self._lock:
+                    self._mem[key] = compiled
+                return compiled
+            t0 = time.perf_counter()
+            compiled = lowered.compile()
+            compile_ms = (time.perf_counter() - t0) * 1000.0
+            self._record("misses", program)
+            self.stats["compile_ms_total"] += compile_ms
+            reg = _obs.registry()
+            if reg:
+                reg.histogram(COMPILE_MS_METRIC, program=program).observe(
+                    compile_ms
+                )
+            self._store_disk(key, compiled, lowered, program)
             with self._lock:
                 self._mem[key] = compiled
-            return compiled
-        t0 = time.perf_counter()
-        compiled = lowered.compile()
-        compile_ms = (time.perf_counter() - t0) * 1000.0
-        self._record("misses", program)
-        self.stats["compile_ms_total"] += compile_ms
-        reg = _obs.registry()
-        if reg:
-            reg.histogram(COMPILE_MS_METRIC, program=program).observe(compile_ms)
-        self._store_disk(key, compiled, lowered, program)
-        with self._lock:
-            self._mem[key] = compiled
         return compiled
 
     def clear_memory(self) -> None:

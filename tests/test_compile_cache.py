@@ -128,19 +128,55 @@ def test_multiprocess_never_roundtrips_executables(tmp_path, monkeypatch):
     assert header["kind"] == "stablehlo"
 
 
-def test_backend_client_change_clears_memory_layer(tmp_path):
+def test_client_token_observes_live_backend():
+    """The token source must see the real backend client: a broken source
+    (always None) would silently disable the client-change gate."""
+    jax.devices()  # ensure the backend is up
+    assert cc._client_token_now() is not None
+    assert cc._client_token_now() == cc._client_token_now()
+
+
+def test_backend_client_change_clears_memory_layer(tmp_path, monkeypatch):
     """An elastic reconnect rebuilds the backend client; executables bound
-    to the old client must not be served from the memory layer."""
+    to the old client must not be served from the memory layer — and an
+    unchanged client must keep serving memory hits."""
     cache = cc.CompileCache(cache_dir=str(tmp_path), allow_load=False)
     a = jnp.ones((8,), jnp.float32)
+    token = {"v": 1}
+    monkeypatch.setattr(cc, "_client_token_now", lambda: token["v"])
     cache.get_or_compile(jax.jit(_fn), a, program="p")
     assert cache._mem
-    cache._client_token = object()  # simulate a torn-down/rebuilt client
+    cache.get_or_compile(jax.jit(_fn), a, program="p")  # unchanged client
+    assert cache.stats["memory_hits"] == 1
+    token["v"] = 2  # elastic reconnect tore down and rebuilt the client
     cache.get_or_compile(jax.jit(_fn), a, program="p")
-    assert cache.stats["memory_hits"] == 0
+    assert cache.stats["memory_hits"] == 1
     assert cache.stats["misses"] == 2
     cache.get_or_compile(jax.jit(_fn), a, program="p")  # same client again
-    assert cache.stats["memory_hits"] == 1
+    assert cache.stats["memory_hits"] == 2
+
+
+def test_concurrent_misses_same_key_compile_once(tmp_path):
+    """The per-key in-flight guard: threads racing on one key pay a single
+    compile; the losers wait and take the winner's executable."""
+    import threading
+
+    cache = cc.CompileCache(cache_dir=str(tmp_path), allow_load=False)
+    a = jnp.ones((8,), jnp.float32)
+    results = []
+
+    def worker():
+        results.append(cache.get_or_compile(jax.jit(_fn), a, program="p"))
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert cache.stats["misses"] == 1
+    assert cache.stats["memory_hits"] == 3
+    assert all(r is results[0] for r in results)
+    assert len(list(tmp_path.glob("*.rltx"))) == 1  # persisted exactly once
 
 
 def test_runtime_error_propagates_without_redispatch():
@@ -267,6 +303,25 @@ def test_cpu_main_process_never_loads_executables(tmp_path):
     cache = cc.CompileCache(cache_dir=str(tmp_path))  # default gate
     cache.get_or_compile(jax.jit(_fn), jnp.ones((8,), jnp.float32), program="p")
     assert cache.stats["misses"] == 1 and cache.stats["disk_hits"] == 0
+
+
+def test_disk_prune_evicts_oldest_over_cap(tmp_path, monkeypatch):
+    """The default cache dir is shared across model/config/version churn;
+    construction prunes LRU-by-mtime down to RLT_XLA_CACHE_MAX_BYTES."""
+    for i, age in enumerate((300, 200, 100)):  # oldest first
+        p = tmp_path / f"{'a' * 8}{i}.rltx"
+        p.write_bytes(b"x" * 100)
+        old = os.stat(p).st_mtime - age
+        os.utime(p, (old, old))
+    (tmp_path / "not_an_entry.txt").write_bytes(b"y" * 1000)  # ignored
+    monkeypatch.setenv(cc.DISK_CAP_ENV, "250")
+    cc.CompileCache(cache_dir=str(tmp_path), allow_load=False)
+    left = sorted(p.name for p in tmp_path.glob("*.rltx"))
+    assert left == ["aaaaaaaa1.rltx", "aaaaaaaa2.rltx"]  # oldest evicted
+
+    monkeypatch.setenv(cc.DISK_CAP_ENV, "0")  # off: nothing else evicted
+    cc.CompileCache(cache_dir=str(tmp_path), allow_load=False)
+    assert len(list(tmp_path.glob("*.rltx"))) == 2
 
 
 def test_actor_env_opens_the_load_gate(monkeypatch):
