@@ -1103,6 +1103,150 @@ def _attach_compile_sweep(result: dict, here: str, env: dict) -> None:
         }
 
 
+def _arbitration_sweep(args: argparse.Namespace) -> int:
+    """Child: the chip-arbitration sweep (--_arbitration_sweep).
+
+    Stands up both workloads on one tiny llama — a LocalReplicaFleet at
+    device capacity plus a real jitted train step over a simulated chip
+    ledger — and drives a ChipArbiter through one forced borrow/return
+    cycle, timing the two latencies an operator plans around:
+
+    - borrow_to_first_token_ms: forced-borrow tick start -> a request
+      served by the GROWN fleet delivers its first token (shrink + warm
+      replica boot + prefill; PR 11's executable cache is what keeps the
+      boot load-bound);
+    - return_to_first_step_ms: forced-return tick start -> the first
+      training step completes on the regrown mesh (drain + regrow +
+      step).
+
+    Reported as detail.arbitration."""
+    import dataclasses
+    import tempfile as _tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_lightning_tpu.models.llama import LlamaConfig, init_params, lm_loss
+    from ray_lightning_tpu.runtime.arbiter import ChipArbiter, FleetServeHandle
+    from ray_lightning_tpu.serving.replica import LocalReplicaFleet
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    tx = optax.adamw(3e-4)
+    opt_state = tx.init(params)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)), jnp.int32
+    )
+
+    @jax.jit
+    def train_step(p, s, toks):
+        (loss, _), grads = jax.value_and_grad(
+            lambda q: lm_loss(q, toks, cfg), has_aux=True
+        )(p)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    class _Train:
+        """Simulated chip ledger over a real train step: shrink frees a
+        chip immediately (no mesh on CPU), grow runs one real step so
+        return-to-first-step pays the honest compute."""
+
+        def __init__(self, devs):
+            self.devs = list(devs)
+            self.params, self.opt_state = params, opt_state
+
+        def devices(self):
+            return list(self.devs)
+
+        def shrink(self, count):
+            freed, self.devs = self.devs[-count:], self.devs[:-count]
+            return freed
+
+        def grow(self, devices):
+            self.devs.extend(devices)
+            self.params, self.opt_state, _ = train_step(
+                self.params, self.opt_state, tokens
+            )
+            jax.block_until_ready(self.params)
+
+    fleet = LocalReplicaFleet(
+        builder=lambda: (params, cfg),
+        engine_kwargs=dict(num_slots=2, max_prompt_len=8, max_len=32),
+        initial_replicas=1,
+        capacity=1,
+    )
+    train = _Train(["chip0", "chip1"])
+    # warm the step executable so return-to-first-step measures the
+    # regrow + step, not the first-trace XLA compile
+    train.grow([])
+    serve = FleetServeHandle(fleet)
+    arb = ChipArbiter(
+        _tempfile.mkdtemp(prefix="rlt-arb-sweep-"),
+        train,
+        serve,
+        devices={"chip0": "train", "chip1": "train"},
+        min_train_devices=1,
+        cooldown_s=0.0,
+    )
+
+    arb.request_transfer("borrow")
+    t0 = time.perf_counter()
+    if arb.tick() != "borrowed":
+        print(json.dumps({"error": "forced borrow did not complete"}))
+        return 1
+    entry = fleet.submit([1, 2, 3], max_new_tokens=4)
+    deadline = time.perf_counter() + 60.0
+    while not entry.tokens and time.perf_counter() < deadline:
+        time.sleep(0.001)
+    borrow_ms = (time.perf_counter() - t0) * 1e3
+
+    arb.request_transfer("return")
+    t1 = time.perf_counter()
+    if arb.tick() != "returned":
+        print(json.dumps({"error": "forced return did not complete"}))
+        return 1
+    return_ms = (time.perf_counter() - t1) * 1e3
+    entry.result(timeout=60.0)
+    fleet.shutdown()
+    print(json.dumps({
+        "platform": "cpu",
+        "borrow_to_first_token_ms": round(borrow_ms, 2),
+        "return_to_first_step_ms": round(return_ms, 2),
+        "transfers_completed": arb.transfers_completed,
+        "state": arb.state,
+    }))
+    return 0
+
+
+def _attach_arbitration_sweep(result: dict, here: str, env: dict) -> None:
+    """Attach detail.arbitration (borrow-to-first-token and
+    return-to-first-step ms through one forced borrow/return cycle).
+    CPU-pinned like the other sweeps. RLT_BENCH_ARBITRATION_SWEEP=0
+    disables."""
+    if os.environ.get("RLT_BENCH_ARBITRATION_SWEEP", "1") == "0":
+        return
+    sweep_env = dict(env)
+    sweep_env["JAX_PLATFORMS"] = "cpu"
+    ok, sweep, serr = _run(
+        [sys.executable, here, "--_arbitration_sweep"],
+        _env_timeout("RLT_BENCH_ARBITRATION_TIMEOUT", 300.0),
+        sweep_env,
+    )
+    detail = result.setdefault("detail", {})
+    if ok and isinstance(sweep, dict) and "borrow_to_first_token_ms" in sweep:
+        detail["arbitration"] = sweep
+    else:
+        detail["arbitration"] = {
+            "error": (sweep or {}).get("error")
+            or serr
+            or "sweep produced no JSON"
+        }
+
+
 def _last_json_dict(stdout: str):
     for line in reversed((stdout or "").strip().splitlines()):
         try:
@@ -1364,6 +1508,7 @@ def main() -> int:
     parser.add_argument("--_input_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_serve_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_compile_sweep", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--_arbitration_sweep", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
 
     if args._probe:
@@ -1378,6 +1523,8 @@ def main() -> int:
         return _serve_sweep(args)
     if args._compile_sweep:
         return _compile_sweep(args)
+    if args._arbitration_sweep:
+        return _arbitration_sweep(args)
 
     probe_timeout = _env_timeout("RLT_BENCH_PROBE_TIMEOUT", 600.0)
     bench_timeout = _env_timeout("RLT_BENCH_TIMEOUT", 1800.0)
@@ -1472,6 +1619,7 @@ def main() -> int:
                     _attach_input_sweep(result, here, env)
                     _attach_serve_sweep(result, here, env)
                     _attach_compile_sweep(result, here, env)
+                    _attach_arbitration_sweep(result, here, env)
                     if _is_on_chip(result):
                         _save_tpu_cache(result, _args_key(args))
                     print(json.dumps(result))
@@ -1521,6 +1669,7 @@ def main() -> int:
         _attach_input_sweep(result, here, env)
         _attach_serve_sweep(result, here, env)
         _attach_compile_sweep(result, here, env)
+        _attach_arbitration_sweep(result, here, env)
     if error:
         result.setdefault("detail", {})["error"] = error
     print(json.dumps(result))

@@ -450,6 +450,63 @@ def _cmd_requests(args) -> int:
     return 0
 
 
+def _cmd_arbiter(args, parser) -> int:
+    """``arbiter status`` prints the ledger's state machine position and
+    device split; ``arbiter force-transfer`` queues an operator override
+    the live arbiter's next tick executes."""
+    import json
+
+    from ray_lightning_tpu.runtime import arbiter as _arbiter
+
+    if args.arbiter_command == "status":
+        try:
+            led = _arbiter.read_ledger(args.ledger_dir)
+        except FileNotFoundError:
+            print(f"no arbiter ledger in {args.ledger_dir}")
+            return 1
+        if args.json:
+            print(json.dumps(led, indent=2, sort_keys=True))
+            return 0
+        owners = {"train": [], "serve": [], "transit": []}
+        for dev, side in sorted(led.get("owner", {}).items()):
+            owners.setdefault(side, []).append(dev)
+        print(f"state:      {led.get('state')}")
+        print(f"ledger:     {led.get('ledger')}")
+        print(
+            f"transfers:  {led.get('transfers_completed')} completed / "
+            f"{led.get('transfer_seq')} attempted "
+            f"({led.get('failures')} consecutive failures)"
+        )
+        for side in ("train", "serve", "transit"):
+            devs = owners.get(side, [])
+            print(f"{side:<8}({len(devs)}): {', '.join(devs) or '-'}")
+        tr = led.get("transfer")
+        if tr:
+            print(
+                f"in-flight:  #{tr.get('id')} {tr.get('direction')} "
+                f"[{tr.get('phase')}] devices={tr.get('devices')}"
+            )
+        return 0
+    if args.arbiter_command == "force-transfer":
+        import os
+        import time
+
+        from ray_lightning_tpu.runtime.elastic import _atomic_write
+
+        os.makedirs(args.ledger_dir, exist_ok=True)
+        path = os.path.join(args.ledger_dir, _arbiter.FORCE_NAME)
+        _atomic_write(
+            path,
+            json.dumps(
+                {"direction": args.direction, "ts": time.time()}
+            ).encode("utf-8"),
+        )
+        print(f"queued forced {args.direction} transfer at {path}")
+        return 0
+    parser.print_help()
+    return 2
+
+
 def main(argv: Optional[list] = None) -> int:
     """``rlt``-style tool dispatch: ``top`` — live view of a run's
     telemetry directory (summary.json + events.jsonl, written by the
@@ -600,6 +657,39 @@ def main(argv: Optional[list] = None) -> int:
     requests_p.add_argument(
         "--json", action="store_true", help="emit JSONL instead of a table"
     )
+    arbiter_p = sub.add_parser(
+        "arbiter",
+        help="chip-arbiter ledger: transfer state, device split, "
+        "operator force-transfer",
+    )
+    arbiter_sub = arbiter_p.add_subparsers(dest="arbiter_command")
+    arbiter_status = arbiter_sub.add_parser(
+        "status", help="print the arbiter ledger (state + device split)"
+    )
+    arbiter_status.add_argument(
+        "--ledger-dir",
+        required=True,
+        help="directory holding arbiter_ledger.json",
+    )
+    arbiter_status.add_argument(
+        "--json", action="store_true", help="emit raw ledger JSON"
+    )
+    arbiter_force = arbiter_sub.add_parser(
+        "force-transfer",
+        help="queue an operator-forced transfer for the arbiter's next "
+        "tick (bypasses SLO/idle signals, not device floors)",
+    )
+    arbiter_force.add_argument(
+        "--ledger-dir",
+        required=True,
+        help="directory holding arbiter_ledger.json",
+    )
+    arbiter_force.add_argument(
+        "--direction",
+        required=True,
+        choices=("borrow", "return"),
+        help="borrow = train->serve, return = serve->train",
+    )
     args = parser.parse_args(argv)
     if args.command == "top":
         from ray_lightning_tpu.observability.aggregator import render_top
@@ -611,6 +701,8 @@ def main(argv: Optional[list] = None) -> int:
         return _cmd_profile(args)
     if args.command == "requests":
         return _cmd_requests(args)
+    if args.command == "arbiter":
+        return _cmd_arbiter(args, arbiter_p)
     parser.print_help()
     return 2
 

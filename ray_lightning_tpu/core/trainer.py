@@ -23,7 +23,7 @@ from __future__ import annotations
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Union
+from typing import Any, Callable, Dict, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -158,6 +158,12 @@ class Trainer:
         self.fast_dev_run = fast_dev_run
         self.use_distributed_sampler = use_distributed_sampler
         self.enable_checkpointing = enable_checkpointing and not fast_dev_run
+        # safe-boundary hooks: callables fired at the points where chip
+        # membership may change without losing work — every per-step
+        # health tick (boundary="step") and every epoch end
+        # (boundary="epoch_end"). The ChipArbiter's training handle
+        # registers here to learn when a shrink/grow is safe to apply.
+        self._safe_boundary_hooks: List[Callable[[int, str], None]] = []
         if fast_dev_run:
             self.max_epochs = 1
             self.limit_train_batches = 1
@@ -1030,6 +1036,7 @@ class Trainer:
                     # none are skipped
                     continue
                 self.current_epoch += 1
+                self._fire_safe_boundary("epoch_end")
                 if 0 <= self.max_steps <= self.global_step:
                     self.should_stop = True
                 if self.should_stop and self.current_epoch < self.min_epochs:
@@ -1146,6 +1153,30 @@ class Trainer:
         )
         return self._input_prefetcher.iterate(loader, limit)
 
+    def register_safe_boundary_hook(
+        self, hook: Callable[[int, str], None]
+    ) -> None:
+        """Register ``hook(global_step, boundary)`` to fire at every safe
+        resize boundary: each training health tick (``boundary="step"``)
+        and each epoch end (``boundary="epoch_end"``). Hooks must be
+        cheap and must not raise — exceptions are logged and swallowed so
+        an arbiter bug can never kill the step loop."""
+        self._safe_boundary_hooks.append(hook)
+
+    def _fire_safe_boundary(self, boundary: str) -> None:
+        if not self._safe_boundary_hooks:
+            return
+        from ray_lightning_tpu.utils.common import rank_zero_warn
+
+        for hook in self._safe_boundary_hooks:
+            try:
+                hook(self.global_step, boundary)
+            except Exception:
+                rank_zero_warn(
+                    f"safe-boundary hook {hook!r} raised at "
+                    f"{boundary} (step {self.global_step}); ignoring"
+                )
+
     def _health_tick(self, train: bool) -> None:
         """Per-batch liveness tick: fire any scripted fault for this rank at
         this global step (train batches only — a validation batch must not
@@ -1156,6 +1187,7 @@ class Trainer:
 
         if train:
             _faults.fire_step_faults(self.global_step)
+            self._fire_safe_boundary("step")
         _session.emit_heartbeat(self.global_step)
         agent = getattr(self, "_elastic_agent", None)
         if train and agent is not None:

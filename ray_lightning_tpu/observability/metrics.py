@@ -39,6 +39,7 @@ SERVE_RETRIES_METRIC = "rlt_serve_retries_total"
 SERVE_SHED_METRIC = "rlt_serve_shed_total"
 SERVE_DEADLINE_EXPIRED_METRIC = "rlt_serve_deadline_expired_total"
 SERVE_BREAKER_STATE_METRIC = "rlt_serve_breaker_state"
+SERVE_CAPACITY_BLOCKED_METRIC = "rlt_serve_capacity_blocked_total"
 
 # `# HELP` text for the exposition; metrics not listed fall back to a
 # name-derived placeholder so every family still carries a HELP line.

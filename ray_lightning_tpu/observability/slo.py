@@ -269,6 +269,21 @@ class SLOMonitor:
             if m.objective.metric.startswith("rlt_serve_")
         )
 
+    def serving_fast_burn(self, now: Optional[float] = None) -> float:
+        """Worst fast-window burn rate across serving-path objectives
+        (metric name ``rlt_serve_*``) — the ChipArbiter's borrow signal:
+        a fast burn above its threshold means serving is eating error
+        budget NOW and a chip should move before the slow window
+        confirms a full breach."""
+        return max(
+            (
+                m.burn_rate(m.fast_window_s, now)
+                for m in self.monitors.values()
+                if m.objective.metric.startswith("rlt_serve_")
+            ),
+            default=0.0,
+        )
+
     def burn_rates(self, now: Optional[float] = None) -> Dict[str, Dict[str, float]]:
         out: Dict[str, Dict[str, float]] = {}
         for name, m in self.monitors.items():

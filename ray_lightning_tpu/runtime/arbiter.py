@@ -1,0 +1,803 @@
+"""SLO-driven train/serve chip arbitration for one shared reservation.
+
+A fixed TPU reservation runs two workloads: a training mesh and a
+serving fleet. Diurnal serving traffic means the right split moves —
+the founding "one substrate" thesis — so the :class:`ChipArbiter` owns
+the device ledger for the reservation and moves chips between the two
+sides as a **supervised state machine**, never a fire-and-forget call::
+
+    steady -> borrow_pending -> draining -> resharding -> lent
+    lent   -> return_pending -> steady
+
+Borrow (train -> serve) is triggered by serving-side fast SLO burn above
+``borrow_burn`` (:mod:`~..observability.slo`) or by the autoscaler
+reporting ``capacity_blocked`` (it wants a replica the fleet has no free
+device for). The training side shrinks at its next safe boundary,
+freed chips boot pre-warmed serving replicas (warm compile cache makes
+this load-bound), and the request journal / breaker layer keeps every
+in-flight request alive across the cutover. Return (serve -> train) is
+driven by sustained idle ticks, **vetoed while serving SLO burn is
+active** (the same veto that blocks autoscaler scale-down), and the
+training side regrows at an epoch boundary.
+
+Every transition is crash-consistent:
+
+- intent is journaled to an atomic ``arbiter_ledger.json`` (tmp + fsync
+  + rename, the membership-ledger idiom) BEFORE acting, and again after
+  each device changes hands — a crash at any instant leaves a ledger
+  that names exactly which devices are mid-flight;
+- a restarted arbiter reconciles the ledger against the handles' ground
+  truth (:meth:`recover`): devices that already landed are re-adopted,
+  orphaned mid-flight devices have the transfer's intent completed, and
+  a transfer that never freed anything is rolled back — no device is
+  ever leaked or owned by both sides;
+- each phase (shrink, per-replica boot, drain, regrow) runs under a
+  per-transition deadline; a timeout or spawn failure cancels the
+  transfer cleanly back to its source side with exponential backoff,
+  and a do-not-thrash cooldown separates consecutive transfers.
+
+The two sides are duck-typed handles so unit tests drive fakes and the
+integration layer adapts the real ElasticController / LocalReplicaFleet:
+
+- train handle: ``devices() -> iterable[str]`` (ground truth of owned
+  chips), ``shrink(count) -> list[str]`` (free ``count`` chips at the
+  next safe boundary, blocking; returns their ids), ``grow(devices)``
+  (re-admit chips, blocking).
+- serve handle: ``devices() -> dict[str, int]`` (chip id -> replica
+  index), ``add_replica(device) -> int`` (boot a pre-warmed replica on
+  the chip), ``remove_replica(index)`` (graceful drain, blocking),
+  ``loads() -> dict`` (idle detection; optional).
+
+Fault hooks (``arbiter:<stall|crash-mid-borrow|crash-mid-return|
+spawn-fail>@<transferN|every:N>`` in ``RLT_FAULT``, see
+:mod:`.faults`) let the chaos harness kill the arbiter itself
+mid-transfer and assert the recovery contract.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from ray_lightning_tpu import observability as _obs
+from ray_lightning_tpu.runtime import faults as _faults
+from ray_lightning_tpu.runtime.elastic import _atomic_write
+
+log = logging.getLogger(__name__)
+
+LEDGER_NAME = "arbiter_ledger.json"
+FORCE_NAME = "arbiter_force.json"
+
+STATES = (
+    "steady",
+    "borrow_pending",
+    "draining",
+    "resharding",
+    "lent",
+    "return_pending",
+)
+
+# current state as a gauge, encoded by STATES index
+ARBITER_STATE_METRIC = "rlt_arbiter_state"
+# device counts by owner label (train / serve / transit)
+ARBITER_DEVICES_METRIC = "rlt_arbiter_devices"
+# completed/failed transfers by direction + outcome
+ARBITER_TRANSFERS_METRIC = "rlt_arbiter_transfers_total"
+# end-to-end transfer latency by direction
+ARBITER_TRANSFER_SECONDS_METRIC = "rlt_arbiter_transfer_seconds"
+# transfers cancelled cleanly back to their source side
+ARBITER_ROLLBACKS_METRIC = "rlt_arbiter_rollbacks_total"
+# return transfers blocked by the serving SLO veto
+ARBITER_RETURN_VETOED_METRIC = "rlt_arbiter_return_vetoed_total"
+# ledger reconciliations on arbiter restart, by action label
+ARBITER_RECOVERIES_METRIC = "rlt_arbiter_recoveries_total"
+
+
+class TransferTimeout(RuntimeError):
+    """A transfer phase exceeded its per-transition deadline."""
+
+
+class LedgerInvariantError(RuntimeError):
+    """The ledger and the handles' ground truth disagree in a way
+    reconciliation cannot repair (a device owned by both sides)."""
+
+
+def _utc() -> float:
+    return time.time()
+
+
+class ChipArbiter:
+    """Driver-level arbiter moving chips between training and serving.
+
+    ``ledger_dir`` holds ``arbiter_ledger.json`` (and the CLI's
+    force-transfer request file). ``devices`` seeds a fresh ledger —
+    either an iterable of chip ids (all homed to training) or a dict
+    ``{chip_id: "train"|"serve"}``; ignored when a ledger already exists
+    (the arbiter recovers from it instead).
+
+    Call :meth:`tick` on the driver's health cadence; each call applies
+    at most one transfer. :meth:`request_transfer` queues an operator
+    override (the ``cli arbiter force-transfer`` path) that the next
+    tick executes regardless of signals.
+    """
+
+    def __init__(
+        self,
+        ledger_dir: str,
+        train: Any,
+        serve: Any,
+        devices: Optional[Any] = None,
+        *,
+        slo_monitor: Optional[Any] = None,
+        autoscaler: Optional[Any] = None,
+        borrow_burn: float = 6.0,
+        borrow_count: int = 1,
+        min_train_devices: int = 1,
+        idle_ticks_return: int = 3,
+        cooldown_s: float = 30.0,
+        transition_timeout_s: float = 120.0,
+        backoff_base_s: float = 1.0,
+        backoff_max_s: float = 60.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if borrow_count < 1:
+            raise ValueError("borrow_count must be >= 1")
+        if min_train_devices < 0:
+            raise ValueError("min_train_devices must be >= 0")
+        if idle_ticks_return < 1:
+            raise ValueError("idle_ticks_return must be >= 1")
+        self.ledger_dir = ledger_dir
+        self.ledger_path = os.path.join(ledger_dir, LEDGER_NAME)
+        self._force_path = os.path.join(ledger_dir, FORCE_NAME)
+        self.train = train
+        self.serve = serve
+        self.slo_monitor = slo_monitor
+        self.autoscaler = autoscaler
+        self.borrow_burn = float(borrow_burn)
+        self.borrow_count = int(borrow_count)
+        self.min_train_devices = int(min_train_devices)
+        self.idle_ticks_return = int(idle_ticks_return)
+        self.cooldown_s = float(cooldown_s)
+        self.transition_timeout_s = float(transition_timeout_s)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._idle_streak = 0
+        self._cooldown_until: Optional[float] = None
+        self.recovered_action: Optional[str] = None
+        os.makedirs(ledger_dir, exist_ok=True)
+        if os.path.exists(self.ledger_path):
+            with open(self.ledger_path, "r", encoding="utf-8") as f:
+                self._led = json.load(f)
+            self.recovered_action = self.recover()
+        else:
+            if devices is None:
+                raise ValueError(
+                    "devices is required when no ledger exists at "
+                    f"{self.ledger_path}"
+                )
+            if isinstance(devices, dict):
+                owner = {str(d): str(side) for d, side in devices.items()}
+            else:
+                owner = {str(d): "train" for d in devices}
+            bad = [d for d, s in owner.items() if s not in ("train", "serve")]
+            if bad:
+                raise ValueError(f"devices must map to train/serve: {bad}")
+            self._led = {
+                "version": 1,
+                "state": "steady",
+                "owner": owner,
+                "home": dict(owner),
+                "replicas": {},
+                "transfer": None,
+                "transfer_seq": 0,
+                "transfers_completed": 0,
+                "failures": 0,
+                "updated": _utc(),
+            }
+            self._journal()
+        self._publish()
+
+    # ----------------------------------------------------------------- #
+    # views
+    # ----------------------------------------------------------------- #
+    @property
+    def state(self) -> str:
+        return self._led["state"]
+
+    @property
+    def transfers_completed(self) -> int:
+        return int(self._led["transfers_completed"])
+
+    @property
+    def transfer_seq(self) -> int:
+        return int(self._led["transfer_seq"])
+
+    def devices_by_owner(self) -> Dict[str, List[str]]:
+        out: Dict[str, List[str]] = {"train": [], "serve": [], "transit": []}
+        for d, side in sorted(self._led["owner"].items()):
+            out[side].append(d)
+        return out
+
+    def borrowed_devices(self) -> List[str]:
+        """Chips homed to training but currently lent to serving."""
+        return [
+            d
+            for d, side in sorted(self._led["owner"].items())
+            if side == "serve" and self._led["home"].get(d) == "train"
+        ]
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self.state,
+                "devices": self.devices_by_owner(),
+                "borrowed": self.borrowed_devices(),
+                "transfer": self._led["transfer"],
+                "transfer_seq": self.transfer_seq,
+                "transfers_completed": self.transfers_completed,
+                "failures": int(self._led["failures"]),
+                "ledger": self.ledger_path,
+            }
+
+    # ----------------------------------------------------------------- #
+    # journal
+    # ----------------------------------------------------------------- #
+    def _journal(self) -> None:
+        self._led["updated"] = _utc()
+        _atomic_write(
+            self.ledger_path,
+            json.dumps(self._led, indent=2, sort_keys=True).encode("utf-8"),
+        )
+
+    def _set(self, state: str, phase: Optional[str] = None) -> None:
+        """Journal a state (and in-flight phase) BEFORE the act it
+        announces — the crash-consistency contract."""
+        if state not in STATES:
+            raise ValueError(f"unknown state {state!r}")
+        self._led["state"] = state
+        if phase is not None and self._led["transfer"] is not None:
+            self._led["transfer"]["phase"] = phase
+        self._journal()
+
+    # ----------------------------------------------------------------- #
+    # operator override (cli arbiter force-transfer)
+    # ----------------------------------------------------------------- #
+    def request_transfer(self, direction: str) -> None:
+        """Queue a forced transfer for the next tick. ``direction`` is
+        ``"borrow"`` or ``"return"``. Bypasses the SLO / idle signals
+        (an operator override) but not the device floors."""
+        if direction not in ("borrow", "return"):
+            raise ValueError("direction must be 'borrow' or 'return'")
+        _atomic_write(
+            self._force_path,
+            json.dumps({"direction": direction, "ts": _utc()}).encode(
+                "utf-8"
+            ),
+        )
+
+    def _consume_force(self) -> Optional[str]:
+        if not os.path.exists(self._force_path):
+            return None
+        try:
+            with open(self._force_path, "r", encoding="utf-8") as f:
+                direction = json.load(f).get("direction")
+        except (OSError, ValueError):
+            direction = None
+        try:
+            os.unlink(self._force_path)
+        except OSError:
+            pass
+        return direction if direction in ("borrow", "return") else None
+
+    # ----------------------------------------------------------------- #
+    # signals
+    # ----------------------------------------------------------------- #
+    def _borrow_signal(self) -> Optional[str]:
+        asc = self.autoscaler
+        if asc is not None and getattr(asc, "capacity_blocked_streak", 0) > 0:
+            return "capacity_blocked"
+        mon = self.slo_monitor
+        if mon is not None and hasattr(mon, "serving_fast_burn"):
+            if mon.serving_fast_burn() >= self.borrow_burn:
+                return "slo_burn"
+        return None
+
+    def _return_vetoed(self) -> bool:
+        mon = self.slo_monitor
+        return bool(
+            mon is not None
+            and hasattr(mon, "serving_breached")
+            and mon.serving_breached()
+        )
+
+    def _serve_idle(self) -> bool:
+        loads = getattr(self.serve, "loads", None)
+        if loads is None:
+            return True
+        entries = [e or {} for e in loads().values()]
+        queued = sum(float(e.get("queue_depth", 0)) for e in entries)
+        active = sum(float(e.get("active", 0)) for e in entries)
+        return queued == 0 and active == 0
+
+    # ----------------------------------------------------------------- #
+    # tick
+    # ----------------------------------------------------------------- #
+    def tick(self, now: Optional[float] = None) -> str:
+        """Evaluate signals once; perform at most one transfer. Returns
+        an outcome string for tests/operators: ``idle``, ``cooldown``,
+        ``borrowed``, ``returned``, ``vetoed``, ``rolled_back``, or
+        ``at_floor``."""
+        with self._lock:
+            now = self._clock() if now is None else now
+            force = self._consume_force()
+            outcome = self._tick_locked(now, force)
+            self._publish()
+            return outcome
+
+    def _tick_locked(self, now: float, force: Optional[str]) -> str:
+        state = self.state
+        in_cooldown = (
+            self._cooldown_until is not None and now < self._cooldown_until
+        )
+        if state == "steady" and not self.borrowed_devices():
+            want = force == "borrow" or (
+                force is None and self._borrow_signal() is not None
+            )
+            if not want:
+                return "idle"
+            if in_cooldown and force is None:
+                return "cooldown"
+            train_devs = [
+                d for d, s in self._led["owner"].items() if s == "train"
+            ]
+            if len(train_devs) - self.borrow_count < self.min_train_devices:
+                return "at_floor"
+            return self._borrow(now)
+        if state == "lent" or (state == "steady" and self.borrowed_devices()):
+            if self._serve_idle():
+                self._idle_streak += 1
+            else:
+                self._idle_streak = 0
+            want = force == "return" or (
+                force is None and self._idle_streak >= self.idle_ticks_return
+            )
+            if not want:
+                return "idle"
+            if self._return_vetoed() and force is None:
+                reg = _obs.registry()
+                if reg is not None:
+                    reg.counter(ARBITER_RETURN_VETOED_METRIC).inc()
+                _obs.event("arbiter_return_vetoed", state=state)
+                return "vetoed"
+            if in_cooldown and force is None:
+                return "cooldown"
+            return self._return(now)
+        return "idle"
+
+    # ----------------------------------------------------------------- #
+    # phase execution under a deadline
+    # ----------------------------------------------------------------- #
+    def _phase(self, fn: Callable[[], Any], label: str) -> Any:
+        box: Dict[str, Any] = {}
+
+        def run() -> None:
+            try:
+                box["value"] = fn()
+            except BaseException as exc:  # re-raised in the caller
+                box["error"] = exc
+
+        t = threading.Thread(
+            target=run, daemon=True, name=f"rlt-arbiter-{label}"
+        )
+        t.start()
+        t.join(self.transition_timeout_s)
+        if t.is_alive():
+            raise TransferTimeout(
+                f"arbiter phase {label!r} exceeded "
+                f"{self.transition_timeout_s}s"
+            )
+        if "error" in box:
+            raise box["error"]
+        return box.get("value")
+
+    def _fail(self, now: float, direction: str, exc: BaseException) -> None:
+        self._led["failures"] = int(self._led["failures"]) + 1
+        backoff = min(
+            self.backoff_base_s * (2 ** (int(self._led["failures"]) - 1)),
+            self.backoff_max_s,
+        )
+        self._cooldown_until = now + max(self.cooldown_s, backoff)
+        self._journal()  # the failure streak survives an arbiter restart
+        reg = _obs.registry()
+        if reg is not None:
+            reg.counter(ARBITER_ROLLBACKS_METRIC).inc()
+            reg.counter(
+                ARBITER_TRANSFERS_METRIC,
+                direction=direction,
+                outcome="rolled_back",
+            ).inc()
+        _obs.event(
+            "arbiter_rollback",
+            direction=direction,
+            error=f"{type(exc).__name__}: {exc}",
+            backoff_s=round(backoff, 3),
+        )
+        log.warning(
+            "arbiter %s transfer rolled back (%s); backoff %.1fs",
+            direction,
+            exc,
+            backoff,
+        )
+
+    def _complete(self, now: float, direction: str, t0: float) -> None:
+        self._led["failures"] = 0
+        self._led["transfers_completed"] = self.transfers_completed + 1
+        self._cooldown_until = now + self.cooldown_s
+        reg = _obs.registry()
+        if reg is not None:
+            reg.counter(
+                ARBITER_TRANSFERS_METRIC,
+                direction=direction,
+                outcome="completed",
+            ).inc()
+            reg.histogram(
+                ARBITER_TRANSFER_SECONDS_METRIC, direction=direction
+            ).observe(max(self._clock() - t0, 0.0))
+        _obs.event(
+            "arbiter_transfer",
+            direction=direction,
+            transfer=self.transfer_seq,
+            devices=len(self._led["transfer"]["devices"])
+            if self._led["transfer"]
+            else 0,
+        )
+
+    # ----------------------------------------------------------------- #
+    # borrow: train -> serve
+    # ----------------------------------------------------------------- #
+    def _borrow(self, now: float) -> str:
+        tid = self.transfer_seq + 1
+        self._led["transfer_seq"] = tid
+        t0 = self._clock()
+        owner = self._led["owner"]
+        freed: List[str] = []
+        with _obs.span("arbiter/borrow", transfer=tid):
+            # intent BEFORE acting: a crash from here on names the
+            # transfer and its direction in the ledger
+            self._led["transfer"] = {
+                "id": tid,
+                "direction": "borrow",
+                "phase": "borrow_pending",
+                "devices": [],
+                "count": self.borrow_count,
+            }
+            self._set("borrow_pending")
+            try:
+                _faults.fire_arbiter_faults(tid, "start")
+                self._set("draining", phase="draining")
+                freed = [
+                    str(d)
+                    for d in self._phase(
+                        lambda: self.train.shrink(self.borrow_count),
+                        "shrink",
+                    )
+                ]
+                for d in freed:
+                    owner[d] = "transit"
+                self._led["transfer"]["devices"] = list(freed)
+                self._set("resharding", phase="resharding")
+                # the juiciest crash point: chips freed, replicas not up
+                _faults.fire_arbiter_faults(tid, "mid-borrow")
+                for d in freed:
+                    _faults.fire_arbiter_faults(tid, "spawn")
+                    idx = self._phase(
+                        lambda d=d: self.serve.add_replica(d), "spawn"
+                    )
+                    owner[d] = "serve"
+                    self._led["replicas"][d] = int(idx)
+                    self._journal()  # each device journals as it lands
+            except _faults.ArbiterFault:
+                raise  # simulated driver death: ledger stays mid-transfer
+            except Exception as exc:
+                self._rollback_borrow(freed, exc)
+                self._fail(now, "borrow", exc)
+                return "rolled_back"
+            self._complete(now, "borrow", t0)
+            self._led["transfer"] = None
+            self._set("lent")
+        self._idle_streak = 0
+        return "borrowed"
+
+    def _rollback_borrow(
+        self, freed: Iterable[str], exc: BaseException
+    ) -> None:
+        """Cancel a failed borrow cleanly back to steady: tear down any
+        replica that did boot, grow training back to full strength."""
+        owner = self._led["owner"]
+        back: List[str] = []
+        for d in freed:
+            idx = self._led["replicas"].pop(d, None)
+            if idx is not None:
+                try:
+                    self._phase(
+                        lambda idx=idx: self.serve.remove_replica(idx),
+                        "rollback-drain",
+                    )
+                except Exception:
+                    log.exception(
+                        "arbiter rollback: draining replica %s failed", idx
+                    )
+            back.append(d)
+        if back:
+            try:
+                self._phase(lambda: self.train.grow(back), "rollback-grow")
+            except Exception:
+                # chips stay in transit; the recovery path re-adopts them
+                log.exception("arbiter rollback: regrow failed")
+            else:
+                for d in back:
+                    owner[d] = "train"
+        self._led["transfer"] = None
+        self._set("lent" if self.borrowed_devices() else "steady")
+
+    # ----------------------------------------------------------------- #
+    # return: serve -> train
+    # ----------------------------------------------------------------- #
+    def _return(self, now: float) -> str:
+        borrowed = self.borrowed_devices() + [
+            d
+            for d, s in self._led["owner"].items()
+            if s == "transit" and self._led["home"].get(d) == "train"
+        ]
+        if not borrowed:
+            return "idle"
+        tid = self.transfer_seq + 1
+        self._led["transfer_seq"] = tid
+        t0 = self._clock()
+        owner = self._led["owner"]
+        with _obs.span("arbiter/return", transfer=tid):
+            self._led["transfer"] = {
+                "id": tid,
+                "direction": "return",
+                "phase": "return_pending",
+                "devices": list(borrowed),
+                "count": len(borrowed),
+            }
+            self._set("return_pending")
+            drained: List[str] = []
+            try:
+                _faults.fire_arbiter_faults(tid, "start")
+                for d in borrowed:
+                    idx = self._led["replicas"].pop(d, None)
+                    if idx is not None:
+                        self._phase(
+                            lambda idx=idx: self.serve.remove_replica(idx),
+                            "drain",
+                        )
+                    owner[d] = "transit"
+                    drained.append(d)
+                    self._journal()
+                # chips drained out of serving, not yet back in the mesh
+                _faults.fire_arbiter_faults(tid, "mid-return")
+                self._phase(lambda: self.train.grow(list(borrowed)), "grow")
+                for d in borrowed:
+                    owner[d] = "train"
+            except _faults.ArbiterFault:
+                raise  # simulated driver death: ledger stays mid-transfer
+            except Exception as exc:
+                self._rollback_return(drained, exc)
+                self._fail(now, "return", exc)
+                return "rolled_back"
+            self._complete(now, "return", t0)
+            self._led["transfer"] = None
+            self._set("steady")
+        self._idle_streak = 0
+        return "returned"
+
+    def _rollback_return(
+        self, drained: Iterable[str], exc: BaseException
+    ) -> None:
+        """Cancel a failed return back to lent: re-boot replicas on the
+        chips that were already drained. A chip whose replica cannot be
+        re-booted stays ``transit`` — the next return attempt (or a
+        restart's recovery) picks it up; it is never lost from the
+        ledger."""
+        owner = self._led["owner"]
+        for d in drained:
+            try:
+                idx = self._phase(
+                    lambda d=d: self.serve.add_replica(d), "rollback-spawn"
+                )
+            except Exception:
+                log.exception(
+                    "arbiter rollback: re-boot of replica on %s failed", d
+                )
+            else:
+                owner[d] = "serve"
+                self._led["replicas"][d] = int(idx)
+        self._led["transfer"] = None
+        self._set("lent")
+
+    # ----------------------------------------------------------------- #
+    # restart recovery
+    # ----------------------------------------------------------------- #
+    def recover(self) -> Optional[str]:
+        """Reconcile a loaded ledger against the handles' ground truth.
+
+        Devices that already landed on a side are re-adopted as that
+        side's; orphaned mid-flight (``transit``) devices have the
+        interrupted transfer's intent completed (borrow: boot the
+        replica, falling back to a training regrow; return: regrow);
+        a transfer that never moved anything rolls back to its source.
+        Returns the action taken (``"adopted"``, ``"completed"``,
+        ``"rolled_back"``) or ``None`` when the ledger was clean.
+        Raises :class:`LedgerInvariantError` if a device is claimed by
+        both handles — that is double-assignment, not recoverable."""
+        serve_devs = {
+            str(d): int(i) for d, i in dict(self.serve.devices()).items()
+        }
+        train_devs = {str(d) for d in self.train.devices()}
+        both = set(serve_devs) & train_devs
+        if both:
+            raise LedgerInvariantError(
+                f"devices owned by both sides: {sorted(both)}"
+            )
+        owner = self._led["owner"]
+        tr = self._led["transfer"]
+        action: Optional[str] = None
+        moved = completed = 0
+        for d in list(owner):
+            if d in serve_devs:
+                if owner[d] != "serve":
+                    moved += 1
+                owner[d] = "serve"
+                self._led["replicas"][d] = serve_devs[d]
+            elif d in train_devs:
+                if owner[d] != "train":
+                    moved += 1
+                owner[d] = "train"
+                self._led["replicas"].pop(d, None)
+        if tr is not None:
+            direction = tr["direction"]
+            orphans = [d for d in owner if owner[d] == "transit"]
+            for d in orphans:
+                if direction == "borrow":
+                    try:
+                        idx = self._phase(
+                            lambda d=d: self.serve.add_replica(d),
+                            "recover-spawn",
+                        )
+                    except Exception:
+                        # cannot finish the borrow: roll the chip back
+                        self._phase(
+                            lambda d=d: self.train.grow([d]), "recover-grow"
+                        )
+                        owner[d] = "train"
+                    else:
+                        owner[d] = "serve"
+                        self._led["replicas"][d] = int(idx)
+                        completed += 1
+                else:
+                    self._phase(
+                        lambda d=d: self.train.grow([d]), "recover-grow"
+                    )
+                    owner[d] = "train"
+                    completed += 1
+            if completed:
+                action = "completed"
+                self._led["transfers_completed"] = (
+                    self.transfers_completed + 1
+                )
+            elif moved:
+                action = "adopted"
+                self._led["transfers_completed"] = (
+                    self.transfers_completed + 1
+                )
+            else:
+                action = "rolled_back"
+            self._led["transfer"] = None
+        elif moved:
+            action = "adopted"
+        self._led["state"] = "lent" if self.borrowed_devices() else "steady"
+        self._journal()
+        if action is not None:
+            reg = _obs.registry()
+            if reg is not None:
+                reg.counter(ARBITER_RECOVERIES_METRIC, action=action).inc()
+            _obs.event(
+                "arbiter_recovered",
+                action=action,
+                state=self.state,
+                moved=moved,
+                completed=completed,
+            )
+            log.info(
+                "arbiter recovered from %s: %s (state=%s)",
+                self.ledger_path,
+                action,
+                self.state,
+            )
+        return action
+
+    # ----------------------------------------------------------------- #
+    # gauges
+    # ----------------------------------------------------------------- #
+    def _publish(self) -> None:
+        reg = _obs.registry()
+        if reg is None:
+            return
+        reg.gauge(ARBITER_STATE_METRIC).set(STATES.index(self.state))
+        by_owner = self.devices_by_owner()
+        for side in ("train", "serve", "transit"):
+            reg.gauge(ARBITER_DEVICES_METRIC, owner=side).set(
+                len(by_owner[side])
+            )
+
+
+class FleetServeHandle:
+    """Adapts a :class:`~..serving.replica.LocalReplicaFleet` to the
+    arbiter's serve-handle protocol.
+
+    ``add_replica(device)`` grants the fleet one device of capacity and
+    boots a pre-warmed replica on it (the warm compile cache makes this
+    load-bound); ``remove_replica(index)`` preempts the replica (queued
+    backlog handed back and migrated, in-flight work finishes), waits
+    for the drain to settle, and revokes the capacity grant — so the
+    chip leaves serving with zero dropped requests."""
+
+    def __init__(
+        self,
+        fleet: Any,
+        drain_timeout_s: float = 60.0,
+        drain_poll_s: float = 0.02,
+    ):
+        self.fleet = fleet
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.drain_poll_s = float(drain_poll_s)
+        self._by_device: Dict[str, int] = {}
+
+    def devices(self) -> Dict[str, int]:
+        return dict(self._by_device)
+
+    def add_replica(self, device: str) -> int:
+        self.fleet.grant_capacity(1)
+        try:
+            idx = self.fleet.add_replica()
+        except Exception:
+            self.fleet.revoke_capacity(1)
+            raise
+        self._by_device[str(device)] = int(idx)
+        return int(idx)
+
+    def remove_replica(self, index: int) -> None:
+        if not self.fleet.preempt_replica(index):
+            raise RuntimeError(f"replica {index} not routable; cannot drain")
+        deadline = time.monotonic() + self.drain_timeout_s
+        while index in getattr(self.fleet, "_draining", {}):
+            if time.monotonic() > deadline:
+                raise TransferTimeout(
+                    f"replica {index} drain exceeded {self.drain_timeout_s}s"
+                )
+            time.sleep(self.drain_poll_s)
+        self.fleet.revoke_capacity(1)
+        for d, i in list(self._by_device.items()):
+            if i == index:
+                del self._by_device[d]
+
+    def loads(self) -> Dict[int, Dict[str, float]]:
+        return self.fleet.loads()
+
+
+def read_ledger(ledger_dir: str) -> Dict[str, Any]:
+    """Load ``arbiter_ledger.json`` from ``ledger_dir`` (the ``cli
+    arbiter status`` path — read-only, no handles needed)."""
+    path = os.path.join(ledger_dir, LEDGER_NAME)
+    with open(path, "r", encoding="utf-8") as f:
+        led = json.load(f)
+    led["ledger"] = path
+    return led

@@ -63,10 +63,34 @@ Serving ``crash`` raises inside the engine loop instead of ``os._exit``:
 ``LocalReplicaFleet`` replicas are threads in the driver process, so a
 process kill would take out the whole fleet (and the test). The raise
 kills exactly one replica's engine — the supervised-death the journal
-and circuit breaker must recover from. Training specs (``rank...``) and
-serving specs (``replica...``) coexist in one ``RLT_FAULT`` value; each
-parser skips the other family. ``RLT_FAULT_FUSE`` at-most-once semantics
-are identical (``@every`` burns one fuse per firing tick).
+and circuit breaker must recover from. Training specs (``rank...``),
+serving specs (``replica...``) and arbiter specs (``arbiter...``)
+coexist in one ``RLT_FAULT`` value; each parser skips every *other known*
+family by prefix and only errors on specs that belong to no family at
+all. ``RLT_FAULT_FUSE`` at-most-once semantics are identical (``@every``
+burns one fuse per firing tick).
+
+The chip-arbiter family (``runtime/arbiter.py`` hooks these per
+transfer) targets the driver-level rebalancing state machine itself::
+
+    arbiter:<kind>@<where>[:<arg>]
+
+    arbiter:stall@transfer1:0.5        # sleep 0.5s at the start of the
+                                       # 1st transfer (deadline food)
+    arbiter:crash-mid-borrow@transfer2 # arbiter dies after training
+                                       # freed chips, before replicas
+                                       # boot (half-finished borrow)
+    arbiter:crash-mid-return@every:3   # arbiter dies after serving
+                                       # drained, before the regrow
+    arbiter:spawn-fail@transfer1       # the borrowed-chip replica boot
+                                       # fails (clean-cancel path)
+
+Arbiter ``crash-*`` raises :class:`ArbiterFault` — like serving crashes,
+an exception rather than ``os._exit``: the contract under test is "the
+arbiter's control loop dies mid-transfer and a restarted arbiter
+recovers from ``arbiter_ledger.json``", not "the driver process dies".
+``spawn-fail`` raises :class:`ArbiterSpawnError` at the replica-boot
+step instead, which the arbiter must catch and roll back gracefully.
 """
 from __future__ import annotations
 
@@ -87,6 +111,22 @@ _SPEC_RE = re.compile(
     r"(?:@(?:step(?P<step>\d+)|every:(?P<every>\d+)|(?P<boot>boot)))?"
     r"(?::(?P<arg>[0-9.]+))?$"
 )
+
+# every known spec family, by prefix. Each family's parser owns exactly
+# one prefix and SKIPS the others — so a mixed RLT_FAULT value (rank +
+# replica + arbiter, comma-separated) parses independently in all three
+# parsers instead of one family's parser rejecting another family's
+# perfectly valid spec.
+_FAMILIES = ("rank", "replica", "arbiter")
+
+
+def _spec_family(raw: str) -> Optional[str]:
+    """The family prefix a raw spec belongs to, or None for no known
+    family (which every parser reports as a bad spec)."""
+    for fam in _FAMILIES:
+        if raw.startswith(fam):
+            return fam
+    return None
 
 
 @dataclass(frozen=True)
@@ -135,8 +175,8 @@ def parse_faults(text: Optional[str]) -> List[FaultSpec]:
         raw = raw.strip()
         if not raw:
             continue
-        if raw.startswith("replica"):
-            continue  # serving-family spec; parse_serve_faults owns it
+        if _spec_family(raw) not in (None, "rank"):
+            continue  # another family's spec; its own parser owns it
         m = _SPEC_RE.match(raw)
         if m is None:
             raise ValueError(
@@ -349,8 +389,10 @@ def parse_serve_faults(text: Optional[str]) -> List[ServeFaultSpec]:
     specs: List[ServeFaultSpec] = []
     for raw in text.split(","):
         raw = raw.strip()
-        if not raw or raw.startswith("rank"):
+        if not raw:
             continue
+        if _spec_family(raw) not in (None, "replica"):
+            continue  # another family's spec; its own parser owns it
         m = _SERVE_SPEC_RE.match(raw)
         if m is None:
             raise ValueError(
@@ -464,6 +506,178 @@ def serve_request_fault(
                 )
             return spec
     return None
+
+
+# --------------------------------------------------------------------------
+# chip-arbiter fault points
+# --------------------------------------------------------------------------
+
+ARBITER_KINDS = ("stall", "crash-mid-borrow", "crash-mid-return", "spawn-fail")
+
+_ARBITER_SPEC_RE = re.compile(
+    r"^arbiter:(?P<kind>stall|crash-mid-borrow|crash-mid-return|spawn-fail)"
+    r"@(?:transfer(?P<transfer>\d+)|every:(?P<every>\d+))"
+    r"(?::(?P<arg>[0-9.]+))?$"
+)
+
+
+class ArbiterFault(RuntimeError):
+    """Raised by an arbiter ``crash-mid-*`` fault inside a transfer.
+
+    An exception, not ``os._exit``, for the same reason serving crashes
+    are: the contract under test is "the arbiter control loop dies with
+    a half-finished transfer journaled in ``arbiter_ledger.json`` and a
+    restarted arbiter re-adopts or rolls it back" — not "the whole
+    driver process (and the test) dies"."""
+
+
+class ArbiterSpawnError(RuntimeError):
+    """Raised by an arbiter ``spawn-fail`` fault at the borrowed-chip
+    replica-boot step. Unlike :class:`ArbiterFault` the arbiter is
+    expected to CATCH this one: a failed borrow must cancel cleanly back
+    to steady (training regrows its chips) rather than crash."""
+
+
+@dataclass(frozen=True)
+class ArbiterFaultSpec:
+    """One scripted arbiter fault. ``transfer`` targets the Nth transfer
+    the arbiter attempts (1-based, monotonic across borrow AND return);
+    ``every`` matches every transfer that is a positive multiple of N.
+    ``arg`` is the stall length in seconds."""
+
+    kind: str
+    transfer: Optional[int] = None
+    every: Optional[int] = None
+    arg: float = 0.0
+
+    @property
+    def fuse_id(self) -> str:
+        if self.every is not None:
+            where = f"every{self.every}"
+        else:
+            where = f"transfer{self.transfer}"
+        return f"arbiter-{self.kind}-{where}"
+
+    def fuse_id_at(self, transfer: int) -> str:
+        if self.every is not None:
+            return f"{self.fuse_id}-s{transfer}"
+        return self.fuse_id
+
+    def matches_transfer(self, transfer: int) -> bool:
+        if self.every is not None:
+            return transfer > 0 and transfer % self.every == 0
+        return self.transfer is not None and self.transfer == transfer
+
+
+def parse_arbiter_faults(text: Optional[str]) -> List[ArbiterFaultSpec]:
+    """Parse the arbiter specs out of an ``RLT_FAULT`` value; training
+    (``rank...``) and serving (``replica...``) specs are skipped. Raises
+    ValueError naming a bad ``arbiter...`` spec."""
+    if not text:
+        return []
+    specs: List[ArbiterFaultSpec] = []
+    for raw in text.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        if _spec_family(raw) not in (None, "arbiter"):
+            continue  # another family's spec; its own parser owns it
+        m = _ARBITER_SPEC_RE.match(raw)
+        if m is None:
+            raise ValueError(
+                f"bad {FAULT_ENV} arbiter spec {raw!r}: expected "
+                "arbiter:<stall|crash-mid-borrow|crash-mid-return|"
+                "spawn-fail>@<transfer<N>|every:<N>>[:<seconds>]"
+            )
+        kind = m.group("kind")
+        transfer = (
+            int(m.group("transfer"))
+            if m.group("transfer") is not None
+            else None
+        )
+        every = int(m.group("every")) if m.group("every") is not None else None
+        if every is not None and every < 1:
+            raise ValueError(
+                f"bad {FAULT_ENV} arbiter spec {raw!r}: @every needs N >= 1"
+            )
+        if transfer is not None and transfer < 1:
+            raise ValueError(
+                f"bad {FAULT_ENV} arbiter spec {raw!r}: transfers are "
+                "1-based; @transfer needs N >= 1"
+            )
+        if kind == "stall" and m.group("arg") is None:
+            raise ValueError(
+                f"bad {FAULT_ENV} arbiter spec {raw!r}: stall needs a "
+                "length, e.g. arbiter:stall@transfer1:0.5"
+            )
+        specs.append(
+            ArbiterFaultSpec(
+                kind=kind,
+                transfer=transfer,
+                every=every,
+                arg=float(m.group("arg") or 0.0),
+            )
+        )
+    return specs
+
+
+_arbiter_cache: Tuple[Optional[str], List[ArbiterFaultSpec]] = (None, [])
+
+
+def _arbiter_env_specs() -> List[ArbiterFaultSpec]:
+    global _arbiter_cache
+    text = os.environ.get(FAULT_ENV)
+    if text != _arbiter_cache[0]:
+        _arbiter_cache = (text, parse_arbiter_faults(text))
+    return _arbiter_cache[1]
+
+
+# the named points inside a transfer where each arbiter kind fires:
+# "start" right after the transfer intent is journaled (stall);
+# "mid-borrow" after training freed its chips but before replicas boot;
+# "spawn" at each borrowed-chip replica boot (spawn-fail);
+# "mid-return" after serving drained but before the training regrow.
+_ARBITER_POINTS = {
+    "stall": "start",
+    "crash-mid-borrow": "mid-borrow",
+    "spawn-fail": "spawn",
+    "crash-mid-return": "mid-return",
+}
+
+
+def fire_arbiter_faults(transfer: int, point: str) -> None:
+    """ChipArbiter hook, called at the named ``point`` of ``transfer``.
+
+    ``stall`` sleeps ``arg`` seconds at the transfer start (per-phase
+    deadline food); ``crash-mid-borrow`` / ``crash-mid-return`` raise
+    :class:`ArbiterFault` at their mid-transfer points (the arbiter
+    control loop dies there, leaving the ledger half-finished);
+    ``spawn-fail`` raises :class:`ArbiterSpawnError` at the replica-boot
+    step (the clean-cancel rollback path). No-op when no arbiter specs
+    are scripted. Fuse semantics match the other families — ``@every``
+    burns one fuse per firing transfer."""
+    specs = _arbiter_env_specs()
+    if not specs:
+        return
+    for spec in specs:
+        if (
+            _ARBITER_POINTS[spec.kind] == point
+            and spec.matches_transfer(transfer)
+            and not _fuse_blown(spec, transfer)
+        ):
+            _blow_fuse(spec, transfer)
+            if spec.kind == "stall":
+                time.sleep(spec.arg)
+            elif spec.kind == "spawn-fail":
+                raise ArbiterSpawnError(
+                    f"scripted arbiter fault: replica spawn fails on "
+                    f"transfer #{transfer}"
+                )
+            else:
+                raise ArbiterFault(
+                    f"scripted arbiter fault: {spec.kind} on transfer "
+                    f"#{transfer}"
+                )
 
 
 def heartbeats_dropped(step: int) -> bool:

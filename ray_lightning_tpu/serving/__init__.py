@@ -41,6 +41,7 @@ from ray_lightning_tpu.serving.paged_kv import (  # noqa: F401
 )
 from ray_lightning_tpu.serving.replica import (  # noqa: F401
     Autoscaler,
+    CapacityBlocked,
     LocalReplicaFleet,
     ReplicaGroup,
     ServeFuture,
@@ -66,6 +67,7 @@ from ray_lightning_tpu.serving.scheduler import (  # noqa: F401
 
 __all__ = [
     "Autoscaler",
+    "CapacityBlocked",
     "BlockAllocation",
     "BlockAllocator",
     "CircuitBreaker",

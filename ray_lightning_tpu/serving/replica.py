@@ -44,6 +44,7 @@ from ray_lightning_tpu.serving.resilience import (
 
 __all__ = [
     "Autoscaler",
+    "CapacityBlocked",
     "LocalReplicaFleet",
     "ReplicaGroup",
     "ServeFuture",
@@ -52,6 +53,17 @@ __all__ = [
     "needs_relaunch",
     "pick_least_loaded",
 ]
+
+
+class CapacityBlocked(RuntimeError):
+    """``add_replica`` refused: the fleet is at its device capacity.
+
+    A scale-up verdict the fleet cannot satisfy is a *capacity* problem,
+    not a load problem — retrying it silently every tick hides the real
+    remedy (borrow a chip from training). The autoscaler surfaces this
+    as an explicit ``capacity_blocked`` outcome (counter + event +
+    ``capacity_blocked_streak``), which the ChipArbiter reads as its
+    borrow signal."""
 
 
 # --------------------------------------------------------------------- #
@@ -185,6 +197,12 @@ class Autoscaler:
         self._idle_streak = 0
         self.scale_ups = 0
         self.scale_downs = 0
+        # scale-up verdicts the fleet could not satisfy (no free device):
+        # the explicit capacity_blocked outcome the ChipArbiter reads as
+        # a borrow signal. The streak resets on any successful add.
+        self.capacity_blocked_total = 0
+        self.capacity_blocked_streak = 0
+        self.last_outcome: Optional[str] = None
         self.history: List[Tuple[float, int, int]] = []  # (t, n, delta)
 
     def tick(self, now: Optional[float] = None) -> int:
@@ -214,12 +232,36 @@ class Autoscaler:
             if now - self._last_action_at < self.cooldown_s:
                 delta = 0
         if delta > 0:
-            self.fleet.add_replica()
-            self.scale_ups += 1
+            try:
+                self.fleet.add_replica()
+            except CapacityBlocked as exc:
+                # the fleet wants a replica it has no device for: report
+                # it loudly (the arbiter's borrow signal) instead of
+                # silently retrying the same verdict every tick
+                self.capacity_blocked_total += 1
+                self.capacity_blocked_streak += 1
+                self.last_outcome = "capacity_blocked"
+                reg = _obs.registry()
+                if reg is not None:
+                    reg.counter(
+                        _metrics.SERVE_CAPACITY_BLOCKED_METRIC
+                    ).inc()
+                _obs.event(
+                    "serve_capacity_blocked",
+                    replicas=n,
+                    streak=self.capacity_blocked_streak,
+                    error=str(exc),
+                )
+                delta = 0
+            else:
+                self.scale_ups += 1
+                self.capacity_blocked_streak = 0
+                self.last_outcome = "scale_up"
         elif delta < 0:
             self.fleet.remove_replica()
             self.scale_downs += 1
             self._idle_streak = 0
+            self.last_outcome = "scale_down"
         if delta != 0:
             self._last_action_at = now
             self.history.append((now, int(self.fleet.num_replicas), delta))
@@ -335,7 +377,15 @@ class LocalReplicaFleet:
         relaunch: bool = True,
         drain_timeout: float = 60.0,
         pump_interval_s: float = 0.02,
+        capacity: Optional[int] = None,
     ):
+        # device capacity: how many replicas the fleet's share of the
+        # reservation can host. None = unbounded (the pre-arbiter
+        # behaviour); the ChipArbiter adjusts it via grant_capacity /
+        # revoke_capacity as chips move between training and serving.
+        if capacity is not None and capacity < 1:
+            raise ValueError("capacity must be >= 1 (or None)")
+        self.capacity = capacity
         self._builder = builder
         self._engine_kwargs = dict(engine_kwargs or {})
         self._params_cfg: Optional[Tuple[Any, Any]] = None
@@ -378,6 +428,19 @@ class LocalReplicaFleet:
             replicas = dict(self._replicas)
         return {i: eng.load() for i, eng in replicas.items()}
 
+    def grant_capacity(self, n: int = 1) -> None:
+        """Raise the device capacity by ``n`` (a chip lent to serving).
+        No-op on an unbounded fleet."""
+        if self.capacity is not None:
+            self.capacity += int(n)
+
+    def revoke_capacity(self, n: int = 1) -> None:
+        """Lower the device capacity by ``n`` (a lent chip going home).
+        Never drops below 1 replica's worth; no-op on an unbounded
+        fleet."""
+        if self.capacity is not None:
+            self.capacity = max(1, self.capacity - int(n))
+
     def _breaker(self, index: int) -> CircuitBreaker:
         with self._lock:
             breaker = self.breakers.get(index)
@@ -393,12 +456,24 @@ class LocalReplicaFleet:
         """Build + start one engine. ``index=None`` allocates a fresh
         index (scale-up); an explicit index is the relaunch path — the
         new engine inherits the index's circuit breaker, so a replica
-        that died with an open breaker still has to pass its probe."""
+        that died with an open breaker still has to pass its probe.
+
+        Scale-up (``index=None``) raises :class:`CapacityBlocked` when
+        the fleet is already at its device ``capacity``; relaunches keep
+        their slot and are never capacity-checked."""
         from ray_lightning_tpu.serving.engine import (
             EngineConfig,
             InferenceEngine,
         )
 
+        if index is None and self.capacity is not None:
+            with self._lock:
+                occupied = len(self._replicas) + len(self._draining)
+            if occupied >= self.capacity:
+                raise CapacityBlocked(
+                    f"fleet at capacity ({occupied}/{self.capacity}): no "
+                    "free device for a new replica"
+                )
         if self._params_cfg is None:
             # one build, shared by every replica: engines never mutate
             # params, and on CPU duplicate weights would be pure waste

@@ -35,6 +35,7 @@ this (prompt, budget) somewhere and wire my guard as on_token".
 from __future__ import annotations
 
 import itertools
+import logging
 import signal
 import threading
 import time
@@ -44,6 +45,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ray_lightning_tpu import observability as _obs
 from ray_lightning_tpu.observability import metrics as _metrics
 from ray_lightning_tpu.serving.scheduler import RequestQueueFull
+
+log = logging.getLogger(__name__)
 
 __all__ = [
     "BREAKER_CLOSED",
@@ -486,7 +489,10 @@ class RequestJournal:
 
 
 def install_sigterm_drain(
-    target: Any, signum: int = signal.SIGTERM
+    target: Any,
+    signum: int = signal.SIGTERM,
+    trainer: Optional[Any] = None,
+    checkpoint_path: Optional[str] = None,
 ) -> Callable[[int, Any], None]:
     """Install a SIGTERM handler that drains ``target`` gracefully.
 
@@ -496,6 +502,18 @@ def install_sigterm_drain(
     handler so tests — and embedders that multiplex signals — can invoke
     it directly. Only callable from the main thread (CPython signal
     rule); replica threads/actors never install their own.
+
+    On a shared reservation the preemption notice covers BOTH workloads:
+    pass the live ``trainer`` (anything with ``save_checkpoint(path,
+    weights_only=...)``) and the handler also flushes a weights-only
+    training checkpoint to ``checkpoint_path`` (default
+    ``rlt_preempt_weights.ckpt`` in the working directory) before
+    returning — the chips can disappear after the drain, so neither the
+    in-flight requests nor the training progress is lost. Weights-only
+    is deliberate: it is the fastest flush that preserves the model, and
+    the resume scanner already refuses to treat it as a full resume
+    point. Checkpoint failures are swallowed (the serving drain already
+    ran; a broken disk must not turn a clean preemption into a crash).
     """
 
     def _handler(_signum: int, _frame: Any) -> None:
@@ -504,6 +522,17 @@ def install_sigterm_drain(
         )
         if drain is not None:
             drain()
+        save = getattr(trainer, "save_checkpoint", None)
+        if save is not None:
+            path = checkpoint_path or "rlt_preempt_weights.ckpt"
+            try:
+                save(path, weights_only=True)
+            except Exception:
+                log.exception(
+                    "preemption drain: weights-only checkpoint flush to "
+                    "%s failed",
+                    path,
+                )
 
     signal.signal(signum, _handler)
     return _handler

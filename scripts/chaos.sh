@@ -20,6 +20,10 @@ echo "== serving resilience (journal recovery, breakers, kill loops) =="
 python -m pytest tests/test_resilience.py -v -m serving_chaos \
     -p no:cacheprovider "$@"
 
+echo "== chip arbitration (borrow/return transfers, incl. kill-loop e2e) =="
+# RLT_CHAOS_KILL_EVERY also tunes the replica-kill cadence under arbitration
+python -m pytest tests/test_arbiter.py -v -m arbiter -p no:cacheprovider "$@"
+
 echo "== legacy relaunch/retry path (slow) =="
 python -m pytest tests/test_cli_and_checkpointing.py -v -m slow \
     -k "retries or relaunch" -p no:cacheprovider "$@"
