@@ -168,6 +168,10 @@ class ChipArbiter:
         self._lock = threading.RLock()
         self._idle_streak = 0
         self._cooldown_until: Optional[float] = None
+        # set when a phase deadline abandons its worker thread: the
+        # side effect can still land later, so subsequent ticks
+        # reconcile the ledger against the handles' ground truth
+        self._suspect_late_effects = False
         self.recovered_action: Optional[str] = None
         os.makedirs(ledger_dir, exist_ok=True)
         if os.path.exists(self.ledger_path):
@@ -229,6 +233,16 @@ class ChipArbiter:
             d
             for d, side in sorted(self._led["owner"].items())
             if side == "serve" and self._led["home"].get(d) == "train"
+        ]
+
+    def _stray_transit(self) -> List[str]:
+        """Train-homed chips parked ``transit`` with no transfer in
+        flight — the residue of a rollback whose regrow failed. They
+        belong to neither side until repatriated."""
+        return [
+            d
+            for d, side in sorted(self._led["owner"].items())
+            if side == "transit" and self._led["home"].get(d) == "train"
         ]
 
     def status(self) -> Dict[str, Any]:
@@ -340,11 +354,15 @@ class ChipArbiter:
             return outcome
 
     def _tick_locked(self, now: float, force: Optional[str]) -> str:
+        if self._suspect_late_effects and self._led["transfer"] is None:
+            self._reconcile_ground_truth()
         state = self.state
+        borrowed = self.borrowed_devices()
+        strays = self._stray_transit()
         in_cooldown = (
             self._cooldown_until is not None and now < self._cooldown_until
         )
-        if state == "steady" and not self.borrowed_devices():
+        if state == "steady" and not borrowed and not strays:
             want = force == "borrow" or (
                 force is None and self._borrow_signal() is not None
             )
@@ -358,17 +376,25 @@ class ChipArbiter:
             if len(train_devs) - self.borrow_count < self.min_train_devices:
                 return "at_floor"
             return self._borrow(now)
-        if state == "lent" or (state == "steady" and self.borrowed_devices()):
+        if state == "lent" or (state == "steady" and (borrowed or strays)):
             if self._serve_idle():
                 self._idle_streak += 1
             else:
                 self._idle_streak = 0
+            # strays are dead capacity — in neither mesh nor fleet — so
+            # they want repatriation regardless of the idle signal
             want = force == "return" or (
-                force is None and self._idle_streak >= self.idle_ticks_return
+                force is None
+                and (
+                    self._idle_streak >= self.idle_ticks_return
+                    or bool(strays and not borrowed)
+                )
             )
             if not want:
                 return "idle"
-            if self._return_vetoed() and force is None:
+            # the veto protects serving capacity; a stray-only return
+            # takes nothing from serving, so it passes
+            if borrowed and self._return_vetoed() and force is None:
                 reg = _obs.registry()
                 if reg is not None:
                     reg.counter(ARBITER_RETURN_VETOED_METRIC).inc()
@@ -397,6 +423,11 @@ class ChipArbiter:
         t.start()
         t.join(self.transition_timeout_s)
         if t.is_alive():
+            # the worker thread is abandoned but its side effect (e.g. a
+            # slow shrink) can still land later — arm the per-tick
+            # ground-truth reconcile so a late landing is detected
+            # instead of silently diverging the ledger
+            self._suspect_late_effects = True
             raise TransferTimeout(
                 f"arbiter phase {label!r} exceeded "
                 f"{self.transition_timeout_s}s"
@@ -404,6 +435,55 @@ class ChipArbiter:
         if "error" in box:
             raise box["error"]
         return box.get("value")
+
+    def _reconcile_ground_truth(self) -> int:
+        """Between transfers, detect side effects that landed AFTER a
+        phase deadline abandoned its thread (a slow ``train.shrink``
+        completing post-timeout): devices that moved sides are adopted,
+        and a train-homed device neither handle claims any more is
+        parked ``transit`` so the stray sweep repatriates it. Returns
+        the number of devices repaired."""
+        try:
+            serve_devs = {
+                str(d): int(i)
+                for d, i in dict(self.serve.devices()).items()
+            }
+            train_devs = {str(d) for d in self.train.devices()}
+        except Exception:
+            log.exception("arbiter reconcile: reading ground truth failed")
+            return 0
+        owner = self._led["owner"]
+        moved = 0
+        for d in list(owner):
+            if d in serve_devs:
+                if owner[d] != "serve":
+                    owner[d] = "serve"
+                    moved += 1
+                self._led["replicas"][d] = serve_devs[d]
+            elif d in train_devs:
+                if owner[d] != "train":
+                    owner[d] = "train"
+                    self._led["replicas"].pop(d, None)
+                    moved += 1
+            elif (
+                owner[d] != "transit"
+                and self._led["home"].get(d) == "train"
+            ):
+                owner[d] = "transit"
+                self._led["replicas"].pop(d, None)
+                moved += 1
+        if moved:
+            self._led["state"] = (
+                "lent" if self.borrowed_devices() else "steady"
+            )
+            self._journal()
+            _obs.event("arbiter_reconciled", moved=moved)
+            log.warning(
+                "arbiter: adopted %d late-landing device move(s) after a "
+                "phase timeout",
+                moved,
+            )
+        return moved
 
     def _fail(self, now: float, direction: str, exc: BaseException) -> None:
         self._led["failures"] = int(self._led["failures"]) + 1
@@ -517,11 +597,17 @@ class ChipArbiter:
         self, freed: Iterable[str], exc: BaseException
     ) -> None:
         """Cancel a failed borrow cleanly back to steady: tear down any
-        replica that did boot, grow training back to full strength."""
+        replica that did boot, grow training back to full strength.
+
+        A replica whose drain fails keeps its device serve-owned with
+        the index mapping intact — the replica may still be live on the
+        chip, so handing the chip back to training would double-assign
+        it; the device counts as borrowed and a later return retries the
+        drain."""
         owner = self._led["owner"]
         back: List[str] = []
         for d in freed:
-            idx = self._led["replicas"].pop(d, None)
+            idx = self._led["replicas"].get(d)
             if idx is not None:
                 try:
                     self._phase(
@@ -532,12 +618,16 @@ class ChipArbiter:
                     log.exception(
                         "arbiter rollback: draining replica %s failed", idx
                     )
+                    continue
+                del self._led["replicas"][d]
+                owner[d] = "transit"  # drained out, not yet regrown
             back.append(d)
         if back:
             try:
                 self._phase(lambda: self.train.grow(back), "rollback-grow")
             except Exception:
-                # chips stay in transit; the recovery path re-adopts them
+                # chips stay in transit; the stray sweep (tick) or the
+                # recovery path (restart) repatriates them
                 log.exception("arbiter rollback: regrow failed")
             else:
                 for d in back:
@@ -573,12 +663,17 @@ class ChipArbiter:
             try:
                 _faults.fire_arbiter_faults(tid, "start")
                 for d in borrowed:
-                    idx = self._led["replicas"].pop(d, None)
+                    # pop the replica mapping only AFTER the drain lands:
+                    # a failed drain must leave the device serve-owned
+                    # with its index intact so the retry drains it again
+                    # instead of regrowing a chip a replica still holds
+                    idx = self._led["replicas"].get(d)
                     if idx is not None:
                         self._phase(
                             lambda idx=idx: self.serve.remove_replica(idx),
                             "drain",
                         )
+                        del self._led["replicas"][d]
                     owner[d] = "transit"
                     drained.append(d)
                     self._journal()
@@ -662,6 +757,13 @@ class ChipArbiter:
                     moved += 1
                 owner[d] = "train"
                 self._led["replicas"].pop(d, None)
+            elif owner[d] != "transit" and self._led["home"].get(d) == "train":
+                # recorded on a side neither handle claims (a drain that
+                # timed out, or a replica the restart lost): park it
+                # transit so the reclaim below sends it home
+                moved += 1
+                owner[d] = "transit"
+                self._led["replicas"].pop(d, None)
         if tr is not None:
             direction = tr["direction"]
             orphans = [d for d in owner if owner[d] == "transit"]
@@ -701,8 +803,14 @@ class ChipArbiter:
             else:
                 action = "rolled_back"
             self._led["transfer"] = None
-        elif moved:
-            action = "adopted"
+        else:
+            # no transfer record, but transit chips can still exist: a
+            # rollback whose regrow failed journals them transit with
+            # transfer=None. They belong to neither side — regrow them
+            # now rather than leaking them across the restart.
+            reclaimed = self._reclaim_strays()
+            if reclaimed or moved:
+                action = "adopted"
         self._led["state"] = "lent" if self.borrowed_devices() else "steady"
         self._journal()
         if action is not None:
@@ -723,6 +831,25 @@ class ChipArbiter:
                 self.state,
             )
         return action
+
+    def _reclaim_strays(self) -> int:
+        """Regrow train-homed ``transit`` chips that no transfer record
+        explains. A failed regrow leaves them transit — the steady-state
+        tick's stray sweep retries through the return path."""
+        strays = self._stray_transit()
+        if not strays:
+            return 0
+        try:
+            self._phase(
+                lambda: self.train.grow(list(strays)), "reclaim-grow"
+            )
+        except Exception:
+            log.exception("arbiter: stray transit regrow failed")
+            return 0
+        owner = self._led["owner"]
+        for d in strays:
+            owner[d] = "train"
+        return len(strays)
 
     # ----------------------------------------------------------------- #
     # gauges
@@ -760,6 +887,11 @@ class FleetServeHandle:
         self.drain_timeout_s = float(drain_timeout_s)
         self.drain_poll_s = float(drain_poll_s)
         self._by_device: Dict[str, int] = {}
+        # replica indices whose capacity grant is already revoked (a
+        # drain timeout settles the books before raising; the retry must
+        # not revoke twice). Fleet scale-up indices are never reused, so
+        # membership is permanent.
+        self._settled: set = set()
 
     def devices(self) -> Dict[str, int]:
         return dict(self._by_device)
@@ -776,14 +908,36 @@ class FleetServeHandle:
 
     def remove_replica(self, index: int) -> None:
         if not self.fleet.preempt_replica(index):
-            raise RuntimeError(f"replica {index} not routable; cannot drain")
+            if index not in getattr(self.fleet, "_draining", {}):
+                if index in self._settled:
+                    # an earlier attempt timed out, settled the books,
+                    # and the drain has since finished: nothing left
+                    return
+                raise RuntimeError(
+                    f"replica {index} not routable; cannot drain"
+                )
+            # an earlier timed-out attempt left the drain in flight:
+            # fall through and wait for it again
         deadline = time.monotonic() + self.drain_timeout_s
         while index in getattr(self.fleet, "_draining", {}):
             if time.monotonic() > deadline:
+                # the replica has irrevocably left routing; even with
+                # the drain still settling, its grant and device slot
+                # must not stay counted or the autoscaler can place one
+                # more replica than the fleet has devices for
+                self._settle(index)
                 raise TransferTimeout(
                     f"replica {index} drain exceeded {self.drain_timeout_s}s"
                 )
             time.sleep(self.drain_poll_s)
+        self._settle(index)
+
+    def _settle(self, index: int) -> None:
+        """Revoke the capacity grant and drop the device mapping exactly
+        once per removed replica, however many attempts it took."""
+        if index in self._settled:
+            return
+        self._settled.add(index)
         self.fleet.revoke_capacity(1)
         for d, i in list(self._by_device.items()):
             if i == index:

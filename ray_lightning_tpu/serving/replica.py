@@ -199,7 +199,8 @@ class Autoscaler:
         self.scale_downs = 0
         # scale-up verdicts the fleet could not satisfy (no free device):
         # the explicit capacity_blocked outcome the ChipArbiter reads as
-        # a borrow signal. The streak resets on any successful add.
+        # a borrow signal. The streak resets on any successful add and
+        # whenever the verdict stops asking for more capacity.
         self.capacity_blocked_total = 0
         self.capacity_blocked_streak = 0
         self.last_outcome: Optional[str] = None
@@ -222,6 +223,13 @@ class Autoscaler:
             ttft_high_ms=self.ttft_high_ms,
             slo_breached=slo_breached,
         )
+        if delta <= 0:
+            # the scale-up pressure is gone: clear any capacity_blocked
+            # streak so the arbiter's borrow signal reflects current
+            # demand, not a burst that already subsided (a stale streak
+            # would re-borrow a chip serving no longer needs right after
+            # every idle-driven return — a borrow/return thrash loop)
+            self.capacity_blocked_streak = 0
         if delta < 0:
             self._idle_streak += 1
             if self._idle_streak < self.idle_ticks_down:

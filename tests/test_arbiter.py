@@ -32,6 +32,7 @@ from ray_lightning_tpu.runtime.arbiter import (
     ChipArbiter,
     FleetServeHandle,
     LedgerInvariantError,
+    TransferTimeout,
     read_ledger,
 )
 from ray_lightning_tpu.serving import (
@@ -483,6 +484,175 @@ def test_transition_deadline_times_out_a_stuck_shrink(tmp_path):
     assert read_ledger(arb.ledger_dir)["failures"] == 1
 
 
+def test_failed_drain_is_retried_not_skipped(tmp_path):
+    """A return whose drain fails must leave the device serve-owned with
+    its replica index intact, so the retried return drains it again —
+    never regrow a chip a live replica may still hold."""
+
+    class FlakyDrainServe(FakeServe):
+        def __init__(self):
+            super().__init__()
+            self.drain_failures_left = 1
+
+        def remove_replica(self, index):
+            if self.drain_failures_left > 0:
+                self.drain_failures_left -= 1
+                raise RuntimeError("drain wedged")
+            super().remove_replica(index)
+
+    clock = [0.0]
+    train, serve = FakeTrain(["t0", "t1"]), FlakyDrainServe()
+    arb = _arbiter(
+        tmp_path, train, serve, backoff_base_s=1.0, clock=lambda: clock[0]
+    )
+    arb.request_transfer("borrow")
+    assert arb.tick() == "borrowed"
+    arb.request_transfer("return")
+    assert arb.tick() == "rolled_back"
+    led = read_ledger(arb.ledger_dir)
+    assert led["owner"]["t1"] == "serve"
+    assert led["replicas"]["t1"] == 0  # the mapping survived the failure
+    assert "t1" in serve.devices() and "t1" not in train.devices()
+    assert arb.state == "lent"
+
+    clock[0] = 10.0
+    arb.request_transfer("return")
+    assert arb.tick() == "returned"
+    assert serve.devices() == {} and set(train.devices()) == {"t0", "t1"}
+    _assert_no_leaks(arb, train, serve, ["t0", "t1"])
+
+
+def test_rollback_drain_failure_keeps_booted_replica_lent(tmp_path):
+    """A borrow whose second spawn fails rolls back; if draining the
+    first (already booted) replica also fails, that chip must stay
+    serve-owned — the replica may still be live on it, so regrowing it
+    into training would double-assign the device."""
+
+    class Serve(FakeServe):
+        def __init__(self):
+            super().__init__()
+            self.fail_drain = True
+
+        def add_replica(self, device):
+            if self._next >= 1:
+                raise RuntimeError("second boot failed")
+            return super().add_replica(device)
+
+        def remove_replica(self, index):
+            if self.fail_drain:
+                raise RuntimeError("drain wedged")
+            super().remove_replica(index)
+
+    train, serve = FakeTrain(["t0", "t1", "t2"]), Serve()
+    arb = _arbiter(tmp_path, train, serve, borrow_count=2)
+    arb.request_transfer("borrow")
+    assert arb.tick() == "rolled_back"
+    led = read_ledger(arb.ledger_dir)
+    # shrink freed t2 then t1; t2 booted replica 0, t1's spawn failed
+    assert led["owner"]["t2"] == "serve" and led["replicas"]["t2"] == 0
+    assert led["owner"]["t1"] == "train"  # the unbooted chip regrew
+    assert arb.state == "lent" and serve.devices() == {"t2": 0}
+
+    # once the drain works again, a return repatriates the stranded chip
+    serve.fail_drain = False
+    arb.request_transfer("return")
+    assert arb.tick() == "returned"
+    assert serve.devices() == {}
+    assert set(train.devices()) == {"t0", "t1", "t2"}
+    _assert_no_leaks(arb, train, serve, ["t0", "t1", "t2"])
+
+
+class GrowFailTrain(FakeTrain):
+    """Train handle whose grow can be wedged, stranding chips transit."""
+
+    def __init__(self, devs):
+        super().__init__(devs)
+        self.fail_grow = False
+
+    def grow(self, devices):
+        if self.fail_grow:
+            raise RuntimeError("mesh wedged")
+        super().grow(devices)
+
+
+def _strand_transit_chip(tmp_path, clock):
+    """Drive a borrow whose spawn AND rollback regrow both fail: t1 ends
+    journaled ``transit`` with ``transfer=None`` — owned by neither
+    side."""
+    train, serve = GrowFailTrain(["t0", "t1"]), FakeServe()
+    serve.spawn_error = RuntimeError("no replica for you")
+    arb = _arbiter(
+        tmp_path, train, serve, backoff_base_s=1.0, clock=lambda: clock[0]
+    )
+    train.fail_grow = True
+    arb.request_transfer("borrow")
+    assert arb.tick() == "rolled_back"
+    led = read_ledger(arb.ledger_dir)
+    assert led["owner"]["t1"] == "transit" and led["transfer"] is None
+    assert "t1" not in train.devices() and "t1" not in serve.devices()
+    train.fail_grow = False
+    serve.spawn_error = None
+    return arb, train, serve
+
+
+def test_stray_transit_chips_reclaimed_by_tick(tmp_path):
+    """Chips stranded transit by a failed rollback regrow must not leak:
+    the steady-state tick sweeps them back into the mesh (no force file,
+    no restart needed) once the backoff expires."""
+    clock = [0.0]
+    arb, train, serve = _strand_transit_chip(tmp_path, clock)
+    clock[0] = 10.0  # past the failure backoff
+    assert arb.tick() == "returned"
+    assert arb.state == "steady"
+    assert set(train.devices()) == {"t0", "t1"}
+    _assert_no_leaks(arb, train, serve, ["t0", "t1"])
+
+
+def test_stray_transit_chips_reclaimed_on_restart(tmp_path):
+    """Restart recovery regrows stranded transit chips even though the
+    ledger has no transfer record explaining them."""
+    clock = [0.0]
+    arb, train, serve = _strand_transit_chip(tmp_path, clock)
+    arb2 = ChipArbiter(arb.ledger_dir, train, serve)
+    assert arb2.recovered_action == "adopted"
+    assert arb2.state == "steady"
+    assert set(train.devices()) == {"t0", "t1"}
+    _assert_no_leaks(arb2, train, serve, ["t0", "t1"])
+
+
+def test_late_landing_shrink_is_reconciled_after_timeout(tmp_path):
+    """A shrink that completes AFTER its phase deadline still frees the
+    chip behind the arbiter's back. The post-timeout ground-truth
+    reconcile must catch the late landing and repatriate the chip
+    instead of silently leaking it with owner still 'train'."""
+
+    class SlowTrain(FakeTrain):
+        def shrink(self, count):
+            time.sleep(0.2)
+            return super().shrink(count)
+
+    clock = [0.0]
+    train, serve = SlowTrain(["t0", "t1"]), FakeServe()
+    arb = _arbiter(
+        tmp_path,
+        train,
+        serve,
+        transition_timeout_s=0.05,
+        backoff_base_s=0.01,
+        clock=lambda: clock[0],
+    )
+    arb.request_transfer("borrow")
+    assert arb.tick() == "rolled_back"  # deadline fired; freed looked empty
+    time.sleep(0.4)  # the abandoned shrink lands: t1 leaves the mesh
+    assert "t1" not in train.devices()
+    assert read_ledger(arb.ledger_dir)["owner"]["t1"] == "train"  # diverged
+
+    clock[0] = 10.0
+    assert arb.tick() == "returned"  # reconcile -> stray -> regrown
+    assert set(train.devices()) == {"t0", "t1"}
+    _assert_no_leaks(arb, train, serve, ["t0", "t1"])
+
+
 # --------------------------------------------------------------------- #
 # crash-consistency: ledger recovery on arbiter restart
 # --------------------------------------------------------------------- #
@@ -615,6 +785,22 @@ def test_autoscaler_reports_capacity_blocked_and_resets_on_success():
     assert asc.last_outcome == "scale_up"
 
 
+def test_capacity_blocked_streak_clears_when_demand_subsides():
+    """A stale streak would make the arbiter re-borrow a chip right
+    after every idle-driven return (borrow/return thrash bounded only by
+    cooldown): once the verdict stops asking for capacity, the borrow
+    signal must clear."""
+    fleet = _BlockedFleet()
+    asc = Autoscaler(fleet, min_replicas=1, max_replicas=4, queue_high=4.0)
+    assert asc.tick(now=0.0) == 0
+    assert asc.capacity_blocked_streak == 1
+    # the burst passes: the queue empties and no scale-up is wanted
+    fleet.loads = lambda: {0: {"queue_depth": 0.0, "active": 0.0}}
+    assert asc.tick(now=1.0) == 0
+    assert asc.capacity_blocked_streak == 0
+    assert asc.capacity_blocked_total == 1  # the counter keeps history
+
+
 def test_fleet_capacity_blocks_scale_up_until_granted(model):
     params, cfg = model
     fleet = LocalReplicaFleet(
@@ -678,6 +864,65 @@ def test_fleet_serve_handle_grants_and_revokes_capacity():
     with pytest.raises(RuntimeError):
         handle.add_replica("c4")
     assert fleet.capacity == 1 and handle.devices() == {}
+
+
+def test_fleet_serve_handle_drain_timeout_settles_books_once():
+    """A drain timeout removed the replica from routing irrevocably: the
+    grant and device slot must be released anyway (or fleet capacity
+    stays inflated by one and the autoscaler over-places), exactly once
+    across however many retries, and the retried removal converges once
+    the drain finally lands."""
+
+    class _Fleet:
+        def __init__(self):
+            self.capacity = 2
+            self._replicas = {}
+            self._draining = {}
+            self._next = 0
+
+        def grant_capacity(self, n=1):
+            self.capacity += n
+
+        def revoke_capacity(self, n=1):
+            self.capacity = max(1, self.capacity - n)
+
+        def add_replica(self):
+            idx = self._next
+            self._next += 1
+            self._replicas[idx] = object()
+            return idx
+
+        def preempt_replica(self, index):
+            engine = self._replicas.pop(index, None)
+            if engine is None:
+                return False
+            self._draining[index] = engine
+            return True
+
+        def loads(self):
+            return {}
+
+    fleet = _Fleet()
+    handle = FleetServeHandle(fleet, drain_timeout_s=0.05, drain_poll_s=0.01)
+    assert handle.add_replica("c0") == 0
+    assert fleet.capacity == 3
+    # the drain never settles: grant revoked, device slot freed, raise
+    with pytest.raises(TransferTimeout):
+        handle.remove_replica(0)
+    assert fleet.capacity == 2 and handle.devices() == {}
+    # retry while the drain is still in flight: times out again but
+    # never double-revokes
+    with pytest.raises(TransferTimeout):
+        handle.remove_replica(0)
+    assert fleet.capacity == 2
+    # the drain finally lands: the retried removal converges cleanly
+    del fleet._draining[0]
+    handle.remove_replica(0)
+    assert fleet.capacity == 2
+    # a replica that never existed is still an error, never a revoke
+    with pytest.raises(RuntimeError):
+        handle.remove_replica(99)
+    assert fleet.capacity == 2
 
 
 # --------------------------------------------------------------------- #
