@@ -1247,6 +1247,94 @@ def _attach_arbitration_sweep(result: dict, here: str, env: dict) -> None:
         }
 
 
+def _goodput_sweep(args) -> int:
+    """Child: the goodput ledger sweep (--_goodput_sweep).
+
+    Runs a tiny in-process CPU fit with telemetry enabled and reports the
+    wall-time goodput breakdown the observability layer folded into
+    ``summary.json`` — so every bench round carries a goodput fraction
+    alongside the throughput number, and a regression that shifts wall
+    time from productive_compute into input_wait/idle is visible even
+    when tokens/s barely moves. Reported as detail.goodput."""
+    import tempfile as _tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import optax
+
+    import ray_lightning_tpu as rlt
+    from ray_lightning_tpu.observability.aggregator import _read_summary
+
+    class _GoodputModel(rlt.LightningModule):
+        def __init__(self):
+            super().__init__()
+            self.model = nn.Dense(2)
+            self.example_input_array = jnp.zeros((1, 32), jnp.float32)
+
+        def training_step(self, params, batch, batch_idx):
+            return jnp.mean(self.model.apply(params, batch) ** 2)
+
+        def configure_optimizers(self):
+            return optax.sgd(0.1)
+
+        def train_dataloader(self):
+            return rlt.DataLoader(
+                rlt.RandomDataset(32, 64), batch_size=8, drop_last=True
+            )
+
+    root = _tempfile.mkdtemp(prefix="rlt-goodput-sweep-")
+    os.environ.pop("RLT_TELEMETRY_DIR", None)  # keep the dump under root
+    trainer = rlt.Trainer(
+        default_root_dir=root,
+        max_epochs=1,
+        limit_train_batches=6,
+        strategy=rlt.XLAStrategy(devices=1, telemetry=True),
+        enable_progress_bar=False,
+        enable_checkpointing=False,
+        logger=False,
+    )
+    trainer.fit(_GoodputModel())
+    summary = _read_summary(os.path.join(root, "telemetry"))
+    gp = (summary or {}).get("goodput")
+    if not gp:
+        print(json.dumps({"error": "fit produced no goodput summary"}))
+        return 1
+    print(json.dumps({
+        "platform": "cpu",
+        "fraction": gp.get("fraction"),
+        "total_s": gp.get("total_s"),
+        "by_category": gp.get("by_category", {}),
+    }))
+    return 0
+
+
+def _attach_goodput_sweep(result: dict, here: str, env: dict) -> None:
+    """Attach detail.goodput (wall-time category breakdown + fraction
+    from a tiny telemetry-enabled CPU fit). RLT_BENCH_GOODPUT_SWEEP=0
+    disables."""
+    if os.environ.get("RLT_BENCH_GOODPUT_SWEEP", "1") == "0":
+        return
+    sweep_env = dict(env)
+    sweep_env["JAX_PLATFORMS"] = "cpu"
+    ok, sweep, serr = _run(
+        [sys.executable, here, "--_goodput_sweep"],
+        _env_timeout("RLT_BENCH_GOODPUT_TIMEOUT", 300.0),
+        sweep_env,
+    )
+    detail = result.setdefault("detail", {})
+    if ok and isinstance(sweep, dict) and "fraction" in sweep:
+        detail["goodput"] = sweep
+    else:
+        detail["goodput"] = {
+            "error": (sweep or {}).get("error")
+            or serr
+            or "sweep produced no JSON"
+        }
+
+
 def _last_json_dict(stdout: str):
     for line in reversed((stdout or "").strip().splitlines()):
         try:
@@ -1256,6 +1344,12 @@ def _last_json_dict(stdout: str):
         if isinstance(parsed, dict):
             return parsed
     return None
+
+
+# Tail of the most recent child's output (stderr then stdout), kept for
+# the incident bundle when a probe failure follows — the child is gone by
+# then and its temp files with it.
+_LAST_RUN_TAIL = ""
 
 
 def _run(cmd: list, timeout: float, env: dict) -> tuple:
@@ -1268,6 +1362,8 @@ def _run(cmd: list, timeout: float, env: dict) -> tuple:
     """
     import signal
     import tempfile
+
+    global _LAST_RUN_TAIL
 
     with tempfile.TemporaryFile(mode="w+") as out_f, \
             tempfile.TemporaryFile(mode="w+") as err_f:
@@ -1292,6 +1388,9 @@ def _run(cmd: list, timeout: float, env: dict) -> tuple:
         stdout = out_f.read()
         err_f.seek(0)
         stderr = err_f.read()
+    _LAST_RUN_TAIL = "\n".join(
+        ((stderr or "") + "\n" + (stdout or "")).strip().splitlines()[-50:]
+    )
     result = _last_json_dict(stdout)
     if timed_out:
         return False, None, f"timeout after {timeout:.0f}s"
@@ -1305,6 +1404,23 @@ def _run(cmd: list, timeout: float, env: dict) -> tuple:
     if result is None:
         return False, None, "child produced no JSON"
     return True, result, None
+
+
+def _record_probe_incident(error: str) -> None:
+    """Surface a failed native probe as a first-class incident: a
+    ``bench_probe_failed`` flight-record event, the
+    ``rlt_bench_probe_failures_total`` counter, and an incident bundle
+    carrying the probe child's log tail. Telemetry trouble must never
+    take down the bench, so every failure here is swallowed."""
+    try:
+        from ray_lightning_tpu.observability import aggregator as _aggregator
+        from ray_lightning_tpu.observability import incidents as _incidents
+
+        _incidents.record_probe_failure(
+            _aggregator.telemetry_dir(), str(error), _LAST_RUN_TAIL
+        )
+    except Exception:
+        pass
 
 
 def _fail_result(detail: dict) -> dict:
@@ -1509,6 +1625,7 @@ def main() -> int:
     parser.add_argument("--_serve_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_compile_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_arbitration_sweep", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--_goodput_sweep", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
 
     if args._probe:
@@ -1525,6 +1642,8 @@ def main() -> int:
         return _compile_sweep(args)
     if args._arbitration_sweep:
         return _arbitration_sweep(args)
+    if args._goodput_sweep:
+        return _goodput_sweep(args)
 
     probe_timeout = _env_timeout("RLT_BENCH_PROBE_TIMEOUT", 600.0)
     bench_timeout = _env_timeout("RLT_BENCH_TIMEOUT", 1800.0)
@@ -1620,6 +1739,7 @@ def main() -> int:
                     _attach_serve_sweep(result, here, env)
                     _attach_compile_sweep(result, here, env)
                     _attach_arbitration_sweep(result, here, env)
+                    _attach_goodput_sweep(result, here, env)
                     if _is_on_chip(result):
                         _save_tpu_cache(result, _args_key(args))
                     print(json.dumps(result))
@@ -1632,6 +1752,7 @@ def main() -> int:
             else:
                 error = f"native backend probe failed ({perr})"
                 _save_probe_verdict(perr)
+                _record_probe_incident(perr)
         # a real measurement captured earlier in the round beats any
         # fallback: the tunnel wedges for long stretches, and losing a
         # number that was already taken on silicon forfeits the perf axis.
@@ -1670,6 +1791,7 @@ def main() -> int:
         _attach_serve_sweep(result, here, env)
         _attach_compile_sweep(result, here, env)
         _attach_arbitration_sweep(result, here, env)
+        _attach_goodput_sweep(result, here, env)
     if error:
         result.setdefault("detail", {})["error"] = error
     print(json.dumps(result))

@@ -507,6 +507,113 @@ def _cmd_arbiter(args, parser) -> int:
     return 2
 
 
+def _cmd_goodput(args) -> int:
+    """Render the fleet goodput section folded into ``summary.json`` by
+    the driver aggregator: fraction, per-category seconds, and the
+    per-source breakdown (docs/observability.md, "Goodput")."""
+    import json
+
+    from ray_lightning_tpu.observability.aggregator import _read_summary
+
+    summary = _read_summary(args.dir)
+    gp = (summary or {}).get("goodput")
+    if not gp:
+        print(
+            f"no goodput section in the summary under {args.dir} "
+            "(needs a run with RLT_TELEMETRY=1 that has reported beats)"
+        )
+        return 1
+    if args.json:
+        print(json.dumps(gp, indent=2, sort_keys=True))
+        return 0
+    total = float(gp.get("total_s") or 0.0)
+    print(
+        f"goodput fraction: {gp.get('fraction', 0.0):.4f}  "
+        f"({total:.1f}s classified wall time across sources)"
+    )
+    print(f"{'category':<22}{'seconds':>12}{'share':>9}")
+    for cat, secs in sorted(
+        gp.get("by_category", {}).items(), key=lambda kv: -kv[1]
+    ):
+        share = (secs / total) if total > 0 else 0.0
+        print(f"{cat:<22}{secs:>12.3f}{share:>9.1%}")
+    per = gp.get("per_rank", {})
+    if per:
+        print()
+        print(f"{'source':<18}{'wall(s)':>10}{'fraction':>10}  top categories")
+        for key, info in sorted(per.items()):
+            cats = sorted(
+                (info.get("seconds") or {}).items(), key=lambda kv: -kv[1]
+            )[:3]
+            tops = ", ".join(f"{c} {s:.1f}s" for c, s in cats)
+            print(
+                f"{key:<18}{info.get('wall_s', 0.0):>10.1f}"
+                f"{info.get('fraction', 0.0):>10.4f}  {tops}"
+            )
+    return 0
+
+
+def _cmd_incidents(args) -> int:
+    """List incident bundles under ``<dir>/incidents/``, or render one
+    bundle's contents with ``--show``."""
+    import json
+    import os
+    import time as _time
+
+    from ray_lightning_tpu.observability import incidents as _incidents
+
+    bundles = _incidents.list_bundles(args.dir)
+    if args.show is not None:
+        match = [b for b in bundles if b["name"] == args.show]
+        if not match:
+            print(f"no incident bundle named {args.show!r} under {args.dir}")
+            return 1
+        detail = _incidents.load_bundle(match[0]["path"])
+        if args.json:
+            print(json.dumps(detail, indent=2, sort_keys=True))
+            return 0
+        meta = detail.get("incident", {})
+        ts = meta.get("ts")
+        when = (
+            _time.strftime("%Y-%m-%d %H:%M:%S", _time.localtime(ts))
+            if ts
+            else "-"
+        )
+        print(f"bundle:  {match[0]['name']}")
+        print(f"kind:    {meta.get('kind', '-')}")
+        print(f"time:    {when}")
+        ev = meta.get("event")
+        if ev:
+            print(f"trigger: {json.dumps(ev, sort_keys=True)}")
+        print("files:")
+        for name, info in sorted(detail.get("files", {}).items()):
+            bits = ", ".join(f"{k}={v}" for k, v in sorted(info.items()))
+            print(f"  {name:<24} {bits}")
+        return 0
+    if not bundles:
+        print(
+            "no incident bundles under "
+            f"{os.path.join(args.dir, _incidents.INCIDENTS_DIRNAME)}"
+        )
+        return 1
+    if args.json:
+        for b in bundles:
+            print(json.dumps(b, sort_keys=True))
+        return 0
+    print(f"{'time':<20}{'kind':<24}{'files':>6}  name")
+    for b in bundles:
+        when = (
+            _time.strftime("%Y-%m-%d %H:%M:%S", _time.localtime(b["ts"]))
+            if b.get("ts")
+            else "-"
+        )
+        print(
+            f"{when:<20}{b.get('kind', '-'):<24}"
+            f"{len(b.get('files', [])):>6}  {b['name']}"
+        )
+    return 0
+
+
 def main(argv: Optional[list] = None) -> int:
     """``rlt``-style tool dispatch: ``top`` — live view of a run's
     telemetry directory (summary.json + events.jsonl, written by the
@@ -531,6 +638,47 @@ def main(argv: Optional[list] = None) -> int:
     )
     top.add_argument(
         "--interval", type=float, default=2.0, help="refresh period seconds"
+    )
+    top.add_argument(
+        "--serve-port",
+        type=int,
+        default=None,
+        help="also expose the run's metrics.prom at "
+        "http://127.0.0.1:PORT/metrics for Prometheus scraping (0 picks "
+        "an ephemeral port; see also RLT_PROM_PORT for the in-driver "
+        "endpoint)",
+    )
+    goodput_p = sub.add_parser(
+        "goodput",
+        help="wall-time goodput breakdown (category seconds + fraction) "
+        "from a run's telemetry directory",
+    )
+    goodput_p.add_argument(
+        "--dir",
+        required=True,
+        help="telemetry directory (e.g. <default_root_dir>/telemetry)",
+    )
+    goodput_p.add_argument(
+        "--json", action="store_true", help="emit the raw goodput section"
+    )
+    incidents_p = sub.add_parser(
+        "incidents",
+        help="list or inspect black-box incident bundles captured under "
+        "<telemetry>/incidents/",
+    )
+    incidents_p.add_argument(
+        "--dir",
+        required=True,
+        help="telemetry directory (e.g. <default_root_dir>/telemetry)",
+    )
+    incidents_p.add_argument(
+        "--show",
+        default=None,
+        metavar="BUNDLE",
+        help="inspect one bundle by directory name instead of listing",
+    )
+    incidents_p.add_argument(
+        "--json", action="store_true", help="emit JSON instead of a table"
     )
     serve = sub.add_parser(
         "serve",
@@ -694,7 +842,16 @@ def main(argv: Optional[list] = None) -> int:
     if args.command == "top":
         from ray_lightning_tpu.observability.aggregator import render_top
 
-        return render_top(args.dir, follow=args.follow, interval=args.interval)
+        return render_top(
+            args.dir,
+            follow=args.follow,
+            interval=args.interval,
+            serve_port=args.serve_port,
+        )
+    if args.command == "goodput":
+        return _cmd_goodput(args)
+    if args.command == "incidents":
+        return _cmd_incidents(args)
     if args.command == "serve":
         return _cmd_serve(args)
     if args.command == "profile":

@@ -20,6 +20,7 @@ synchronization happens only at logger flush points and epoch boundaries.
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from dataclasses import dataclass, field
@@ -239,6 +240,7 @@ class Trainer:
         # flight recorder handle: None when telemetry is off, so every
         # instrumented hot path reduces to one attribute check (`if rec`)
         self._obs = None
+        self._goodput = None
         self._profiler = None
         self._first_step_dispatched = False
         self._restored_ckpt: Optional[Dict[str, Any]] = None
@@ -858,6 +860,11 @@ class Trainer:
         if getattr(self.strategy, "telemetry", False):
             obs.enable()
         self._obs = obs.get_recorder()
+        # goodput wall-time ledger: every second of this fit classified
+        # into a category; published on each heartbeat (collect_beat_payload)
+        self._goodput = (
+            obs.goodput.new_ledger("train") if self._obs is not None else None
+        )
         self._first_step_dispatched = False
         self._step_log_buffer = []
         self._input_prefetcher = None
@@ -1056,6 +1063,8 @@ class Trainer:
             # settle both before the logger closes. The drain reads device
             # arrays — a collective failure can leave them unreadable, and
             # that must not mask the original error
+            if self._goodput is not None:
+                self._goodput.enter("drain")
             try:
                 self._drain_step_logs()
             except Exception:
@@ -1300,6 +1309,12 @@ class Trainer:
 
         agent = self._elastic_agent
         _t_wall, _t0 = time.time(), time.perf_counter()
+        if self._goodput is not None:
+            # planned resizes are elastic transitions; an exception-driven
+            # one is unplanned fault recovery
+            self._goodput.enter(
+                "fault_recovery" if err is not None else "elastic_transition"
+            )
         my_rank = cmd.rank_of(agent.boot_id)
         if my_rank is None:  # evicted while transitioning: not our group
             raise _elastic.MembershipChanged(cmd)
@@ -1486,14 +1501,25 @@ class Trainer:
         # which is only non-None when telemetry or a profile env is armed)
         rec = self._obs
         prof = self._profiler
+        led = self._goodput
         step_hist = (
             obs.metrics.get_registry().histogram("rlt_step_time_seconds")
             if rec is not None
             else None
         )
+        # the time between loop iterations is the prefetch generator
+        # pulling the next batch: input wait until the body reclassifies
+        if led is not None:
+            led.enter("input_wait")
         for batch_idx, batch, device_batch in self._prefetch_shard(
             train_loader, limit_train
         ):
+            if led is not None:
+                led.enter(
+                    "productive_compute"
+                    if self._first_step_dispatched
+                    else "compile"
+                )
             if rec is not None or prof is not None:
                 _it_wall, _it_t0 = time.time(), time.perf_counter()
                 if prof is not None:
@@ -1567,6 +1593,8 @@ class Trainer:
             ):
                 self._run_validation(val_loader, val_step)
 
+            if led is not None:
+                led.enter("input_wait")
             if 0 <= self.max_steps <= self.global_step:
                 self.should_stop = True
                 break
@@ -1579,6 +1607,8 @@ class Trainer:
         # the epoch's input-pipeline stats into the run totals (the
         # prefetcher itself is dropped — it holds the recorder and a bound
         # shard_fn, neither of which should ride a trainer pickle)
+        if led is not None:
+            led.enter("idle")
         self._drain_step_logs()
         if self._input_prefetcher is not None:
             self._input_stats["starved_s"] += self._input_prefetcher.starved_s
@@ -1681,7 +1711,13 @@ class Trainer:
         # validation is a logger flush point: deferred step rows land
         # before the val rows so the CSV stays step-ordered
         self._drain_step_logs()
-        with obs.span("validate", step=self.global_step):
+        led = self._goodput
+        ctx = (
+            led.phase("productive_compute")
+            if led is not None
+            else contextlib.nullcontext()
+        )
+        with ctx, obs.span("validate", step=self.global_step):
             self._hook("on_validation_epoch_start")
             self._cb("on_validation_start")
             metrics = self._run_eval_epoch(
@@ -1876,7 +1912,12 @@ class Trainer:
         return ckpt
 
     def save_checkpoint(self, filepath: str, weights_only: bool = False) -> None:
-        with obs.span("checkpoint/save", step=self.global_step, path=filepath):
+        led = self._goodput
+        ctx = (
+            led.phase("checkpoint") if led is not None
+            else contextlib.nullcontext()
+        )
+        with ctx, obs.span("checkpoint/save", step=self.global_step, path=filepath):
             ckpt = self.dump_checkpoint(weights_only)
             filepath = os.path.abspath(filepath)
             os.makedirs(os.path.dirname(filepath), exist_ok=True)

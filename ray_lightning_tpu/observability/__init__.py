@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from . import metrics, profiler, reqtrace, slo, trace
+from . import goodput, metrics, profiler, reqtrace, slo, trace
 from .trace import (  # noqa: F401  (re-exported API)
     DRIVER,
     NOOP_SPAN,
@@ -46,6 +46,7 @@ __all__ = [
     "estimate_skew",
     "event",
     "get_recorder",
+    "goodput",
     "maybe_enable_from_env",
     "merge_traces",
     "metrics",
@@ -85,6 +86,9 @@ def collect_beat_payload(final: bool = False) -> Optional[Dict[str, Any]]:
         return {"p": prof} if prof else None
     events = rec.drain()
     reg = metrics.get_registry()
+    # goodput ledgers publish just-in-time so the wall-time counters on
+    # this beat are current up to this instant
+    goodput.publish_all(reg)
     snap = reg.snapshot(delta=not final)
     if not final and not events and not prof and reg.is_empty_snapshot(snap):
         return None
@@ -108,3 +112,4 @@ def reset() -> None:
     trace.disable()
     metrics.reset_registry()
     profiler.reset_pending()
+    goodput.reset()

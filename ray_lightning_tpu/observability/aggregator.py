@@ -29,6 +29,9 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from . import anomaly as _anomaly
+from . import goodput as _goodput
+from . import incidents as _incidents
 from . import metrics as _metrics
 from . import reqtrace as _reqtrace
 from . import trace as _trace
@@ -48,6 +51,15 @@ MAX_SKEW_SAMPLES = 512
 MAX_STEP_SAMPLES = 8192
 
 STEP_TIME_METRIC = "rlt_step_time_seconds"
+ITL_METRIC = "rlt_serve_itl_seconds"
+
+# Event kinds that *explain* a goodput drop — their recency arms the
+# silent-degradation detector's quiet gate.
+FAULT_EVENT_KINDS = frozenset({
+    "crash", "hang", "straggler", "slo_breach", "elastic_shrink",
+    "elastic_grow", "elastic_grow_failed", "arbiter_rollback",
+    "arbiter_transfer", "serve_replica_drain",
+})
 
 
 def telemetry_dir(default_root_dir: Optional[str] = None) -> str:
@@ -125,7 +137,29 @@ class DriverAggregator:
         self._summary_interval = float(summary_interval)
         self._summary_written = 0.0
         self._finalized = False
+        # goodput fold: rank -> src -> {category: cumulative seconds}
+        self._goodput: Dict[Any, Dict[str, Dict[str, float]]] = {}
+        self._last_fault_ts: Optional[float] = None
+        self.anomaly = _anomaly.AnomalyMonitor() if self.full else None
+        self.incidents = _incidents.IncidentRecorder(
+            run_dir,
+            registry=self.registry,
+            events_path=self._events.path,
+            trace_provider=self._trace_slice,
+        )
         os.makedirs(run_dir, exist_ok=True)
+        self._prom: Optional[_metrics.PromServer] = None
+        port = _metrics.prom_port_from_env()
+        if port is not None and self.full:
+            try:
+                self._prom = _metrics.PromServer(
+                    self.registry.prometheus_text, port
+                )
+                bound = self._prom.start()
+                self.record_event("prom_endpoint", port=bound)
+            except OSError as e:
+                self._prom = None
+                self.record_event("prom_endpoint_failed", error=str(e))
 
     # ----------------------------------------------------------------- #
     # ingestion (called from the supervisor thread)
@@ -182,11 +216,25 @@ class DriverAggregator:
             for name, labels, value in snap.get("counters", ()):
                 if not labels:
                     gauges[name] = value
+                elif name == _goodput.GOODPUT_SECONDS_METRIC:
+                    d = dict(labels)
+                    cat = d.get("category")
+                    if cat:
+                        self._goodput.setdefault(rank, {}).setdefault(
+                            d.get("src", "train"), {}
+                        )[cat] = value
             for name, labels, h in snap.get("histograms", ()):
                 if name == STEP_TIME_METRIC:
+                    samples = h.get("samples", ())
                     self._step_samples.setdefault(
                         rank, deque(maxlen=MAX_STEP_SAMPLES)
-                    ).extend(h.get("samples", ()))
+                    ).extend(samples)
+                    if self.anomaly is not None:
+                        for v in samples:
+                            self.anomaly.observe_step(rank, v)
+                elif name == ITL_METRIC and self.anomaly is not None:
+                    for v in h.get("samples", ()):
+                        self.anomaly.observe_itl(v)
             if self.slo is not None:
                 self._feed_slo(rank, snap)
 
@@ -238,6 +286,9 @@ class DriverAggregator:
         self._slo_counter_last = {
             k: v for k, v in self._slo_counter_last.items() if k[0] != rank
         }
+        self._goodput.pop(rank, None)
+        if self.anomaly is not None:
+            self.anomaly.drop_rank(rank)
         self.registry.drop_series(rank=rank)
         self.record_event("rank_dropped", rank=rank)
 
@@ -319,6 +370,12 @@ class DriverAggregator:
         self._events.write(line)
         _trace.event(f"verdict/{kind}" if kind in (
             "crash", "hang", "straggler") else kind, **fields)
+        if kind in FAULT_EVENT_KINDS:
+            self._last_fault_ts = line["ts"]
+        if kind in _incidents.INCIDENT_EVENT_KINDS:
+            # the triggering line is already flushed, so the bundle's
+            # event window covers its own cause
+            self.incidents.maybe_capture(kind, event=line)
 
     def record_request(self, record: dict, rank: Optional[int] = None) -> None:
         """One finished-request record (from a replica's beat payload or a
@@ -342,6 +399,49 @@ class DriverAggregator:
             rank: _trace.estimate_skew(list(samples))
             for rank, samples in self._skew_samples.items()
         }
+
+    def register_incident_source(self, name: str, fn) -> None:
+        """Expose a ledger/journal snapshot to future incident bundles."""
+        self.incidents.register_source(name, fn)
+
+    def _trace_slice(self, limit: int = 2000) -> Dict[str, Any]:
+        """Merged Chrome-trace slice of the recent per-rank tails plus the
+        driver ring (non-destructive peek), for incident bundles."""
+        events_by_rank: Dict[Any, List[_trace.TraceTuple]] = {
+            r: list(buf)[-limit:] for r, buf in self._trace_by_rank.items()
+        }
+        rec = _trace.get_recorder()
+        if rec is not None:
+            events_by_rank[_trace.DRIVER] = rec.peek(limit)
+        return _trace.merge_traces(events_by_rank, self.skew_by_rank())
+
+    def goodput_summary(self) -> Dict[str, Any]:
+        """Fold per-(rank, src) goodput ledgers — beats from workers plus
+        any ledgers living in this process (driver bookkeeping, local
+        serve engines) — into the fleet-level section, and publish the
+        fleet counters + fraction gauge."""
+        per: Dict[Any, Dict[str, float]] = {}
+        seen_srcs = set()
+        for rank, srcs in self._goodput.items():
+            for src, cats in srcs.items():
+                key = str(rank) if src == "train" else f"{rank}/{src}"
+                per[key] = dict(cats)
+                seen_srcs.add(src)
+        # process-local ledgers not already reported through a beat (the
+        # in-process path publishes via write_local_dump/ingest instead)
+        for src, led in _goodput.ledgers().items():
+            if src in seen_srcs:
+                continue
+            per[f"driver/{src}"] = led.snapshot()
+        folded = _goodput.fold(per)
+        if folded["total_s"] > 0:
+            reg = self.registry
+            for cat, secs in folded["by_category"].items():
+                reg.counter(
+                    _goodput.GOODPUT_SECONDS_METRIC, category=cat
+                ).value = secs
+            reg.gauge(_goodput.GOODPUT_FRACTION_METRIC).set(folded["fraction"])
+        return folded
 
     def step_samples_by_rank(self) -> Dict[Any, List[float]]:
         return {r: list(s) for r, s in self._step_samples.items()}
@@ -427,6 +527,9 @@ class DriverAggregator:
         profile = self._profile_summary()
         if profile:
             out["profile"] = profile
+        gp = self.goodput_summary()
+        if gp["total_s"] > 0:
+            out["goodput"] = gp
         return out
 
     def _profile_summary(self) -> Dict[str, Any]:
@@ -461,7 +564,22 @@ class DriverAggregator:
         if not self.full or now - self._summary_written < self._summary_interval:
             return
         self._summary_written = now
+        self.registry.push_history(now)
+        self._run_anomaly(now)
         self._write_json(SUMMARY_FILE, self.summary())
+
+    def _run_anomaly(self, now: float) -> None:
+        if self.anomaly is None:
+            return
+        gp = self.goodput_summary()
+        fraction = gp["fraction"] if gp["total_s"] > 0 else None
+        for ev in self.anomaly.evaluate(
+            reg=self.registry,
+            goodput_fraction=fraction,
+            last_fault_ts=self._last_fault_ts,
+            now=now,
+        ):
+            self.record_event(ev.pop("event"), **ev)
 
     def _write_json(self, filename: str, obj: Any) -> None:
         path = os.path.join(self.run_dir, filename)
@@ -500,6 +618,9 @@ class DriverAggregator:
         if self._finalized:
             return self.run_dir if self.full else None
         self._finalized = True
+        if self._prom is not None:
+            self._prom.stop()
+            self._prom = None
         if self.full:
             events_by_rank: Dict[Any, List[_trace.TraceTuple]] = {
                 r: list(buf) for r, buf in self._trace_by_rank.items()
@@ -663,28 +784,61 @@ def _read_events(run_dir: str, limit: int = 32) -> List[dict]:
     return out
 
 
+def start_prom_file_server(
+    run_dir: str, port: int
+) -> "_metrics.PromServer":
+    """Serve ``<run_dir>/metrics.prom`` over HTTP so Prometheus can
+    scrape a run from the driver box without the run itself opening a
+    port (complement to the in-driver ``RLT_PROM_PORT`` endpoint).
+    Responds 503 while the file does not exist yet."""
+    path = os.path.join(run_dir, PROM_FILE)
+
+    def provider() -> str:
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+
+    srv = _metrics.PromServer(provider, port=port)
+    srv.start()
+    return srv
+
+
 def render_top(
     run_dir: str,
     follow: bool = False,
     interval: float = 2.0,
+    serve_port: Optional[int] = None,
     _print=print,
 ) -> int:
     """Render the live summary for ``run_dir``; with ``follow`` keep
-    refreshing until interrupted. Returns a process exit code."""
-    while True:
-        summary = _read_summary(run_dir)
-        if summary is None:
-            _print(f"no telemetry summary found under {run_dir} "
-                   f"(is RLT_TELEMETRY=1 set on the run?)")
-            if not follow:
-                return 1
-        else:
-            if follow:
-                _print("\x1b[2J\x1b[H", end="")
-            _print(format_summary(summary, _read_events(run_dir)))
-        if not follow:
-            return 0
-        try:
-            time.sleep(interval)
-        except KeyboardInterrupt:  # pragma: no cover
-            return 0
+    refreshing until interrupted. With ``serve_port`` also expose
+    ``metrics.prom`` at ``http://127.0.0.1:<port>/metrics`` and stay
+    alive (even without ``follow``) so the endpoint remains scrapable.
+    Returns a process exit code."""
+    srv = None
+    if serve_port is not None:
+        srv = start_prom_file_server(run_dir, serve_port)
+        _print(
+            f"serving metrics at http://127.0.0.1:{srv.port}/metrics "
+            f"(from {os.path.join(run_dir, PROM_FILE)})"
+        )
+    try:
+        while True:
+            summary = _read_summary(run_dir)
+            if summary is None:
+                _print(f"no telemetry summary found under {run_dir} "
+                       f"(is RLT_TELEMETRY=1 set on the run?)")
+                if not follow and srv is None:
+                    return 1
+            else:
+                if follow:
+                    _print("\x1b[2J\x1b[H", end="")
+                _print(format_summary(summary, _read_events(run_dir)))
+            if not follow and srv is None:
+                return 0
+            try:
+                time.sleep(interval)
+            except KeyboardInterrupt:  # pragma: no cover
+                return 0
+    finally:
+        if srv is not None:
+            srv.stop()

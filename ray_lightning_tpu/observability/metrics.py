@@ -15,10 +15,12 @@ resolution.
 """
 from __future__ import annotations
 
+import os
+import threading
 import time
 from bisect import bisect_left
 from collections import deque
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 LabelKey = Tuple[str, Tuple[Tuple[str, str], ...]]
 
@@ -31,6 +33,21 @@ DEFAULT_BOUNDS = (
 PENDING_CAP = 4096
 # Last-N exemplar ids kept per histogram bucket.
 EXEMPLAR_CAP = 3
+
+# Ring-buffered registry history: how many compact snapshots the black-box
+# recorder keeps (pushed on the driver's summary cadence, ~2s apart).
+HISTORY_ENV = "RLT_METRICS_HISTORY"
+HISTORY_DEFAULT = 64
+
+# Driver-local Prometheus scrape endpoint (unset = disabled, 0 = ephemeral).
+PROM_PORT_ENV = "RLT_PROM_PORT"
+
+
+def history_cap() -> int:
+    try:
+        return max(0, int(os.environ.get(HISTORY_ENV, HISTORY_DEFAULT)))
+    except ValueError:
+        return HISTORY_DEFAULT
 
 # Serving-resilience metric names, shared by serving/resilience.py, the
 # engine's shed/expiry paths and the replica router so emit sites and the
@@ -59,6 +76,13 @@ HELP: Dict[str, str] = {
     "rlt_serve_shed_total": "Serving requests rejected by the load-shed policy.",
     "rlt_serve_deadline_expired_total": "Serving requests evicted past their deadline (queued or decoding).",
     "rlt_serve_breaker_state": "Replica circuit-breaker state (0 closed, 1 half-open, 2 open).",
+    "rlt_goodput_seconds_total": "Wall time per goodput category (category, src labels).",
+    "rlt_goodput_fraction": "Fraction of fleet wall time spent in productive compute.",
+    "rlt_anomaly_score": "Current robust z-score (or drop) per anomaly detector.",
+    "rlt_anomaly_events_total": "Anomaly detector firings per detector.",
+    "rlt_incidents_captured_total": "Incident bundles written per triggering kind.",
+    "rlt_incidents_suppressed_total": "Incident captures suppressed by the per-kind cooldown.",
+    "rlt_bench_probe_failures_total": "Native bench backend probes that failed or timed out.",
 }
 
 
@@ -209,6 +233,10 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: Dict[LabelKey, Any] = {}
+        # black-box ring: compact timestamped snapshots, pushed on the
+        # driver's summary cadence; incident bundles dump the window
+        self._history: deque = deque(maxlen=history_cap() or 1)
+        self._history_enabled = history_cap() > 0
 
     def __len__(self) -> int:
         return len(self._metrics)
@@ -319,6 +347,50 @@ class MetricsRegistry:
                 for x in ids:
                     dst.append(str(x))
                 del dst[:-EXEMPLAR_CAP]
+
+    # ----------------------------------------------------------------- #
+    # history ring (black-box recorder)
+    # ----------------------------------------------------------------- #
+    def push_history(self, now: Optional[float] = None) -> None:
+        """Append one compact snapshot to the bounded history ring.
+        Histograms are summarized (sum/count/p50/p99 over the recent
+        window) instead of carrying buckets + raw samples, so N entries
+        stay cheap enough to hold in memory and dump into a bundle."""
+        if not self._history_enabled:
+            return
+        counters: List[Any] = []
+        gauges: List[Any] = []
+        hists: List[Any] = []
+        for (name, labels), m in self._metrics.items():
+            if isinstance(m, Counter):
+                counters.append([name, list(labels), m.value])
+            elif isinstance(m, Gauge):
+                gauges.append([name, list(labels), m.value])
+            else:
+                recent = list(m.recent)
+                hists.append([
+                    name,
+                    list(labels),
+                    {
+                        "sum": m.sum,
+                        "count": m.count,
+                        "p50": percentile(recent, 50) if recent else None,
+                        "p99": percentile(recent, 99) if recent else None,
+                    },
+                ])
+        self._history.append({
+            "ts": time.time() if now is None else now,
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": hists,
+        })
+
+    def history(self, since: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Snapshots in the ring, oldest first; ``since`` filters by ts."""
+        entries = list(self._history)
+        if since is not None:
+            entries = [e for e in entries if e["ts"] >= since]
+        return entries
 
     # ----------------------------------------------------------------- #
     # exposition
@@ -446,3 +518,86 @@ def last_device_memory() -> Optional[List[Dict[str, Any]]]:
     """The most recent (possibly stale) device-memory snapshot, or None
     if none has been taken — never touches the device."""
     return _devmem_cache[1]
+
+
+# --------------------------------------------------------------------- #
+# Prometheus scrape endpoint
+# --------------------------------------------------------------------- #
+class PromServer:
+    """Tiny stdlib HTTP server exposing a text provider at ``/metrics``
+    (and ``/``), so the live registry is scrapeable instead of being
+    file-dump-only. Daemon-threaded; ``stop()`` is idempotent."""
+
+    def __init__(
+        self,
+        provider: Callable[[], str],
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ) -> None:
+        self._provider = provider
+        self._host = host
+        self._requested_port = port
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    def start(self) -> int:
+        import http.server
+
+        provider = self._provider
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API
+                if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = provider().encode("utf-8")
+                except Exception as e:  # provider failure -> scrape error
+                    self.send_error(503, explain=str(e))
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self._host, self._requested_port), _Handler
+        )
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.2},
+            name="rlt-prom",
+            daemon=True,
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+def prom_port_from_env() -> Optional[int]:
+    """The RLT_PROM_PORT knob: an int port (0 = ephemeral) or None when
+    unset/invalid — callers treat None as 'endpoint disabled'."""
+    raw = os.environ.get(PROM_PORT_ENV)
+    if raw is None or not raw.strip():
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None

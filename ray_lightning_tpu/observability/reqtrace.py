@@ -143,6 +143,47 @@ class JsonlWriter:
                 pass
             self._fh = None
 
+    def read_window(self, max_bytes: int = 256 * 1024) -> List[str]:
+        """Trailing window of this writer's records — see
+        :func:`read_window`. Flushes nothing (``write`` already flushes
+        per line) but stitches the live file with its rotation, so a
+        reader never loses the seconds straddling a rotation boundary."""
+        return read_window(self.path, max_bytes)
+
+
+def read_window(path: str, max_bytes: int = 256 * 1024) -> List[str]:
+    """The last ``max_bytes`` worth of JSONL lines ending at ``path``'s
+    tail, stitched across the single-generation rotation: the budget is
+    spent on the live file first, then on ``<path>.1``, and the result is
+    returned oldest-first. A partially-included first line (the seek
+    landed mid-record) is dropped rather than returned corrupt."""
+    chunks: List[bytes] = []
+    remaining = max(0, int(max_bytes))
+    for p in (path, path + ".1"):
+        if remaining <= 0:
+            break
+        try:
+            size = os.path.getsize(p)
+        except OSError:
+            continue
+        take = min(size, remaining)
+        if take <= 0:
+            continue
+        try:
+            with open(p, "rb") as fh:
+                fh.seek(size - take)
+                data = fh.read(take)
+        except OSError:
+            continue
+        if take < size:
+            nl = data.find(b"\n")
+            data = data[nl + 1:] if nl >= 0 else b""
+        chunks.append(data)
+        remaining -= take
+    chunks.reverse()  # rotated generation (older) first
+    text = b"".join(chunks).decode("utf-8", "replace")
+    return [ln for ln in text.splitlines() if ln.strip()]
+
 
 class RequestTrace:
     """Mutable timeline of one in-flight request (perf_counter based,

@@ -89,6 +89,13 @@ class TraceRecorder:
             except IndexError:
                 return out
 
+    def peek(self, limit: int = 0) -> List[TraceTuple]:
+        """Non-destructive copy of the last ``limit`` tuples (all when 0)
+        — incident bundles snapshot the ring without stealing events from
+        the eventual trace drain."""
+        out = list(self._ring)
+        return out[-limit:] if limit > 0 else out
+
 
 class _Span:
     """Context manager recording one complete ("X") event on exit."""

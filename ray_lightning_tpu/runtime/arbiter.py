@@ -55,6 +55,7 @@ mid-transfer and assert the recovery contract.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -142,6 +143,7 @@ class ChipArbiter:
         backoff_base_s: float = 1.0,
         backoff_max_s: float = 60.0,
         clock: Callable[[], float] = time.monotonic,
+        aggregator: Optional[Any] = None,
     ):
         if borrow_count < 1:
             raise ValueError("borrow_count must be >= 1")
@@ -173,6 +175,16 @@ class ChipArbiter:
         # reconcile the ledger against the handles' ground truth
         self._suspect_late_effects = False
         self.recovered_action: Optional[str] = None
+        # optional DriverAggregator: rollbacks/transfers land in the
+        # flight record (incident triggers) instead of just the trace
+        # ring, and the ledger becomes an incident-bundle source
+        self._aggregator = aggregator
+        if aggregator is not None and hasattr(
+            aggregator, "register_incident_source"
+        ):
+            aggregator.register_incident_source(
+                "arbiter_ledger", lambda: read_ledger(self.ledger_dir)
+            )
         os.makedirs(ledger_dir, exist_ok=True)
         if os.path.exists(self.ledger_path):
             with open(self.ledger_path, "r", encoding="utf-8") as f:
@@ -375,7 +387,8 @@ class ChipArbiter:
             ]
             if len(train_devs) - self.borrow_count < self.min_train_devices:
                 return "at_floor"
-            return self._borrow(now)
+            with self._transfer_phase():
+                return self._borrow(now)
         if state == "lent" or (state == "steady" and (borrowed or strays)):
             if self._serve_idle():
                 self._idle_streak += 1
@@ -402,8 +415,19 @@ class ChipArbiter:
                 return "vetoed"
             if in_cooldown and force is None:
                 return "cooldown"
-            return self._return(now)
+            with self._transfer_phase():
+                return self._return(now)
         return "idle"
+
+    def _transfer_phase(self):
+        """Attribute transfer wall time to the driver's goodput ledger.
+        Transfers run on the driver thread, so the driver ledger is the
+        one whose clock they consume."""
+        if not _obs.enabled():
+            return contextlib.nullcontext()
+        return _obs.goodput.ensure_ledger("driver").phase(
+            "arbitration_transfer"
+        )
 
     # ----------------------------------------------------------------- #
     # phase execution under a deadline
@@ -507,6 +531,13 @@ class ChipArbiter:
             error=f"{type(exc).__name__}: {exc}",
             backoff_s=round(backoff, 3),
         )
+        self._record_event(
+            "arbiter_rollback",
+            direction=direction,
+            error=f"{type(exc).__name__}: {exc}",
+            backoff_s=round(backoff, 3),
+            failures=int(self._led["failures"]),
+        )
         log.warning(
             "arbiter %s transfer rolled back (%s); backoff %.1fs",
             direction,
@@ -536,6 +567,19 @@ class ChipArbiter:
             if self._led["transfer"]
             else 0,
         )
+        self._record_event(
+            "arbiter_transfer",
+            direction=direction,
+            transfer=self.transfer_seq,
+        )
+
+    def _record_event(self, kind: str, **fields) -> None:
+        if self._aggregator is None:
+            return
+        try:
+            self._aggregator.record_event(kind, **fields)
+        except Exception:  # pragma: no cover - telemetry must not kill ticks
+            log.debug("arbiter event emit failed", exc_info=True)
 
     # ----------------------------------------------------------------- #
     # borrow: train -> serve

@@ -601,6 +601,13 @@ class ElasticController:
         self._aggregator = aggregator
         self._readmit = readmit
         self.supervisor: Any = None  # wired by the launcher after creation
+        if aggregator is not None and hasattr(
+            aggregator, "register_incident_source"
+        ):
+            # incident bundles freeze the membership view at fault time
+            aggregator.register_incident_source(
+                "membership_ledger", self._ledger_snapshot
+            )
 
         self._lock = threading.Lock()
         self.members: List[int] = list(range(num_workers))
@@ -813,6 +820,31 @@ class ElasticController:
             self._publish(recovery_s=recovery)
 
     # -- observability -----------------------------------------------------
+    def _ledger_snapshot(self) -> Dict[str, Any]:
+        """Membership state for an incident bundle: current members/epoch
+        plus the ledger's recorded transitions."""
+        with self._lock:
+            out: Dict[str, Any] = {
+                "members": list(self.members),
+                "epoch": self.epoch,
+                "resizes": dict(self.resizes),
+                "last_recovery_s": self.last_recovery_s,
+            }
+        cmds = []
+        epoch = 1
+        while self.ledger.has(epoch):
+            cmd = self.ledger.read(epoch)
+            if cmd is not None:
+                cmds.append({
+                    "epoch": cmd.epoch,
+                    "kind": cmd.kind,
+                    "world": cmd.world,
+                    "members": list(cmd.members),
+                })
+            epoch += 1
+        out["transitions"] = cmds
+        return out
+
     def _record_event(self, kind: str, detail: Dict[str, Any]) -> None:
         if self._aggregator is not None:
             try:

@@ -295,6 +295,18 @@ class InferenceEngine:
         self._tracer: Optional[_reqtrace.RequestTracer] = (
             _reqtrace.RequestTracer() if _obs.enabled() else None
         )
+        # goodput ledger for this engine's wall time; a relaunch under the
+        # same replica index adopts the predecessor's totals so the
+        # published counters stay monotonic (the crash-to-relaunch gap
+        # lands in whatever category _fail_all left open: fault_recovery)
+        self._goodput = (
+            _obs.goodput.new_ledger(
+                f"serve{replica_index}" if replica_index is not None else "serve",
+                category="idle",
+            )
+            if _obs.enabled()
+            else None
+        )
         # throughput/utilization accounting (host side, always on)
         self.stats: Dict[str, float] = {
             "decode_steps": 0,
@@ -574,6 +586,8 @@ class InferenceEngine:
         import jax.numpy as jnp
 
         self._ticks += 1
+        if self._goodput is not None:
+            self._goodput.enter("productive_compute")
         # scripted serving faults (RLT_FAULT replica<N> specs): crash
         # raises out of step() -> the loop fails every in-flight request
         # and dies, which is exactly the replica death the journal and
@@ -796,11 +810,14 @@ class InferenceEngine:
             self._thread.start()
 
     def _loop(self) -> None:
+        led = self._goodput
         while True:
             with self._work:
                 while not self.scheduler.has_work():
                     if self._stop_when_idle:
                         return
+                    if led is not None:
+                        led.enter("idle")
                     self._work.wait(timeout=0.05)
             try:
                 self.step()
@@ -810,6 +827,10 @@ class InferenceEngine:
 
     def _fail_all(self, error: BaseException) -> None:
         self.failed = error
+        if self._goodput is not None:
+            # the time from here until a successor engine adopts the
+            # ledger is unplanned recovery, not idle
+            self._goodput.enter("fault_recovery")
         for req in self.scheduler.drain_queue():
             self._finish(req.request_id, "error", error)
             if req.trace is not None:
@@ -840,6 +861,8 @@ class InferenceEngine:
         instead of being silently dropped."""
         with self._work:
             self._closed = True
+        if self._goodput is not None:
+            self._goodput.enter("drain")
         out: List[Dict[str, Any]] = []
         for req in self.scheduler.drain_queue():
             self._finish(req.request_id, "cancelled")
@@ -868,6 +891,8 @@ class InferenceEngine:
 
     def drain(self, timeout: Optional[float] = 60.0) -> None:
         """Stop admitting; finish in-flight + queued work; stop the loop."""
+        if self._goodput is not None:
+            self._goodput.enter("drain")
         with self._work:
             self._closed = True
             self._stop_when_idle = True

@@ -418,6 +418,9 @@ class LocalReplicaFleet:
         self._pump_interval = max(float(pump_interval_s), 0.005)
         self._pump_gate = threading.Lock()
         self._pump_stop = threading.Event()
+        # optional DriverAggregator: flight-record events + incident
+        # sources (attach_aggregator) — None keeps the fleet standalone
+        self._aggregator: Optional[Any] = None
         for _ in range(int(initial_replicas)):
             self.add_replica()
         self._pump_thread = threading.Thread(
@@ -790,6 +793,14 @@ class LocalReplicaFleet:
                 "serve/replica_dead", replica=index,
                 error=repr(engine.failed),
             )
+            if self._aggregator is not None:
+                # flight-record line (and incident trigger, for crash
+                # loops) — the trace ring alone dies with the process
+                self._aggregator.record_event(
+                    "serve_replica_dead",
+                    replica=index,
+                    error=repr(engine.failed),
+                )
             if self.relaunch:
                 self.add_replica(index=index)
             else:
@@ -804,6 +815,14 @@ class LocalReplicaFleet:
         with self._lock:
             breakers = dict(self.breakers)
         publish_breaker_states(breakers)
+
+    def attach_aggregator(self, aggregator: Any) -> None:
+        """Couple the fleet to a DriverAggregator: replica deaths land in
+        the flight record and the request-journal summary becomes an
+        incident-bundle source."""
+        self._aggregator = aggregator
+        if hasattr(aggregator, "register_incident_source"):
+            aggregator.register_incident_source("request_journal", self.stats)
 
     def stats(self) -> Dict[str, Any]:
         """Journal dispositions + fleet recovery counters."""

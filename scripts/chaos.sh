@@ -24,6 +24,11 @@ echo "== chip arbitration (borrow/return transfers, incl. kill-loop e2e) =="
 # RLT_CHAOS_KILL_EVERY also tunes the replica-kill cadence under arbitration
 python -m pytest tests/test_arbiter.py -v -m arbiter -p no:cacheprovider "$@"
 
+echo "== goodput ledger + black-box incident capture (chaos e2e) =="
+# the e2e asserts a faulted run yields >=1 incident bundle whose frozen
+# events.jsonl window is non-empty and covers the fault timestamp
+python -m pytest tests/test_goodput.py -v -m goodput -p no:cacheprovider "$@"
+
 echo "== legacy relaunch/retry path (slow) =="
 python -m pytest tests/test_cli_and_checkpointing.py -v -m slow \
     -k "retries or relaunch" -p no:cacheprovider "$@"

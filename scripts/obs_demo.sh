@@ -48,3 +48,20 @@ python -m ray_lightning_tpu.cli profile --dir "$ROOT/telemetry" --report
 echo
 echo "per-rank jax.profiler captures:"
 ls -d "$ROOT"/telemetry/profile/rank* 2>/dev/null || echo "  (none captured)"
+echo
+# where every second of wall time went (per-category ledger fold)
+python -m ray_lightning_tpu.cli goodput --dir "$ROOT/telemetry"
+echo
+# force one incident capture so the black-box recorder has something to
+# show: append a fault-shaped event through the recorder offline
+python - "$ROOT" <<'EOF'
+import sys
+
+from ray_lightning_tpu.observability import aggregator as _aggregator
+
+run_dir = f"{sys.argv[1]}/telemetry"
+agg = _aggregator.DriverAggregator(run_dir, num_workers=2, full=True)
+agg.record_event("slo_breach", objective="demo", note="forced for the demo")
+agg.finalize()
+EOF
+python -m ray_lightning_tpu.cli incidents --dir "$ROOT/telemetry"

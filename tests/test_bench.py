@@ -35,6 +35,7 @@ def _isolated_cache_dir(tmp_path, monkeypatch):
     monkeypatch.setenv("RLT_BENCH_SERVE_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_COMPILE_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_ARBITRATION_SWEEP", "0")
+    monkeypatch.setenv("RLT_BENCH_GOODPUT_SWEEP", "0")
 
 
 def _result(value, **detail):
