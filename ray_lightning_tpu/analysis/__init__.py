@@ -1,0 +1,32 @@
+"""Project-native static analysis + runtime sanitizers (``rltcheck``).
+
+The correctness of the threaded driver runtime (supervisor, elastic
+controller, chip arbiter, replica fleet, recovery pump, circuit
+breakers) rests on conventions: lock acquisition order, atomic-write
+discipline for crash-consistent ledgers, a registry of ``RLT_*`` env
+knobs, and metric names that match the docs. This package turns those
+conventions into *checked invariants*:
+
+- :mod:`.lockgraph` — AST lock-order analyzer: per-class lock
+  acquisition graph, cycle (potential deadlock) detection, and
+  blocking-call-under-lock lint.
+- :mod:`.sanitizer` — opt-in (``RLT_SANITIZE=1``) instrumented lock
+  wrapper that records per-thread acquisition stacks at runtime and
+  raises on observed inversions.
+- :mod:`.envknobs` — extracts every ``RLT_*`` env read/write, emits the
+  generated registry (:mod:`.knobs`), and drift-gates it against the
+  docs knob tables in both directions.
+- :mod:`.docs_drift` — the shared docs-drift engine (generalizes
+  ``scripts/check_metrics_docs.py``).
+- :mod:`.invariants` — atomic-write discipline, unknown ``rlt_*``
+  metric literals, private cross-module imports, and the daemon-thread
+  leak guard used as a pytest fixture.
+
+Every module here is stdlib-only and uses *relative* imports, so
+``scripts/rltcheck.py`` can load the suite standalone (via a synthetic
+parent package) without importing ``ray_lightning_tpu`` — and therefore
+without importing JAX — keeping the tier-1 static pass fast.
+
+Findings are suppressed per-site through ``allowlist.txt`` (one
+``<key>  # justification`` per line); see docs/development.md.
+"""

@@ -1,0 +1,123 @@
+"""Shared plumbing for the rltcheck analyzers: the Violation record,
+the allowlist file format, and source-tree iteration.
+
+Allowlist format (``analysis/allowlist.txt``)::
+
+    # comment lines and blanks are ignored
+    <violation-key>  # justification (required)
+
+A violation's ``key`` is stable across line-number drift (it is built
+from module/class/function names, never line numbers), so an audited
+entry survives unrelated edits. Entries without a justification are
+themselves reported, as are entries that no longer match anything.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "Violation",
+    "Allowlist",
+    "load_allowlist",
+    "iter_sources",
+    "module_name",
+    "parse_source",
+]
+
+
+@dataclass
+class Violation:
+    kind: str  # e.g. "lock-order", "blocking-under-lock", "raw-os-replace"
+    key: str  # stable allowlist key, "<kind>:<qualified-site>"
+    message: str
+    path: str = ""
+    line: int = 0
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}: " if self.path else ""
+        return f"{loc}{self.message}\n    allowlist key: {self.key}"
+
+
+@dataclass
+class Allowlist:
+    entries: Dict[str, str] = field(default_factory=dict)  # key -> why
+    problems: List[Violation] = field(default_factory=list)
+    used: set = field(default_factory=set)
+
+    def allows(self, key: str) -> bool:
+        if key in self.entries:
+            self.used.add(key)
+            return True
+        return False
+
+    def unused(self) -> List[str]:
+        return sorted(set(self.entries) - self.used)
+
+
+def load_allowlist(path: Optional[Path]) -> Allowlist:
+    al = Allowlist()
+    if path is None or not Path(path).exists():
+        return al
+    for lineno, raw in enumerate(
+        Path(path).read_text(encoding="utf-8").splitlines(), 1
+    ):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, why = line.partition("#")
+        key, why = key.strip(), why.strip()
+        if not why:
+            al.problems.append(
+                Violation(
+                    kind="allowlist",
+                    key=f"allowlist:{key}",
+                    message=(
+                        f"allowlist entry {key!r} has no justification "
+                        "comment — every audited suppression must say why"
+                    ),
+                    path=str(path),
+                    line=lineno,
+                )
+            )
+            continue
+        al.entries[key] = why
+    return al
+
+
+def module_name(path: Path, root: Path) -> str:
+    """``<root>/serving/replica.py`` -> ``serving.replica``."""
+    rel = Path(path).resolve().relative_to(Path(root).resolve())
+    parts = list(rel.with_suffix("").parts)
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or "__init__"
+
+
+def iter_sources(
+    root: Path, subdirs: Optional[List[str]] = None
+) -> Iterator[Tuple[Path, str]]:
+    """Yield ``(path, module_name)`` for every .py file under ``root``
+    (optionally restricted to ``subdirs``), skipping caches and the
+    generated registry."""
+    root = Path(root)
+    bases = [root / d for d in subdirs] if subdirs else [root]
+    for base in bases:
+        if base.is_file():
+            yield base, module_name(base, root)
+            continue
+        for path in sorted(base.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            yield path, module_name(path, root)
+
+
+def parse_source(path: Path) -> Optional[ast.Module]:
+    try:
+        return ast.parse(
+            Path(path).read_text(encoding="utf-8"), filename=str(path)
+        )
+    except SyntaxError:
+        return None

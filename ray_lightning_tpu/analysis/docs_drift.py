@@ -1,0 +1,142 @@
+"""Shared docs-drift engine.
+
+``scripts/check_metrics_docs.py`` (PR 8) proved the pattern: extract a
+name set from code, extract a name set from the docs, and fail CI on
+drift in either direction. This module is that pattern factored out so
+the metric gate and the env-knob gate (:mod:`.envknobs`) — and any
+future registry — share one implementation:
+
+- code side: regex scans over the package source,
+- docs side: *table rows* are contractual (must exist in code), prose
+  mentions are advisory (stale prose is a warning, not a failure),
+- a :class:`DriftReport` with both directions split out.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, List, Pattern, Set
+
+__all__ = [
+    "DriftReport",
+    "scan_file_literals",
+    "doc_mentions",
+    "doc_table_rows",
+    "drift",
+    "emitted_metric_names",
+    "METRIC_EMIT_CALL",
+    "METRIC_CONST",
+    "METRIC_DOC_ROW",
+]
+
+# ---- metric-specific patterns (shared with scripts/check_metrics_docs) --
+# a registry emission call (possibly line-wrapped after the paren)
+METRIC_EMIT_CALL = re.compile(
+    r"""\.(?:counter|gauge|histogram)\(\s*["'](rlt_[a-z0-9_]+)["']"""
+)
+# module-level metric-name constant, e.g. BURN_RATE_METRIC = "rlt_..."
+METRIC_CONST = re.compile(
+    r"""[A-Z][A-Z0-9_]*METRIC[A-Z0-9_]*\s*=\s*["'](rlt_[a-z0-9_]+)["']"""
+)
+# a metric-reference TABLE row: the line's first cell is a backticked name
+METRIC_DOC_ROW = re.compile(r"^\s*\|\s*`(rlt_[a-z0-9_]+)`", re.MULTILINE)
+
+
+@dataclass
+class DriftReport:
+    missing_docs: List[str] = field(default_factory=list)  # code, not docs
+    stale_rows: List[str] = field(default_factory=list)  # table row, no code
+    prose_only: List[str] = field(default_factory=list)  # prose, no code
+
+    @property
+    def clean(self) -> bool:
+        return not self.missing_docs and not self.stale_rows
+
+
+def scan_file_literals(
+    paths: Iterable[Path], patterns: Iterable[Pattern]
+) -> Set[str]:
+    """Union of all pattern captures over the given source files."""
+    names: Set[str] = set()
+    for path in paths:
+        try:
+            text = Path(path).read_text(encoding="utf-8")
+        except OSError:
+            continue
+        for pat in patterns:
+            names.update(pat.findall(text))
+    return names
+
+
+def doc_mentions(doc_paths: Iterable[Path], pattern: Pattern) -> Set[str]:
+    """Every capture of ``pattern`` anywhere in the docs (prose, code
+    fences, tables alike)."""
+    names: Set[str] = set()
+    for path in doc_paths:
+        p = Path(path)
+        if not p.exists():
+            continue
+        names.update(pattern.findall(p.read_text(encoding="utf-8")))
+    return names
+
+
+def doc_table_rows(doc_paths: Iterable[Path], pattern: Pattern) -> Set[str]:
+    """Captures of ``pattern`` on markdown table-row lines only (lines
+    whose first non-space char is ``|``). These are the contractual
+    mentions: a row naming something that no longer exists in code is a
+    failure, unlike prose."""
+    names: Set[str] = set()
+    for path in doc_paths:
+        p = Path(path)
+        if not p.exists():
+            continue
+        for line in p.read_text(encoding="utf-8").splitlines():
+            if line.lstrip().startswith("|"):
+                names.update(pattern.findall(line))
+    return names
+
+
+def _matches(name: str, code_names: Set[str]) -> bool:
+    """Wildcard-aware membership: a documented ``RLT_SLO_*`` (or a
+    trailing-underscore prefix like ``rlt_serve_``) matches any code
+    name with that prefix."""
+    if name in code_names:
+        return True
+    if name.endswith("*"):
+        prefix = name.rstrip("*")
+        return any(c.startswith(prefix) for c in code_names)
+    if name.endswith("_"):
+        return any(c.startswith(name) for c in code_names)
+    return False
+
+
+def drift(
+    code_names: Set[str],
+    documented_anywhere: Set[str],
+    documented_rows: Set[str],
+) -> DriftReport:
+    report = DriftReport()
+    doc_all = documented_anywhere | documented_rows
+    for name in sorted(code_names):
+        if not any(_matches(d, {name}) for d in doc_all):
+            report.missing_docs.append(name)
+    for name in sorted(documented_rows):
+        if not _matches(name, code_names):
+            report.stale_rows.append(name)
+    for name in sorted(documented_anywhere - documented_rows):
+        if not _matches(name, code_names):
+            report.prose_only.append(name)
+    return report
+
+
+def emitted_metric_names(package_root: Path) -> Set[str]:
+    """Every ``rlt_*`` metric the package emits (registry calls +
+    ``*_METRIC*`` constants) — the code side of the metric gate, also
+    used by the unknown-metric-literal lint in :mod:`.invariants`."""
+    paths = [
+        p
+        for p in sorted(Path(package_root).rglob("*.py"))
+        if "__pycache__" not in p.parts
+    ]
+    return scan_file_literals(paths, [METRIC_EMIT_CALL, METRIC_CONST])

@@ -1,0 +1,309 @@
+"""Invariant lint bundle + the daemon-thread leak guard.
+
+Static lints (all AST-based, stdlib-only):
+
+- ``raw-os-replace:<module>:<func>`` — a direct ``os.replace`` outside
+  ``utils/fsio.py``. Crash-consistent tmp-write-then-rename is
+  implemented exactly once (:mod:`ray_lightning_tpu.utils.fsio`);
+  hand-rolled copies are how the four pre-PR-14 variants drifted
+  (fsync'd vs not, mkstemp vs ``.tmp`` suffix collisions).
+- ``raw-ledger-write:<module>:<func>`` — ``open(..., "w"/"wb")`` whose
+  path expression mentions ``ledger``/``journal``: those files carry
+  the crash-consistency contract and must go through fsio.
+- ``metric-literal:<module>:<name>`` — an ``rlt_*`` string literal that
+  is not an emitted metric name (nor a ``rlt_…_`` prefix of one):
+  either a typo'd metric reference or a new name invisible to the docs
+  gate. Trailing-underscore literals are treated as prefix matches
+  (``startswith`` filters).
+- ``private-import:<module>:<name>`` — ``from <other module> import
+  _private``: the layering smell that let ``_atomic_write`` live in
+  ``runtime/elastic.py`` while cli/arbiter imported it.
+
+Runtime guard:
+
+- :class:`ThreadGuard` — snapshot alive threads before a test, report
+  non-daemon stragglers after it (with a join grace). Wired as an
+  autouse fixture in tests/conftest.py so no test can leak a
+  non-daemon thread that would wedge interpreter shutdown.
+"""
+from __future__ import annotations
+
+import ast
+import re
+import threading
+import time
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import Allowlist, Violation, iter_sources, parse_source
+from . import docs_drift
+
+__all__ = [
+    "scan_atomic_writes",
+    "scan_metric_literals",
+    "scan_private_imports",
+    "run_all",
+    "ThreadGuard",
+]
+
+FSIO_MODULE = "utils.fsio"
+_LEDGER_HINTS = ("ledger", "journal")
+_METRIC_LITERAL = re.compile(r"rlt_[a-z0-9][a-z0-9_]*\Z")
+
+
+class _ContextVisitor(ast.NodeVisitor):
+    """Tracks the enclosing ``Class.function`` qualname during a walk."""
+
+    def __init__(self, module: str, path: str):
+        self.module = module
+        self.path = path
+        self._stack: List[str] = []
+        self.violations: List[Violation] = []
+
+    @property
+    def qual(self) -> str:
+        return ".".join(self._stack) or "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+
+class _WriteVisitor(_ContextVisitor):
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "replace"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "os"
+        ):
+            self.violations.append(
+                Violation(
+                    kind="raw-os-replace",
+                    key=f"raw-os-replace:{self.module}:{self.qual}",
+                    message=(
+                        f"direct os.replace in {self.module}.{self.qual} — "
+                        "atomic writes go through utils/fsio.py"
+                    ),
+                    path=self.path,
+                    line=node.lineno,
+                )
+            )
+        if (
+            isinstance(func, ast.Name)
+            and func.id == "open"
+            and node.args
+            and self._write_mode(node)
+            and self._ledgerish(node.args[0])
+        ):
+            self.violations.append(
+                Violation(
+                    kind="raw-ledger-write",
+                    key=f"raw-ledger-write:{self.module}:{self.qual}",
+                    message=(
+                        f"{self.module}.{self.qual} opens a ledger/journal "
+                        "path for writing directly — crash-consistent "
+                        "files go through utils/fsio.py"
+                    ),
+                    path=self.path,
+                    line=node.lineno,
+                )
+            )
+        self.generic_visit(node)
+
+    @staticmethod
+    def _write_mode(node: ast.Call) -> bool:
+        mode = None
+        if len(node.args) > 1:
+            mode = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        return (
+            isinstance(mode, ast.Constant)
+            and isinstance(mode.value, str)
+            and "w" in mode.value
+        )
+
+    @staticmethod
+    def _ledgerish(expr: ast.AST) -> bool:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                low = sub.value.lower()
+                if any(h in low for h in _LEDGER_HINTS):
+                    return True
+        return False
+
+
+def scan_atomic_writes(
+    package_root: Path, allowlist: Optional[Allowlist] = None
+) -> List[Violation]:
+    allowlist = allowlist or Allowlist()
+    out: List[Violation] = []
+    for path, module in iter_sources(Path(package_root)):
+        if module == FSIO_MODULE:
+            continue
+        tree = parse_source(path)
+        if tree is None:
+            continue
+        v = _WriteVisitor(module, str(path))
+        v.visit(tree)
+        out.extend(x for x in v.violations if not allowlist.allows(x.key))
+    return out
+
+
+def scan_metric_literals(
+    package_root: Path,
+    allowlist: Optional[Allowlist] = None,
+    emitted: Optional[Set[str]] = None,
+) -> List[Violation]:
+    allowlist = allowlist or Allowlist()
+    package_root = Path(package_root)
+    if emitted is None:
+        emitted = docs_drift.emitted_metric_names(package_root)
+    out: List[Violation] = []
+    for path, module in iter_sources(package_root):
+        if module.startswith("analysis"):
+            continue
+        tree = parse_source(path)
+        if tree is None:
+            continue
+        seen: Set[str] = set()
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _METRIC_LITERAL.match(node.value)
+            ):
+                continue
+            name = node.value
+            if name in emitted or name in seen:
+                continue
+            if name.endswith("_") and any(
+                e.startswith(name) for e in emitted
+            ):
+                continue  # prefix literal used for startswith filtering
+            seen.add(name)
+            key = f"metric-literal:{module}:{name}"
+            if allowlist.allows(key):
+                continue
+            out.append(
+                Violation(
+                    kind="metric-literal",
+                    key=key,
+                    message=(
+                        f"string literal {name!r} in {module} looks like a "
+                        "metric name but no registry emission site defines "
+                        "it — typo, or a name the docs gate cannot see"
+                    ),
+                    path=str(path),
+                    line=node.lineno,
+                )
+            )
+    return out
+
+
+def scan_private_imports(
+    package_root: Path, allowlist: Optional[Allowlist] = None
+) -> List[Violation]:
+    allowlist = allowlist or Allowlist()
+    out: List[Violation] = []
+    for path, module in iter_sources(Path(package_root)):
+        tree = parse_source(path)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            src = node.module or ""
+            cross_module = node.level > 0 or "ray_lightning_tpu" in src
+            if not cross_module:
+                continue
+            for alias in node.names:
+                if alias.name.startswith("_") and not alias.name.startswith(
+                    "__"
+                ):
+                    key = f"private-import:{module}:{alias.name}"
+                    if allowlist.allows(key):
+                        continue
+                    out.append(
+                        Violation(
+                            kind="private-import",
+                            key=key,
+                            message=(
+                                f"{module} imports private name "
+                                f"{alias.name!r} from {src or '(relative)'}"
+                                " — promote it to a public helper instead"
+                            ),
+                            path=str(path),
+                            line=node.lineno,
+                        )
+                    )
+    return out
+
+
+def run_all(
+    package_root: Path, allowlist: Optional[Allowlist] = None
+) -> List[Violation]:
+    allowlist = allowlist or Allowlist()
+    return (
+        scan_atomic_writes(package_root, allowlist)
+        + scan_metric_literals(package_root, allowlist)
+        + scan_private_imports(package_root, allowlist)
+    )
+
+
+class ThreadGuard:
+    """No-non-daemon-stragglers invariant for the test suite.
+
+    Usage::
+
+        guard = ThreadGuard.snapshot()
+        ...            # run the test
+        leaked = guard.stragglers(grace=3.0)
+        assert not leaked
+
+    A straggler is an alive, non-daemon thread that did not exist at
+    snapshot time and is still alive after ``grace`` seconds. Daemon
+    threads are exempt (the interpreter can exit through them); known
+    pool threads can be exempted by name pattern.
+    """
+
+    DEFAULT_IGNORE = ("pydevd", "ThreadPoolExecutor", "asyncio_")
+
+    def __init__(self, baseline: Set[int], ignore: Sequence[str]):
+        self.baseline = baseline
+        self.ignore = tuple(ignore)
+
+    @classmethod
+    def snapshot(
+        cls, ignore: Sequence[str] = DEFAULT_IGNORE
+    ) -> "ThreadGuard":
+        return cls({t.ident for t in threading.enumerate()}, ignore)
+
+    def _new_nondaemon(self) -> List[threading.Thread]:
+        return [
+            t
+            for t in threading.enumerate()
+            if t.is_alive()
+            and not t.daemon
+            and t.ident not in self.baseline
+            and not any(pat in (t.name or "") for pat in self.ignore)
+        ]
+
+    def stragglers(self, grace: float = 3.0) -> List[threading.Thread]:
+        deadline = time.monotonic() + grace
+        leaked = self._new_nondaemon()
+        while leaked and time.monotonic() < deadline:
+            time.sleep(0.05)
+            leaked = self._new_nondaemon()
+        return leaked

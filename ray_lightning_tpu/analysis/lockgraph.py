@@ -1,0 +1,610 @@
+"""AST lock-order analyzer for the threaded driver runtime.
+
+Extracts, per class (and per module for module-level locks), the lock
+*acquisition graph*: an edge ``A -> B`` means some code path acquires
+``B`` while holding ``A`` — either directly (``with self._a: with
+self._b:``) or through a resolvable call made while holding ``A``
+(``self.method()``, ``self.attr.method()`` or a local bound to a known
+class, where the callee — transitively — acquires ``B``).
+
+Violations reported:
+
+- ``lock-order:A->B`` — the edge participates in a cycle of the global
+  acquisition graph (potential deadlock). Allowlisting an edge removes
+  it from the graph *before* cycle detection, so auditing one edge of a
+  two-lock cycle clears the cycle.
+- ``lock-self-cycle:A`` — a non-reentrant ``threading.Lock`` is
+  (possibly transitively) re-acquired while already held: guaranteed
+  self-deadlock on that path.
+- ``blocking-under-lock:<module>:<func>:<callee>`` — a call that can
+  block indefinitely (``.join()``, ``queue.get()``, ``time.sleep``,
+  ``.wait()`` on something other than the held condition, ``.result()``,
+  ``recv``, subprocess waits) made while holding a lock.
+
+Lock identity is the *creation site* (``module.Class.attr`` or
+``module.name``), not the instance: two instances of the same class
+share a node. That is the standard lock-ordering discipline — and the
+runtime sanitizer (:mod:`.sanitizer`) complements it with exact
+per-instance inversion detection.
+
+Recognized creation idioms: ``threading.Lock()`` / ``RLock()`` /
+``Condition(...)``, the sanitizer factories ``rlt_lock(name)`` /
+``rlt_rlock(name)`` / ``rlt_condition(name, lock=None)``, and
+``<dict>.setdefault(key, <lock ctor>)``. A ``Condition(self._x)``
+aliases the wrapped lock: acquiring the condition *is* acquiring
+``self._x``.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Allowlist, Violation, iter_sources, parse_source
+
+__all__ = ["LockGraph", "build_graph", "analyze", "DEFAULT_SUBDIRS"]
+
+DEFAULT_SUBDIRS = ["runtime", "serving", "observability"]
+
+_LOCK_CTORS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "rlt_lock": "lock",
+    "rlt_rlock": "rlock",
+    "rlt_condition": "condition",
+}
+
+# callee names that can block indefinitely; each entry is
+# (attr_name, receiver_filter) where receiver_filter refines matches
+_BLOCKING_SIMPLE = {"result", "recv", "recv_bytes", "communicate"}
+_QUEUE_HINTS = ("queue", "inbox", "outbox", "mailbox")
+
+
+def _call_name(func: ast.AST) -> Tuple[Optional[str], str]:
+    """Return (dotted receiver or None, final attribute/function name)."""
+    if isinstance(func, ast.Name):
+        return None, func.id
+    if isinstance(func, ast.Attribute):
+        parts: List[str] = []
+        cur: ast.AST = func.value
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            parts.append(cur.id)
+            return ".".join(reversed(parts)), func.attr
+        if isinstance(cur, ast.Constant):
+            return "<const>", func.attr
+        return "<expr>", func.attr
+    return None, "<lambda>"
+
+
+def _lock_ctor_kind(call: ast.AST) -> Optional[Tuple[str, Optional[ast.AST]]]:
+    """If ``call`` constructs a lock, return (kind, aliased_lock_expr).
+
+    ``aliased_lock_expr`` is the wrapped-lock argument of a Condition
+    (or None). Also unwraps ``<dict>.setdefault(key, <ctor>)``.
+    """
+    if not isinstance(call, ast.Call):
+        return None
+    recv, name = _call_name(call.func)
+    if name == "setdefault" and len(call.args) == 2:
+        return _lock_ctor_kind(call.args[1])
+    if name not in _LOCK_CTORS:
+        return None
+    kind = _LOCK_CTORS[name]
+    alias: Optional[ast.AST] = None
+    if kind == "condition":
+        # threading.Condition(lock) / rlt_condition(name, lock)
+        args = call.args
+        if name == "rlt_condition":
+            args = args[1:]
+        kwargs = {k.arg: k.value for k in call.keywords}
+        if args:
+            alias = args[0]
+        elif "lock" in kwargs:
+            alias = kwargs["lock"]
+    return kind, alias
+
+
+@dataclass
+class _ClassInfo:
+    module: str
+    name: str
+    locks: Dict[str, str] = field(default_factory=dict)  # attr -> kind
+    cond_alias: Dict[str, str] = field(default_factory=dict)  # attr -> attr
+    attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> Class
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+
+    def lock_id(self, attr: str) -> str:
+        attr = self.cond_alias.get(attr, attr)
+        return f"{self.module}.{self.name}.{attr}"
+
+
+@dataclass
+class LockGraph:
+    locks: Dict[str, str] = field(default_factory=dict)  # id -> kind
+    # (a, b) -> [(path, line, note)]
+    edges: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = field(
+        default_factory=dict
+    )
+    blocking: List[Violation] = field(default_factory=list)
+
+    def add_edge(self, a: str, b: str, path: str, line: int, note: str):
+        self.edges.setdefault((a, b), []).append((path, line, note))
+
+
+class _FilePass(ast.NodeVisitor):
+    """Pass 1: classes, their lock attrs / attr types, module locks."""
+
+    def __init__(self, module: str):
+        self.module = module
+        self.classes: Dict[str, _ClassInfo] = {}
+        self.module_locks: Dict[str, str] = {}  # name -> kind
+        self.module_funcs: Dict[str, ast.AST] = {}
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        info = _ClassInfo(self.module, node.name)
+        self.classes[node.name] = info
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[item.name] = item
+                for sub in ast.walk(item):
+                    if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                        tgt = sub.targets[0]
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                        ):
+                            self._bind_attr(info, tgt.attr, sub.value)
+
+    def _bind_attr(self, info: _ClassInfo, attr: str, value: ast.AST) -> None:
+        ctor = _lock_ctor_kind(value)
+        if ctor is not None:
+            kind, alias = ctor
+            info.locks[attr] = kind
+            if (
+                alias is not None
+                and isinstance(alias, ast.Attribute)
+                and isinstance(alias.value, ast.Name)
+                and alias.value.id == "self"
+            ):
+                info.cond_alias[attr] = alias.attr
+            return
+        if isinstance(value, ast.Call):
+            _, name = _call_name(value.func)
+            if name and name[:1].isupper():
+                info.attr_types[attr] = name
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.module_funcs[node.name] = node
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            ctor = _lock_ctor_kind(node.value)
+            if ctor is not None:
+                self.module_locks[node.targets[0].id] = ctor[0]
+
+
+class _Universe:
+    """Everything pass 1 learned across all scanned files."""
+
+    def __init__(self) -> None:
+        self.files: Dict[str, _FilePass] = {}  # module -> pass
+        self.class_index: Dict[str, _ClassInfo] = {}  # ClassName -> info
+
+    def add(self, fp: _FilePass) -> None:
+        self.files[fp.module] = fp
+        for name, info in fp.classes.items():
+            # first definition wins; class names are unique in practice
+            self.class_index.setdefault(name, info)
+
+
+class _MethodWalker:
+    """Pass 2: walk one function body tracking the held-lock stack."""
+
+    def __init__(
+        self,
+        universe: _Universe,
+        fp: _FilePass,
+        cls: Optional[_ClassInfo],
+        func_name: str,
+        path: str,
+        graph: LockGraph,
+        summaries: Dict[str, Set[str]],
+    ):
+        self.u = universe
+        self.fp = fp
+        self.cls = cls
+        self.func_name = func_name
+        self.path = path
+        self.graph = graph
+        self.summaries = summaries
+        self.local_locks: Dict[str, str] = {}  # local var -> lock id
+        self.local_types: Dict[str, str] = {}  # local var -> ClassName
+
+    # -- resolution ---------------------------------------------------- #
+    def _qual(self) -> str:
+        if self.cls is not None:
+            return f"{self.cls.name}.{self.func_name}"
+        return self.func_name
+
+    def resolve_lock(self, expr: ast.AST) -> Optional[str]:
+        """Lock id of an expression, or None."""
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and self.cls is not None
+            and self.cls.cond_alias.get(expr.attr, expr.attr)
+            in self.cls.locks
+        ):
+            return self.cls.lock_id(expr.attr)
+        if isinstance(expr, ast.Name):
+            if expr.id in self.local_locks:
+                return self.local_locks[expr.id]
+            if expr.id in self.fp.module_locks:
+                return f"{self.fp.module}.{expr.id}"
+        return None
+
+    def lock_kind(self, lock_id: str) -> str:
+        return self.graph.locks.get(lock_id, "lock")
+
+    def resolve_method(self, call: ast.Call) -> Optional[str]:
+        """Return ``ClassName.method`` / ``module.func`` summary key for
+        a resolvable call, else None."""
+        recv, name = _call_name(call.func)
+        if recv is None:
+            if name in self.fp.module_funcs:
+                return f"{self.fp.module}:{name}"
+            return None
+        parts = recv.split(".")
+        if parts[0] == "self" and self.cls is not None:
+            if len(parts) == 1:
+                if name in self.cls.methods:
+                    return f"{self.cls.name}.{name}"
+                return None
+            if len(parts) == 2:
+                tname = self.cls.attr_types.get(parts[1])
+                tinfo = self.u.class_index.get(tname) if tname else None
+                if tinfo is not None and name in tinfo.methods:
+                    return f"{tinfo.name}.{name}"
+            return None
+        if len(parts) == 1:
+            tname = self.local_types.get(parts[0])
+            tinfo = self.u.class_index.get(tname) if tname else None
+            if tinfo is not None and name in tinfo.methods:
+                return f"{tinfo.name}.{name}"
+        return None
+
+    # -- traversal ----------------------------------------------------- #
+    def walk_body(self, body: List[ast.stmt], held: List[str]) -> None:
+        for stmt in body:
+            self.walk(stmt, held)
+
+    def walk(self, node: ast.AST, held: List[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs execute later; analyze with an empty stack
+            self.walk_body(node.body, [])
+            return
+        if isinstance(node, ast.With):
+            acquired: List[str] = []
+            for item in node.items:
+                self._scan_expr(item.context_expr, held)
+                lid = self.resolve_lock(item.context_expr)
+                if lid is not None:
+                    self._on_acquire(lid, held, node.lineno)
+                    held.append(lid)
+                    acquired.append(lid)
+            self.walk_body(node.body, held)
+            for lid in reversed(acquired):
+                held.pop()
+            return
+        if isinstance(node, ast.Assign):
+            self._track_assign(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, held)
+            else:
+                self.walk(child, held)
+
+    def _track_assign(self, node: ast.Assign) -> None:
+        if len(node.targets) != 1 or not isinstance(node.targets[0], ast.Name):
+            return
+        name = node.targets[0].id
+        ctor = _lock_ctor_kind(node.value)
+        if ctor is not None:
+            lid = f"{self.fp.module}.{self._qual()}.{name}"
+            self.local_locks[name] = lid
+            self.graph.locks.setdefault(lid, ctor[0])
+            return
+        if isinstance(node.value, ast.Call):
+            _, cname = _call_name(node.value.func)
+            if cname and cname[:1].isupper() and cname in self.u.class_index:
+                self.local_types[name] = cname
+
+    def _scan_expr(self, expr: ast.AST, held: List[str]) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call) and held:
+                self._on_call_under_lock(sub, held)
+
+    # -- events -------------------------------------------------------- #
+    def _on_acquire(self, lid: str, held: List[str], line: int) -> None:
+        for h in held:
+            if h == lid:
+                if self.lock_kind(lid) != "lock":
+                    continue  # RLock/Condition re-entry is legal
+                self.graph.blocking.append(
+                    Violation(
+                        kind="lock-self-cycle",
+                        key=f"lock-self-cycle:{lid}",
+                        message=(
+                            f"non-reentrant lock {lid} re-acquired while "
+                            f"already held in {self.fp.module}.{self._qual()}"
+                        ),
+                        path=self.path,
+                        line=line,
+                    )
+                )
+                continue
+            self.graph.add_edge(
+                h, lid, self.path, line, f"{self.fp.module}.{self._qual()}"
+            )
+
+    def _on_call_under_lock(self, call: ast.Call, held: List[str]) -> None:
+        recv, name = _call_name(call.func)
+        # 1) interprocedural lock propagation through resolvable calls
+        target = self.resolve_method(call)
+        if target is not None:
+            for lid in self.summaries.get(target, ()):
+                self._on_acquire(lid, held, call.lineno)
+        # 2) blocking-call lint
+        reason = self._blocking_reason(call, recv, name, held)
+        if reason is not None:
+            self.graph.blocking.append(
+                Violation(
+                    kind="blocking-under-lock",
+                    key=(
+                        f"blocking-under-lock:{self.fp.module}:"
+                        f"{self._qual()}:{name}"
+                    ),
+                    message=(
+                        f"{reason} while holding "
+                        f"{' -> '.join(held)} in "
+                        f"{self.fp.module}.{self._qual()}"
+                    ),
+                    path=self.path,
+                    line=call.lineno,
+                )
+            )
+
+    def _blocking_reason(
+        self,
+        call: ast.Call,
+        recv: Optional[str],
+        name: str,
+        held: List[str],
+    ) -> Optional[str]:
+        last = recv.rsplit(".", 1)[-1].lower() if recv else ""
+        if name == "join":
+            # str.join (constant receiver) and os.path.join are not
+            # thread joins
+            if recv in (None, "<const>") or last in ("path", "posixpath"):
+                return None
+            if isinstance(getattr(call.func, "value", None), ast.Constant):
+                return None
+            return f"potentially-blocking {recv}.join()"
+        if name == "sleep":
+            return "time.sleep() under a lock stalls every contender"
+        if name in ("wait", "wait_for"):
+            lid = (
+                self.resolve_lock(call.func.value)
+                if isinstance(call.func, ast.Attribute)
+                else None
+            )
+            if lid is not None and lid in held:
+                return None  # cond.wait() releases the held condition
+            return f"blocking {recv}.{name}() on a foreign waitable"
+        if name in _BLOCKING_SIMPLE:
+            return f"blocking {recv}.{name}()" if recv else f"{name}()"
+        if name in ("get", "put"):
+            if any(h in last for h in _QUEUE_HINTS) or last in ("q", "rt"):
+                return f"blocking {recv}.{name}() on a queue"
+            return None
+        if recv == "subprocess" and name in (
+            "run",
+            "check_call",
+            "check_output",
+            "call",
+        ):
+            return f"subprocess.{name}() under a lock"
+        if recv == "os" and name in ("waitpid", "wait"):
+            return f"os.{name}() under a lock"
+        if recv == "select" and name == "select":
+            return "select.select() under a lock"
+        return None
+
+
+def build_graph(
+    package_root: Path, subdirs: Optional[List[str]] = None
+) -> LockGraph:
+    universe = _Universe()
+    sources: List[Tuple[Path, _FilePass]] = []
+    for path, module in iter_sources(
+        Path(package_root), subdirs or DEFAULT_SUBDIRS
+    ):
+        tree = parse_source(path)
+        if tree is None:
+            continue
+        fp = _FilePass(module)
+        fp.visit(tree)
+        universe.add(fp)
+        sources.append((path, fp))
+
+    graph = LockGraph()
+    for _, fp in sources:
+        for cname, info in fp.classes.items():
+            for attr, kind in info.locks.items():
+                if attr not in info.cond_alias:
+                    graph.locks[info.lock_id(attr)] = kind
+        for name, kind in fp.module_locks.items():
+            graph.locks[f"{fp.module}.{name}"] = kind
+
+    # direct-acquisition summaries, then a fixpoint over resolvable calls
+    summaries: Dict[str, Set[str]] = {}
+    method_calls: Dict[str, Set[str]] = {}
+
+    def _collect(fp: _FilePass, cls, fname, fnode, path):
+        key = f"{cls.name}.{fname}" if cls else f"{fp.module}:{fname}"
+        w = _MethodWalker(universe, fp, cls, fname, path, LockGraph(), {})
+        direct: Set[str] = set()
+        calls: Set[str] = set()
+        for sub in ast.walk(fnode):
+            if isinstance(sub, ast.Assign):
+                w._track_assign(sub)
+        for sub in ast.walk(fnode):
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    lid = w.resolve_lock(item.context_expr)
+                    if lid is not None:
+                        direct.add(lid)
+            elif isinstance(sub, ast.Call):
+                tgt = w.resolve_method(sub)
+                if tgt is not None:
+                    calls.add(tgt)
+        summaries[key] = direct
+        method_calls[key] = calls
+
+    for path, fp in sources:
+        for cls in fp.classes.values():
+            for fname, fnode in cls.methods.items():
+                _collect(fp, cls, fname, fnode, str(path))
+        for fname, fnode in fp.module_funcs.items():
+            _collect(fp, None, fname, fnode, str(path))
+
+    for _ in range(len(summaries)):
+        changed = False
+        for key, calls in method_calls.items():
+            acc = summaries[key]
+            before = len(acc)
+            for tgt in calls:
+                acc |= summaries.get(tgt, set())
+            changed |= len(acc) != before
+        if not changed:
+            break
+
+    # second pass: held-stack walk with interprocedural summaries
+    for path, fp in sources:
+        for cls in fp.classes.values():
+            for fname, fnode in cls.methods.items():
+                w = _MethodWalker(
+                    universe, fp, cls, fname, str(path), graph, summaries
+                )
+                w.walk_body(fnode.body, [])
+        for fname, fnode in fp.module_funcs.items():
+            w = _MethodWalker(
+                universe, fp, None, fname, str(path), graph, summaries
+            )
+            w.walk_body(fnode.body, [])
+    return graph
+
+
+def _cycles(
+    edges: Set[Tuple[str, str]]
+) -> List[Set[str]]:
+    """Strongly connected components with >1 node (Tarjan)."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan to stay safe on deep graphs
+        work = [(v, iter(adj[v]))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(adj[w])))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc: Set[str] = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    out.append(scc)
+
+    for v in list(adj):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+def analyze(
+    package_root: Path,
+    allowlist: Optional[Allowlist] = None,
+    subdirs: Optional[List[str]] = None,
+) -> Tuple[List[Violation], LockGraph]:
+    allowlist = allowlist or Allowlist()
+    graph = build_graph(package_root, subdirs)
+    violations: List[Violation] = []
+
+    live_edges = {
+        (a, b)
+        for (a, b) in graph.edges
+        if not allowlist.allows(f"lock-order:{a}->{b}")
+    }
+    for scc in _cycles(live_edges):
+        for (a, b), sites in sorted(graph.edges.items()):
+            if a in scc and b in scc and (a, b) in live_edges:
+                path, line, ctx = sites[0]
+                violations.append(
+                    Violation(
+                        kind="lock-order",
+                        key=f"lock-order:{a}->{b}",
+                        message=(
+                            f"lock-order cycle: {b} acquired while "
+                            f"holding {a} (in {ctx}; cycle members: "
+                            f"{', '.join(sorted(scc))})"
+                        ),
+                        path=path,
+                        line=line,
+                    )
+                )
+    for v in graph.blocking:
+        if not allowlist.allows(v.key):
+            violations.append(v)
+    return violations, graph

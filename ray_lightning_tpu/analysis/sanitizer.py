@@ -1,0 +1,238 @@
+"""Runtime lock-order sanitizer (opt-in via ``RLT_SANITIZE=1``).
+
+The driver runtime creates its locks through the factories below
+(``rlt_lock`` / ``rlt_rlock`` / ``rlt_condition``). With sanitizing off
+(the default) they return plain :mod:`threading` primitives — zero
+overhead, zero behavior change. With ``RLT_SANITIZE=1`` they return
+instrumented wrappers that record, per thread, the stack of held locks
+and the creation-stack of every first-seen ordering edge ``A -> B``
+("B acquired while holding A", keyed by lock *instance*). When a thread
+is about to block on ``B`` while holding ``A`` and the reversed edge
+``B -> A`` has been observed — the classic two-thread deadlock recipe —
+the acquire raises :class:`LockInversionError` carrying both
+acquisition stacks instead of deadlocking, and the inversion is
+appended to a process-global report that the test harness asserts
+empty (see the ``sanitize`` fixtures in tests/conftest.py).
+
+Also raises on a guaranteed self-deadlock: blocking re-acquisition of a
+non-reentrant sanitized Lock by the thread that already holds it.
+
+Instance-keyed edges make the detector precise (no false positives
+from two unrelated instances of the same class being locked in
+opposite orders by design), at the cost of only catching inversions the
+run actually exercises — which is exactly why the chaos/elastic/arbiter
+kill-loop suites run with it enabled: sustained fault loops double as
+race hunts. The static analyzer (:mod:`.lockgraph`) covers the
+creation-site-level ordering the sanitizer can't see.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "enabled",
+    "rlt_lock",
+    "rlt_rlock",
+    "rlt_condition",
+    "LockInversionError",
+    "SanitizedLock",
+    "SanitizedRLock",
+    "inversions",
+    "reset",
+]
+
+
+class LockInversionError(RuntimeError):
+    """Raised instead of deadlocking when an acquisition inverts a
+    previously-observed lock order (or re-enters a non-reentrant
+    sanitized lock)."""
+
+
+def enabled() -> bool:
+    return os.environ.get("RLT_SANITIZE", "") == "1"
+
+
+_serial = itertools.count(1)
+_tls = threading.local()
+_graph_lock = threading.Lock()
+# (sid_held, sid_acquired) -> (name_held, name_acquired, stack)
+_edges: Dict[Tuple[int, int], Tuple[str, str, str]] = {}
+_inversions: List[Dict[str, Any]] = []
+
+
+def _held() -> List["SanitizedLock"]:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _stack(skip: int = 2, limit: int = 10) -> str:
+    frames = []
+    try:
+        f = sys._getframe(skip)
+    except ValueError:  # pragma: no cover
+        return "<no stack>"
+    while f is not None and len(frames) < limit:
+        frames.append(
+            f"{f.f_code.co_filename}:{f.f_lineno} in {f.f_code.co_name}"
+        )
+        f = f.f_back
+    return " <- ".join(frames)
+
+
+def inversions() -> List[Dict[str, Any]]:
+    """Inversions observed since the last :func:`reset` (process-wide)."""
+    with _graph_lock:
+        return list(_inversions)
+
+
+def reset() -> None:
+    """Clear the ordering graph and the inversion report (test harness)."""
+    with _graph_lock:
+        _edges.clear()
+        _inversions.clear()
+
+
+def _check_order(lock: "SanitizedLock") -> None:
+    """Called before a *blocking* acquire: record edges held->lock and
+    raise if the reverse edge was ever observed."""
+    held = _held()
+    if not held:
+        return
+    stack = None
+    for h in held:
+        if h._sid == lock._sid:
+            if lock._reentrant:
+                return  # re-entry is legal; no new ordering information
+            report = {
+                "kind": "self-deadlock",
+                "lock": lock._name,
+                "stack": _stack(3),
+            }
+            with _graph_lock:
+                _inversions.append(report)
+            raise LockInversionError(
+                f"self-deadlock: non-reentrant lock {lock._name!r} "
+                f"re-acquired by the thread holding it\n  at: "
+                + report["stack"]
+            )
+        pair = (h._sid, lock._sid)
+        rev = (lock._sid, h._sid)
+        prior = _edges.get(rev)
+        if prior is not None:
+            report = {
+                "kind": "inversion",
+                "first": f"{prior[0]} -> {prior[1]}",
+                "first_stack": prior[2],
+                "second": f"{h._name} -> {lock._name}",
+                "second_stack": _stack(3),
+            }
+            with _graph_lock:
+                _inversions.append(report)
+            raise LockInversionError(
+                "lock-order inversion: acquiring "
+                f"{lock._name!r} while holding {h._name!r}, but the "
+                f"opposite order was previously observed\n  prior "
+                f"({report['first']}): {prior[2]}\n  now "
+                f"({report['second']}): {report['second_stack']}"
+            )
+        if pair not in _edges:
+            if stack is None:
+                stack = _stack(3)
+            with _graph_lock:
+                _edges.setdefault(pair, (h._name, lock._name, stack))
+
+
+class SanitizedLock:
+    """Instrumented drop-in for ``threading.Lock()``."""
+
+    _reentrant = False
+
+    def __init__(self, name: str):
+        self._name = name
+        self._sid = next(_serial)
+        self._inner = self._make_inner()
+
+    def _make_inner(self):
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking:
+            _check_order(self)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _held().append(self)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i]._sid == self._sid:
+                del held[i]
+                break
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "SanitizedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<{type(self).__name__} {self._name!r} sid={self._sid}>"
+
+
+class SanitizedRLock(SanitizedLock):
+    """Instrumented drop-in for ``threading.RLock()`` — including the
+    ``_release_save`` / ``_acquire_restore`` / ``_is_owned`` protocol so
+    it can back a ``threading.Condition``."""
+
+    _reentrant = True
+
+    def _make_inner(self):
+        return threading.RLock()
+
+    # Condition protocol ------------------------------------------------
+    def _release_save(self):
+        held = _held()
+        count = sum(1 for h in held if h._sid == self._sid)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i]._sid == self._sid:
+                del held[i]
+        return self._inner._release_save(), count
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, count = state
+        self._inner._acquire_restore(inner_state)
+        _held().extend([self] * count)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+def rlt_lock(name: str):
+    """A named lock: plain ``threading.Lock()`` unless ``RLT_SANITIZE=1``."""
+    return SanitizedLock(name) if enabled() else threading.Lock()
+
+
+def rlt_rlock(name: str):
+    return SanitizedRLock(name) if enabled() else threading.RLock()
+
+
+def rlt_condition(name: str, lock: Optional[Any] = None):
+    """A named condition. ``lock`` may be a plain or sanitized lock; when
+    omitted under sanitizing, the condition wraps a :class:`SanitizedRLock`
+    so waits/notifies are order-checked too."""
+    if not enabled():
+        return threading.Condition(lock)
+    return threading.Condition(lock if lock is not None else SanitizedRLock(name))

@@ -491,15 +491,16 @@ def _cmd_arbiter(args, parser) -> int:
         import os
         import time
 
-        from ray_lightning_tpu.runtime.elastic import _atomic_write
+        from ray_lightning_tpu.utils.fsio import atomic_write_bytes
 
         os.makedirs(args.ledger_dir, exist_ok=True)
         path = os.path.join(args.ledger_dir, _arbiter.FORCE_NAME)
-        _atomic_write(
+        atomic_write_bytes(
             path,
             json.dumps(
                 {"direction": args.direction, "ts": time.time()}
             ).encode("utf-8"),
+            fsync=True,
         )
         print(f"queued forced {args.direction} transfer at {path}")
         return 0

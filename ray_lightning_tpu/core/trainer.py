@@ -41,6 +41,7 @@ from ray_lightning_tpu.loggers.base import Logger
 from ray_lightning_tpu.loggers.csv_logger import CSVLogger
 from ray_lightning_tpu.runtime import compile_cache as _compile_cache
 from ray_lightning_tpu.strategies.base import Strategy, XLAStrategy
+from ray_lightning_tpu.utils import fsio
 from ray_lightning_tpu.utils.precision import cast_floats, parse_precision
 from ray_lightning_tpu.utils.seed import seed_everything
 from ray_lightning_tpu.utils.serialization import to_state_stream, load_state_stream
@@ -1924,10 +1925,7 @@ class Trainer:
             # write-then-rename: a process killed mid-save (the exact moment
             # the crash-relaunch path later scans this directory) must never
             # leave a truncated .ckpt that the relaunch would pick as "newest"
-            tmp = filepath + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(to_state_stream(ckpt))
-            os.replace(tmp, filepath)
+            fsio.atomic_write_bytes(filepath, to_state_stream(ckpt))
         reg = obs.registry()
         if reg is not None:
             reg.counter("rlt_checkpoint_saves_total").inc()

@@ -29,6 +29,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from ..utils import fsio
 from . import anomaly as _anomaly
 from . import goodput as _goodput
 from . import incidents as _incidents
@@ -583,11 +584,8 @@ class DriverAggregator:
 
     def _write_json(self, filename: str, obj: Any) -> None:
         path = os.path.join(self.run_dir, filename)
-        tmp = path + ".tmp"
         try:
-            with open(tmp, "w") as f:
-                json.dump(obj, f, default=str)
-            os.replace(tmp, path)
+            fsio.atomic_write_json(path, obj, default=str)
         except OSError:  # pragma: no cover
             pass
 

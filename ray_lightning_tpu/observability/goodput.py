@@ -34,6 +34,8 @@ DriverAggregator folds those per-rank counters into fleet totals.
 from __future__ import annotations
 
 import threading
+
+from ray_lightning_tpu.analysis.sanitizer import rlt_lock
 import time
 from typing import Callable, Dict, Iterator, Optional
 
@@ -75,7 +77,7 @@ class GoodputLedger:
     ) -> None:
         self.src = src
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = rlt_lock("observability.goodput.GoodputLedger._lock")
         self._totals: Dict[str, float] = {}
         self._carried = 0.0  # wall time inherited from a predecessor ledger
         self._started = clock()
@@ -160,7 +162,7 @@ class GoodputLedger:
 # -- process-local ledger registry ---------------------------------------
 
 _LEDGERS: Dict[str, GoodputLedger] = {}
-_REG_LOCK = threading.Lock()
+_REG_LOCK = rlt_lock("observability.goodput._REG_LOCK")
 
 
 def new_ledger(src: str = "train", category: str = "idle") -> GoodputLedger:

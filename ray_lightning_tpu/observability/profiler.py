@@ -42,6 +42,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..utils import fsio
 from . import metrics as _metrics
 from . import trace as _trace
 
@@ -391,10 +392,7 @@ def write_profile_command(
     if note:
         cmd["note"] = str(note)
     path = os.path.join(run_dir, PROFILE_CMD_FILE)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(cmd, f)
-    os.replace(tmp, path)
+    fsio.atomic_write_json(path, cmd)
     return cmd
 
 

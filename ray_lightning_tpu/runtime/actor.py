@@ -30,6 +30,8 @@ import secrets
 import socket
 import struct
 import threading
+
+from ray_lightning_tpu.analysis.sanitizer import rlt_lock
 import time
 import traceback
 from concurrent.futures import Future
@@ -238,10 +240,10 @@ class _Connection:
         _send_msg(self.sock, authkey)
         self._pending: Dict[int, Future] = {}
         self._ids = itertools.count()
-        self._lock = threading.Lock()
+        self._lock = rlt_lock("runtime.actor._Connection._lock")
         # socket writes get their own lock: _lock only guards _pending/_ids,
         # so the reader can dispatch responses while a large send is inflight
-        self._send_lock = threading.Lock()
+        self._send_lock = rlt_lock("runtime.actor._Connection._send_lock")
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 

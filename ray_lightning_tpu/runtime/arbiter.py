@@ -65,7 +65,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 
 from ray_lightning_tpu import observability as _obs
 from ray_lightning_tpu.runtime import faults as _faults
-from ray_lightning_tpu.runtime.elastic import _atomic_write
+from ray_lightning_tpu.analysis.sanitizer import rlt_rlock
+from ray_lightning_tpu.utils.fsio import atomic_write_bytes
 
 log = logging.getLogger(__name__)
 
@@ -167,7 +168,7 @@ class ChipArbiter:
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_max_s = float(backoff_max_s)
         self._clock = clock
-        self._lock = threading.RLock()
+        self._lock = rlt_rlock("runtime.arbiter.ChipArbiter._lock")
         self._idle_streak = 0
         self._cooldown_until: Optional[float] = None
         # set when a phase deadline abandons its worker thread: the
@@ -275,9 +276,10 @@ class ChipArbiter:
     # ----------------------------------------------------------------- #
     def _journal(self) -> None:
         self._led["updated"] = _utc()
-        _atomic_write(
+        atomic_write_bytes(
             self.ledger_path,
             json.dumps(self._led, indent=2, sort_keys=True).encode("utf-8"),
+            fsync=True,
         )
 
     def _set(self, state: str, phase: Optional[str] = None) -> None:
@@ -299,11 +301,12 @@ class ChipArbiter:
         (an operator override) but not the device floors."""
         if direction not in ("borrow", "return"):
             raise ValueError("direction must be 'borrow' or 'return'")
-        _atomic_write(
+        atomic_write_bytes(
             self._force_path,
             json.dumps({"direction": direction, "ts": _utc()}).encode(
                 "utf-8"
             ),
+            fsync=True,
         )
 
     def _consume_force(self) -> Optional[str]:

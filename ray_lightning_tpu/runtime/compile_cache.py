@@ -52,7 +52,9 @@ from typing import Any, Dict, Optional, Tuple
 
 from ray_lightning_tpu import observability as _obs
 from ray_lightning_tpu.observability import metrics as _metrics
+from ray_lightning_tpu.analysis.sanitizer import rlt_lock
 from ray_lightning_tpu.utils.common import rank_zero_warn
+from ray_lightning_tpu.utils.fsio import atomic_writer
 
 # Bump when the on-disk entry layout changes; skewed entries recompile.
 FORMAT_VERSION = 1
@@ -328,7 +330,7 @@ class CompileCache:
         self._allow_load = allow_load
         self._persist = persist if persist is not None else self.cache_dir is not None
         self._mem: Dict[str, Any] = {}
-        self._lock = threading.Lock()
+        self._lock = rlt_lock("runtime.compile_cache.CompileCache._lock")
         self._key_locks: Dict[str, threading.Lock] = {}
         self._client_token: Optional[int] = None
         self._warned_persist = False
@@ -469,11 +471,9 @@ class CompileCache:
         }
         try:
             os.makedirs(self.cache_dir, exist_ok=True)
-            fd, tmp = tempfile.mkstemp(dir=self.cache_dir, suffix=".tmp")
-            with os.fdopen(fd, "wb") as f:
+            with atomic_writer(path, "wb") as f:
                 f.write(json.dumps(header).encode() + b"\n")
                 f.write(payload)
-            os.replace(tmp, path)
         except OSError as e:
             if not self._warned_persist:
                 self._warned_persist = True
@@ -513,7 +513,9 @@ class CompileCache:
                 self._client_token = token
             compiled = self._mem.get(key)
             if compiled is None:
-                key_lock = self._key_locks.setdefault(key, threading.Lock())
+                key_lock = self._key_locks.setdefault(
+                key, rlt_lock("runtime.compile_cache.CompileCache._key_lock")
+            )
         if compiled is not None:
             self._record("hits", program, "memory")
             return compiled
@@ -659,7 +661,7 @@ class CachedProgram:
 # process-wide shared cache
 # --------------------------------------------------------------------- #
 _GLOBAL: Optional[CompileCache] = None
-_GLOBAL_LOCK = threading.Lock()
+_GLOBAL_LOCK = rlt_lock("runtime.compile_cache._GLOBAL_LOCK")
 
 
 def get_cache() -> CompileCache:

@@ -38,6 +38,9 @@ import time
 from dataclasses import dataclass, field, asdict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ray_lightning_tpu.analysis.sanitizer import rlt_lock
+from ray_lightning_tpu.utils.fsio import atomic_write_bytes
+
 logger = logging.getLogger(__name__)
 
 ELASTIC_ENV = "RLT_ELASTIC"
@@ -322,15 +325,6 @@ class ResizeCommand:
         return ResizeCommand(**data)
 
 
-def _atomic_write(path: str, data: bytes) -> None:
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-
-
 class MembershipLedger:
     """Append-only command log + ack files on a shared filesystem.
 
@@ -349,7 +343,9 @@ class MembershipLedger:
         return os.path.join(self.root, f"epoch_{epoch:06d}.json")
 
     def announce(self, cmd: ResizeCommand) -> None:
-        _atomic_write(self._cmd_path(cmd.epoch), cmd.to_json().encode("utf-8"))
+        atomic_write_bytes(
+            self._cmd_path(cmd.epoch), cmd.to_json().encode("utf-8"), fsync=True
+        )
 
     def read(self, epoch: int) -> Optional[ResizeCommand]:
         path = self._cmd_path(epoch)
@@ -370,9 +366,10 @@ class MembershipLedger:
         return os.path.join(self.root, f"ack_{epoch:06d}_b{boot_id}.json")
 
     def ack(self, epoch: int, boot_id: int) -> None:
-        _atomic_write(
+        atomic_write_bytes(
             self._ack_path(epoch, boot_id),
             json.dumps({"ts": time.time(), "pid": os.getpid()}).encode("utf-8"),
+            fsync=True,
         )
 
     def acks_present(self, epoch: int, boot_ids: Sequence[int]) -> bool:
@@ -392,11 +389,13 @@ class MembershipLedger:
 
 
 def write_handoff(path: str, payload: Dict[str, Any]) -> None:
-    _atomic_write(path, pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL))
+    atomic_write_bytes(
+        path, pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL), fsync=True
+    )
 
 
 def write_handoff_failed(path: str) -> None:
-    _atomic_write(path + ".failed", b"{}")
+    atomic_write_bytes(path + ".failed", b"{}", fsync=True)
 
 
 def read_handoff(path: str, timeout: float, allow_failed: bool = False) -> Optional[Dict[str, Any]]:
@@ -609,7 +608,7 @@ class ElasticController:
                 "membership_ledger", self._ledger_snapshot
             )
 
-        self._lock = threading.Lock()
+        self._lock = rlt_lock("runtime.elastic.ElasticController._lock")
         self.members: List[int] = list(range(num_workers))
         self.epoch = 0
         self._next_boot_id = num_workers

@@ -10,11 +10,13 @@ import ctypes
 import os
 import subprocess
 import threading
+
+from ray_lightning_tpu.analysis.sanitizer import rlt_lock
 from typing import Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "librlt_shm.so")
-_lock = threading.Lock()
+_lock = rlt_lock("runtime.native._lock")
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 

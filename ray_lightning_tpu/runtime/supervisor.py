@@ -43,6 +43,8 @@ from __future__ import annotations
 
 import logging
 import threading
+
+from ray_lightning_tpu.analysis.sanitizer import rlt_lock
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -158,7 +160,7 @@ class Supervisor:
             r: WorkerHealth(rank=r) for r in range(num_workers)
         }
         self._verdict: Optional[WorkerHangError] = None
-        self._verdict_lock = threading.Lock()
+        self._verdict_lock = rlt_lock("runtime.supervisor.Supervisor._verdict_lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._poll_interval = max(0.02, min(self.heartbeat_interval / 2.0, 0.25))

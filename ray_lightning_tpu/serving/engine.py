@@ -55,6 +55,8 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+
+from ray_lightning_tpu.analysis.sanitizer import rlt_condition, rlt_lock
 import time
 from collections import deque
 from dataclasses import dataclass, replace as _dc_replace
@@ -283,8 +285,10 @@ class InferenceEngine:
         self._on_token: Dict[str, Callable[[str, int], Any]] = {}
         self._rng = jax.random.key(ecfg.seed)
         self._req_counter = itertools.count()
-        self._state_lock = threading.Lock()
-        self._work = threading.Condition(self._state_lock)
+        self._state_lock = rlt_lock("serving.engine.InferenceEngine._state_lock")
+        self._work = rlt_condition(
+            "serving.engine.InferenceEngine._work", self._state_lock
+        )
         self._closed = False
         self._thread: Optional[threading.Thread] = None
         self._stop_when_idle = False

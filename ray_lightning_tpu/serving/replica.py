@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import itertools
 import threading
+
+from ray_lightning_tpu.analysis.sanitizer import rlt_lock
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -309,7 +311,7 @@ class _LoadTap:
     heartbeat_age / record_event)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = rlt_lock("serving.replica._LoadTap._lock")
         self.loads: Dict[int, Dict[str, float]] = {}
         self.ages: Dict[int, float] = {}
         self.events: List[Tuple[str, dict]] = []
@@ -402,7 +404,7 @@ class LocalReplicaFleet:
         self._drain_threads: List[threading.Thread] = []
         self._next_index = 0
         self._rr = 0
-        self._lock = threading.Lock()
+        self._lock = rlt_lock("serving.replica.LocalReplicaFleet._lock")
         self.added_total = 0
         self.removed_total = 0
         self.max_retries = int(max_retries)
@@ -416,7 +418,7 @@ class LocalReplicaFleet:
         self.relaunches_total = 0
         self._pending: List[JournalEntry] = []
         self._pump_interval = max(float(pump_interval_s), 0.005)
-        self._pump_gate = threading.Lock()
+        self._pump_gate = rlt_lock("serving.replica.LocalReplicaFleet._pump_gate")
         self._pump_stop = threading.Event()
         # optional DriverAggregator: flight-record events + incident
         # sources (attach_aggregator) — None keeps the fleet standalone
@@ -1087,7 +1089,7 @@ class ReplicaGroup:
         self._inflight: Dict[str, int] = {}  # request id -> replica index
         self._drain_threads: List[threading.Thread] = []
         self._rr = 0
-        self._lock = threading.Lock()
+        self._lock = rlt_lock("serving.replica.ReplicaGroup._lock")
         self._queue = None
         self._supervisor = None
         # request recovery: driver-owned ids + per-request resubmission

@@ -38,6 +38,8 @@ import itertools
 import logging
 import signal
 import threading
+
+from ray_lightning_tpu.analysis.sanitizer import rlt_lock
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -113,7 +115,7 @@ class CircuitBreaker:
         self.failure_threshold = int(failure_threshold)
         self.open_cooldown_s = float(open_cooldown_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = rlt_lock("serving.resilience.CircuitBreaker._lock")
         self._state = BREAKER_CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0
@@ -284,7 +286,7 @@ class JournalEntry:
         self.submitted_at = time.perf_counter()
         self.first_token_at: Optional[float] = None
         self._done = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = rlt_lock("serving.resilience.JournalEntry._lock")
 
     # -- Completion-compatible surface ---------------------------------- #
     @property
@@ -334,7 +336,7 @@ class RequestJournal:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = rlt_lock("serving.resilience.RequestJournal._lock")
         self._entries: Dict[str, JournalEntry] = {}
         self._auto_id = itertools.count()
         self.retries_total = 0

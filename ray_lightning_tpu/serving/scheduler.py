@@ -37,6 +37,8 @@ converts possible starvation into bounded extra latency.
 from __future__ import annotations
 
 import threading
+
+from ray_lightning_tpu.analysis.sanitizer import rlt_lock
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -125,7 +127,7 @@ class ContinuousBatchScheduler:
         self.head_skip_limit = int(head_skip_limit)
         self.head_aging_ticks = int(head_aging_ticks)
         self._queue: Deque[Request] = deque()
-        self._lock = threading.Lock()
+        self._lock = rlt_lock("serving.scheduler.ContinuousBatchScheduler._lock")
         self.queued_total = 0
         self.rejected_total = 0
         self.deferred_total = 0  # ticks the queue head waited for capacity

@@ -1,0 +1,98 @@
+"""Crash-consistent file writes — the single audited implementation.
+
+Every ledger, journal, telemetry summary, checkpoint, and cache entry
+in the stack relies on the same contract: a reader (often a process
+that just crashed and restarted) either sees the COMPLETE previous
+file or the COMPLETE new one, never a torn write. The implementation is
+tmp-file-in-the-same-directory + ``os.replace`` (atomic on POSIX within
+a filesystem). It used to be copy-pasted in four places with drifting
+details (fsync'd vs not, pid-suffixed tmp names that collide across
+threads); rltcheck's ``raw-os-replace`` lint now forbids any other
+``os.replace`` call site in the package, so this stays the only copy.
+
+``fsync=True`` additionally makes the *contents* durable against power
+loss before the rename — use it for ledgers whose journal-before-act
+contract (arbiter, membership) must hold across machine crashes, not
+just process crashes. The default (False) is rename-atomicity only,
+which is what telemetry summaries and caches need.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from contextlib import contextmanager
+from typing import Any, Iterator, Optional
+
+__all__ = [
+    "atomic_writer",
+    "atomic_write_bytes",
+    "atomic_write_text",
+    "atomic_write_json",
+]
+
+
+@contextmanager
+def atomic_writer(
+    path: str,
+    mode: str = "wb",
+    fsync: bool = False,
+    encoding: Optional[str] = None,
+) -> Iterator[Any]:
+    """Yield a file handle on a temp file in ``path``'s directory;
+    atomically rename over ``path`` on clean exit, unlink on error.
+
+    mkstemp (not a fixed ``.tmp`` suffix) so concurrent writers — two
+    threads persisting the same cache key, or a driver and a worker
+    racing on a summary — never interleave into one tmp file.
+    """
+    path = os.path.abspath(path)
+    directory = os.path.dirname(path)
+    fd, tmp = tempfile.mkstemp(
+        dir=directory, prefix=os.path.basename(path) + ".", suffix=".tmp"
+    )
+    if "b" in mode:
+        f = os.fdopen(fd, mode)
+    else:
+        f = os.fdopen(fd, mode, encoding=encoding or "utf-8")
+    try:
+        yield f
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            f.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_bytes(path: str, data: bytes, fsync: bool = False) -> None:
+    with atomic_writer(path, "wb", fsync=fsync) as f:
+        f.write(data)
+
+
+def atomic_write_text(
+    path: str, text: str, fsync: bool = False, encoding: str = "utf-8"
+) -> None:
+    with atomic_writer(path, "w", fsync=fsync, encoding=encoding) as f:
+        f.write(text)
+
+
+def atomic_write_json(
+    path: str,
+    obj: Any,
+    fsync: bool = False,
+    indent: Optional[int] = None,
+    sort_keys: bool = False,
+    default: Any = None,
+) -> None:
+    with atomic_writer(path, "w", fsync=fsync) as f:
+        json.dump(obj, f, indent=indent, sort_keys=sort_keys, default=default)

@@ -29,6 +29,14 @@ echo "== goodput ledger + black-box incident capture (chaos e2e) =="
 # events.jsonl window is non-empty and covers the fault timestamp
 python -m pytest tests/test_goodput.py -v -m goodput -p no:cacheprovider "$@"
 
+echo "== arbiter kill loop under the lock-order sanitizer =="
+# RLT_SANITIZE=1 wraps every rlt_lock with acquisition-order tracking
+# (docs/development.md): an inversion anywhere in the arbiter/elastic/
+# fleet stack raises LockInversionError instead of deadlocking silently.
+# Worker processes inherit the env var, so actor-side locks are covered.
+RLT_SANITIZE=1 python -m pytest tests/test_arbiter.py tests/test_elastic.py \
+    -v -m "arbiter or elastic" -p no:cacheprovider "$@"
+
 echo "== legacy relaunch/retry path (slow) =="
 python -m pytest tests/test_cli_and_checkpointing.py -v -m slow \
     -k "retries or relaunch" -p no:cacheprovider "$@"

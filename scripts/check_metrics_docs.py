@@ -12,42 +12,53 @@ a registry ``counter(`` / ``gauge(`` / ``histogram(`` call, or assigned
 to a ``*_METRIC*`` constant. Log strings that merely start with
 ``rlt_`` (e.g. ``f"rlt_queue_push failed: ..."``) and unrelated dict
 keys (``"rlt_version"``) are not false positives.
+
+The extraction lives in the shared docs-drift engine
+(``ray_lightning_tpu/analysis/docs_drift.py``), which the env-knob gate
+in ``scripts/rltcheck.py`` reuses; this script keeps the original CLI
+surface and the metric-specific single-doc policy.
 """
 from __future__ import annotations
 
+import importlib
 import re
 import sys
+import types
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 PACKAGE = REPO / "ray_lightning_tpu"
 DOCS = REPO / "docs" / "observability.md"
 
-# a metric name is the ENTIRE quoted literal, nothing more
+
+def _load_docs_drift():
+    """Import analysis.docs_drift without importing ray_lightning_tpu
+    (whose __init__ pulls in JAX) — same trick as scripts/rltcheck.py."""
+    if "ray_lightning_tpu" in sys.modules:
+        return importlib.import_module("ray_lightning_tpu.analysis.docs_drift")
+    base = "_rltcheck_analysis"
+    if base not in sys.modules:
+        pkg = types.ModuleType(base)
+        pkg.__path__ = [str(PACKAGE / "analysis")]
+        sys.modules[base] = pkg
+    return importlib.import_module(f"{base}.docs_drift")
+
+
+_drift = _load_docs_drift()
+
+# re-exported so existing callers (tests) keep working
 _METRIC_LITERAL = re.compile(r"""["'](rlt_[a-z0-9_]+)["']""")
-# registry emission call (possibly line-wrapped after the paren)
-_EMIT_CALL = re.compile(
-    r"""\.(?:counter|gauge|histogram)\(\s*["'](rlt_[a-z0-9_]+)["']"""
-)
-# module-level metric-name constant, e.g. BURN_RATE_METRIC = "rlt_..."
-_METRIC_CONST = re.compile(
-    r"""[A-Z][A-Z0-9_]*METRIC[A-Z0-9_]*\s*=\s*["'](rlt_[a-z0-9_]+)["']"""
-)
-# a metric-reference TABLE row: the line's first cell is a backticked name
-_DOC_ROW = re.compile(r"^\s*\|\s*`(rlt_[a-z0-9_]+)`", re.MULTILINE)
+_EMIT_CALL = _drift.METRIC_EMIT_CALL
+_METRIC_CONST = _drift.METRIC_CONST
+_DOC_ROW = _drift.METRIC_DOC_ROW
 
 
 def emitted_metrics(package: Path = PACKAGE) -> set:
-    names = set()
-    for path in sorted(package.rglob("*.py")):
-        text = path.read_text(encoding="utf-8")
-        names.update(_EMIT_CALL.findall(text))
-        names.update(_METRIC_CONST.findall(text))
-    return names
+    return _drift.emitted_metric_names(package)
 
 
 def documented_metrics(docs: Path = DOCS) -> set:
-    return set(_METRIC_LITERAL.findall(docs.read_text(encoding="utf-8")) ) | {
+    return set(_METRIC_LITERAL.findall(docs.read_text(encoding="utf-8"))) | {
         m.group(1)
         for m in re.finditer(r"`(rlt_[a-z0-9_]+)`", docs.read_text(encoding="utf-8"))
     }
@@ -56,42 +67,40 @@ def documented_metrics(docs: Path = DOCS) -> set:
 def documented_rows(docs: Path = DOCS) -> set:
     """Names claimed by the metric-reference tables specifically — these
     must exist in code (docs->code direction), unlike prose mentions."""
-    return set(_DOC_ROW.findall(docs.read_text(encoding="utf-8")))
+    return _drift.doc_table_rows([docs], _DOC_ROW)
 
 
 def main() -> int:
     emitted = emitted_metrics()
     documented = documented_metrics()
-    missing = sorted(emitted - documented)
-    if missing:
+    rows = documented_rows()
+    report = _drift.drift(emitted, documented, rows)
+    if report.missing_docs:
         print(
             "metrics emitted by ray_lightning_tpu but absent from "
             f"{DOCS.relative_to(REPO)}:"
         )
-        for name in missing:
+        for name in report.missing_docs:
             print(f"  {name}")
         print(
             "\nadd each to the 'Metric name reference' table (or rename "
             "the metric)."
         )
         return 1
-    rows = documented_rows()
-    stale_rows = sorted(rows - emitted)
-    if stale_rows:
+    if report.stale_rows:
         print(
             f"metric table rows in {DOCS.relative_to(REPO)} that no longer "
             "exist in ray_lightning_tpu:"
         )
-        for name in stale_rows:
+        for name in report.stale_rows:
             print(f"  {name}")
         print("\nremove each stale row (or restore the metric in code).")
         return 1
-    stale = sorted(documented - emitted - rows)
-    if stale:
+    if report.prose_only:
         # documented-but-not-emitted PROSE is a warning, not a failure:
         # docs may legitimately mention label values or derived names
         print("note: documented but not found as a literal in the package:")
-        for name in stale:
+        for name in report.prose_only:
             print(f"  {name}")
     print(
         f"ok: {len(emitted)} emitted metrics all documented, "

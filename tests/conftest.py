@@ -55,6 +55,51 @@ os.environ.setdefault("RLT_ZYGOTE", "1")
 
 import pytest  # noqa: E402
 
+from ray_lightning_tpu.analysis import sanitizer as _sanitizer  # noqa: E402
+from ray_lightning_tpu.analysis.invariants import ThreadGuard  # noqa: E402
+
+# Suites whose whole point is concurrent lock traffic run under the
+# lock-order sanitizer (docs/development.md). Tests can also opt in
+# individually with @pytest.mark.sanitize.
+_SANITIZE_MARKERS = {"sanitize", "chaos", "elastic", "arbiter", "serving_chaos"}
+
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer(request, monkeypatch):
+    """Force RLT_SANITIZE=1 for sanitizer-marked tests and fail the test
+    on any lock-order inversion observed while it ran. Locks created
+    before the fixture (module-level registries) stay uninstrumented —
+    only locks constructed during the test are checked, which is exactly
+    the set the test exercises."""
+    marked = _SANITIZE_MARKERS.intersection(
+        m.name for m in request.node.iter_markers()
+    )
+    if not marked:
+        yield
+        return
+    monkeypatch.setenv("RLT_SANITIZE", "1")
+    _sanitizer.reset()
+    yield
+    inversions = _sanitizer.inversions()
+    assert not inversions, (
+        "lock-order inversion(s) observed during the test:\n"
+        + "\n\n".join(str(i) for i in inversions)
+    )
+
+
+@pytest.fixture(autouse=True)
+def _thread_guard(request):
+    """No test may leak a non-daemon thread (it would wedge interpreter
+    shutdown). Daemon pumps are exempt; so are tests that legitimately
+    hand threads to a later test via module state (none today)."""
+    guard = ThreadGuard.snapshot()
+    yield
+    leaked = guard.stragglers(grace=3.0)
+    assert not leaked, (
+        f"test leaked non-daemon thread(s): {[t.name for t in leaked]} — "
+        "join them or make them daemons with an explicit shutdown path"
+    )
+
 
 @pytest.fixture
 def tmp_root(tmp_path):
