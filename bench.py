@@ -1335,6 +1335,184 @@ def _attach_goodput_sweep(result: dict, here: str, env: dict) -> None:
         }
 
 
+def _zero_sweep(args) -> int:
+    """Child: the ZeRO sharding sweep (--_zero_sweep).
+
+    Trains the same tiny MLP under four configurations — replicated DDP,
+    explicit ZeRO-2, explicit ZeRO-3, and ZeRO-3 with the int8
+    block-scaled parameter all-gather — on 4 virtual CPU devices and
+    reports, per config: median post-warmup step time, analytic
+    collective bytes per step (from the profiler's HLO cost report of
+    the compiled program), and live state bytes (sum of addressable
+    shard sizes of params + optimizer state, so replicated state counts
+    once per device and sharded state once total). For the quantized
+    config it also reports the all-gather wire bytes next to the fp32
+    equivalent so the compression delta is visible in every bench round.
+    Reported as detail.zero."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = " ".join(
+        f for f in flags.split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count=4".strip()
+    )
+    os.environ.pop("RLT_TELEMETRY_DIR", None)  # keep dumps under tmp roots
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import numpy as _np
+    import optax
+
+    import ray_lightning_tpu as rlt
+    from ray_lightning_tpu.parallel.sharding import ShardingPolicy
+
+    class _Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.tanh(nn.Dense(512)(x))
+            return nn.Dense(16)(h)
+
+    class _ZeroModel(rlt.LightningModule):
+        def __init__(self):
+            super().__init__()
+            self.net = _Net()
+
+        def init_params(self, rng):
+            return self.net.init(rng, jnp.zeros((1, 64)))
+
+        def training_step(self, params, batch, batch_idx):
+            x, y = batch
+            loss = jnp.mean((self.net.apply(params, x) - y) ** 2)
+            self.log("loss", loss)
+            return loss
+
+        def configure_optimizers(self):
+            return optax.adam(1e-2)
+
+    def _loader():
+        rng = _np.random.RandomState(0)
+        x = rng.randn(128, 64).astype(_np.float32)
+        y = rng.randn(128, 16).astype(_np.float32)
+        return rlt.DataLoader(
+            list(zip(x, y)),
+            batch_size=32,
+            collate_fn=lambda items: (
+                _np.stack([i[0] for i in items]),
+                _np.stack([i[1] for i in items]),
+            ),
+        )
+
+    class _StepTimer(rlt.Callback):
+        """Per-step wall times (blocking on params so async dispatch does
+        not fold device time into a later interval) plus the profiler's
+        cost reports, grabbed inside the loop — the trainer closes and
+        drops the profiler before on_train_end fires."""
+
+        def __init__(self):
+            self.marks = []
+            self.reports = {}
+
+        def on_train_batch_end(self, trainer, module, outputs, batch, batch_idx):
+            jax.block_until_ready(trainer._params)
+            self.marks.append(time.perf_counter())
+            prof = getattr(trainer, "_profiler", None)
+            if prof is not None and prof._reports:
+                self.reports = dict(prof._reports)
+
+    def _live_bytes(tree) -> int:
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                total += int(sum(s.data.nbytes for s in shards))
+            elif hasattr(leaf, "nbytes"):
+                total += int(leaf.nbytes)
+        return total
+
+    configs = [
+        ("ddp", 0, False),
+        ("zero2", 2, False),
+        ("zero3", 3, False),
+        ("zero3_int8_gather", 3, True),
+    ]
+    out = {"platform": "cpu", "devices": 4, "configs": {}}
+    for name, stage, quant in configs:
+        policy = ShardingPolicy(
+            zero_stage=stage, data_axes=("dp",), min_shard_size=1024
+        )
+        timer = _StepTimer()
+        root = tempfile.mkdtemp(prefix=f"rlt-zero-sweep-{name}-")
+        trainer = rlt.Trainer(
+            default_root_dir=root,
+            max_steps=8,
+            max_epochs=10,
+            strategy=rlt.XLAStrategy(
+                devices=4,
+                sharding_policy=policy,
+                telemetry=True,
+                zero_quantized_allgather=quant,
+            ),
+            callbacks=[timer],
+            enable_progress_bar=False,
+            enable_checkpointing=False,
+            logger=False,
+        )
+        trainer.fit(_ZeroModel(), _loader())
+        deltas = sorted(
+            b - a for a, b in zip(timer.marks[1:-1], timer.marks[2:])
+        )
+        entry = {
+            "program": trainer._train_program,
+            "step_ms": (
+                round(deltas[len(deltas) // 2] * 1e3, 3) if deltas else None
+            ),
+            "state_bytes": _live_bytes((trainer._params, trainer._opt_state)),
+        }
+        rep = timer.reports.get(trainer._train_program)
+        if rep is not None:
+            entry["collective_bytes"] = rep.collective_bytes
+        ctx = getattr(trainer, "_zero_ctx", None)
+        if ctx is not None:
+            entry["allgather_wire_bytes"] = ctx.gather_wire_bytes()
+            entry["allgather_fp32_bytes"] = ctx.gather_fp32_bytes()
+        out["configs"][name] = entry
+    q8 = out["configs"].get("zero3_int8_gather", {})
+    if q8.get("allgather_fp32_bytes"):
+        out["quantized_allgather_savings"] = round(
+            1.0 - q8["allgather_wire_bytes"] / q8["allgather_fp32_bytes"], 4
+        )
+    print(json.dumps(out))
+    return 0
+
+
+def _attach_zero_sweep(result: dict, here: str, env: dict) -> None:
+    """Attach detail.zero (DDP vs explicit ZeRO-2/3 vs int8-gather step
+    time, collective bytes, live state bytes). RLT_BENCH_ZERO_SWEEP=0
+    disables."""
+    if os.environ.get("RLT_BENCH_ZERO_SWEEP", "1") == "0":
+        return
+    sweep_env = dict(env)
+    sweep_env["JAX_PLATFORMS"] = "cpu"
+    ok, sweep, serr = _run(
+        [sys.executable, here, "--_zero_sweep"],
+        _env_timeout("RLT_BENCH_ZERO_TIMEOUT", 600.0),
+        sweep_env,
+    )
+    detail = result.setdefault("detail", {})
+    if ok and isinstance(sweep, dict) and "configs" in sweep:
+        detail["zero"] = sweep
+    else:
+        detail["zero"] = {
+            "error": (sweep or {}).get("error")
+            or serr
+            or "sweep produced no JSON"
+        }
+
+
 def _last_json_dict(stdout: str):
     for line in reversed((stdout or "").strip().splitlines()):
         try:
@@ -1626,6 +1804,7 @@ def main() -> int:
     parser.add_argument("--_compile_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_arbitration_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_goodput_sweep", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--_zero_sweep", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
 
     if args._probe:
@@ -1644,6 +1823,8 @@ def main() -> int:
         return _arbitration_sweep(args)
     if args._goodput_sweep:
         return _goodput_sweep(args)
+    if args._zero_sweep:
+        return _zero_sweep(args)
 
     probe_timeout = _env_timeout("RLT_BENCH_PROBE_TIMEOUT", 600.0)
     bench_timeout = _env_timeout("RLT_BENCH_TIMEOUT", 1800.0)
@@ -1740,6 +1921,7 @@ def main() -> int:
                     _attach_compile_sweep(result, here, env)
                     _attach_arbitration_sweep(result, here, env)
                     _attach_goodput_sweep(result, here, env)
+                    _attach_zero_sweep(result, here, env)
                     if _is_on_chip(result):
                         _save_tpu_cache(result, _args_key(args))
                     print(json.dumps(result))
@@ -1792,6 +1974,7 @@ def main() -> int:
         _attach_compile_sweep(result, here, env)
         _attach_arbitration_sweep(result, here, env)
         _attach_goodput_sweep(result, here, env)
+        _attach_zero_sweep(result, here, env)
     if error:
         result.setdefault("detail", {})["error"] = error
     print(json.dumps(result))

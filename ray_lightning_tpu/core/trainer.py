@@ -42,7 +42,13 @@ from ray_lightning_tpu.loggers.csv_logger import CSVLogger
 from ray_lightning_tpu.runtime import compile_cache as _compile_cache
 from ray_lightning_tpu.strategies.base import Strategy, XLAStrategy
 from ray_lightning_tpu.utils import fsio
-from ray_lightning_tpu.utils.precision import cast_floats, parse_precision
+from ray_lightning_tpu.utils.precision import (
+    cast_floats,
+    matmul_precision_scope,
+    parse_matmul_precision,
+    parse_precision,
+    round_matmul_inputs,
+)
 from ray_lightning_tpu.utils.seed import seed_everything
 from ray_lightning_tpu.utils.serialization import to_state_stream, load_state_stream
 
@@ -236,6 +242,14 @@ class Trainer:
         # by _setup_dcn_compression when the strategy enables it; None means
         # the standard GSPMD implicit-all-reduce train step
         self._dcn_ctx = None
+        # explicit-ZeRO context (parallel/zero.py), set by _setup_zero for
+        # RayShardedStrategy(zero_stage>=2) when the model/optimizer shape
+        # qualifies; None means sharding stays GSPMD placement only
+        self._zero_ctx = None
+        self._zero_tx = None  # clip-stripped wrap of the configured tx
+        self._configured_tx = None  # pre-_wrap_tx optax transformation
+        self._train_program = "train_step"  # compile-cache/profiler key
+        self._matmul_precision = "default"  # resolved in _build_train_step
         self._rng_root = None
         self._datamodule = None
         # flight recorder handle: None when telemetry is off, so every
@@ -429,9 +443,13 @@ class Trainer:
         ]
         return jax.tree_util.tree_unflatten(prefix_def, full)
 
-    def _wrap_tx(self, tx) -> optax.GradientTransformation:
-        """Trainer-level knobs applied around any optimizer."""
-        if self.gradient_clip_val:
+    def _wrap_tx(self, tx, skip_clip: bool = False) -> optax.GradientTransformation:
+        """Trainer-level knobs applied around any optimizer. ``skip_clip``
+        is for the explicit-ZeRO step: inside its shard_map the optimizer
+        sees shard-LOCAL gradients, so ``clip_by_global_norm`` would clip
+        by the wrong (per-shard) norm — the step computes the true global
+        norm itself with a psum and pre-scales the gradients."""
+        if self.gradient_clip_val and not skip_clip:
             tx = optax.chain(optax.clip_by_global_norm(self.gradient_clip_val), tx)
         if self.accumulate_grad_batches > 1:
             tx = optax.MultiSteps(tx, every_k_schedule=self.accumulate_grad_batches)
@@ -497,6 +515,9 @@ class Trainer:
             raise TypeError(
                 "configure_optimizers must return an optax.GradientTransformation"
             )
+        # kept un-wrapped so the explicit-ZeRO step can re-wrap with
+        # skip_clip=True (it owns global-norm clipping)
+        self._configured_tx = configured
         return self._wrap_tx(configured)
 
     # ------------------------------------------------------------------ #
@@ -592,6 +613,225 @@ class Trainer:
             "batch_axes": batch_axes,
             "block_size": block_size,
         }
+
+    # ------------------------------------------------------------------ #
+    # explicit ZeRO update sharding (parallel/zero.py, 2004.13336)
+    # ------------------------------------------------------------------ #
+    def _setup_zero(self):
+        """Decide whether the EXPLICIT ZeRO update path runs (reduce-scatter
+        grads -> 1/N optimizer update per rank -> grouped param all-gather
+        inside a shard_map), returning its ZeroContext, or None for the
+        implicit GSPMD-placement path.
+
+        The explicit step assumes ELEMENTWISE optimizer transforms
+        (adam/sgd/rmsprop/adamw/...): per-tensor-norm optimizers
+        (lamb/lars/adafactor) compute tensor statistics that are wrong on
+        a 1/N shard and must stay on the GSPMD path (pass partition_rules
+        to force it).
+        """
+        policy = self.strategy.sharding_policy
+        quantized = bool(getattr(self.strategy, "zero_quantized_allgather", False))
+        if policy.zero_stage < 2:
+            if quantized:
+                raise ValueError(
+                    "zero_quantized_allgather (RLT_ZERO_QUANTIZED_ALLGATHER) "
+                    "requires a ZeRO strategy with zero_stage >= 3, got "
+                    f"zero_stage={policy.zero_stage}"
+                )
+            return None
+        from ray_lightning_tpu.parallel.zero import PAD_UNIT, ZeroContext
+        from ray_lightning_tpu.utils.common import rank_zero_warn
+
+        def fallback(reason):
+            if quantized:
+                raise ValueError(
+                    "zero_quantized_allgather needs the explicit ZeRO update "
+                    f"step, but {reason}"
+                )
+            rank_zero_warn(
+                "explicit ZeRO update path disabled (%s); zero_stage=%d "
+                "falls back to GSPMD sharding propagation",
+                reason,
+                policy.zero_stage,
+            )
+            return None
+
+        if self._alt_txs is not None:
+            return fallback("alternating optimizers are configured")
+        if self._dcn_ctx is not None:
+            return fallback("dcn_grad_compression is active")
+        mesh = self.strategy.mesh
+        module_fn = getattr(self._module, "param_shardings", None)
+        if callable(module_fn) and module_fn(mesh) is not None:
+            return fallback("the module owns its sharding layout")
+        if self.strategy.partition_rules:
+            return fallback(
+                "partition_rules are set (rules define a GSPMD placement)"
+            )
+        data_axes = [
+            a
+            for a in policy.data_axes
+            if a in mesh.axis_names and mesh.shape[a] > 1
+        ]
+        non_data = [
+            a
+            for a in mesh.axis_names
+            if a not in policy.data_axes and mesh.shape[a] > 1
+        ]
+        if len(data_axes) > 1 or non_data:
+            return fallback(
+                f"needs a single data axis (data axes {data_axes}, model "
+                f"axes {non_data})"
+            )
+        axis = data_axes[0] if data_axes else policy.data_axes[0]
+        if axis not in mesh.axis_names:
+            return fallback(f"data axis {axis!r} missing from the mesh")
+        n = int(mesh.shape[axis])
+        if PAD_UNIT % n:
+            return fallback(
+                f"world size {n} does not divide the padding unit "
+                f"{PAD_UNIT} (padded shapes would depend on the world size "
+                "and break elastic state handoff)"
+            )
+        ctx = ZeroContext(
+            mesh,
+            axis,
+            self._param_shape_tree,
+            stage=policy.zero_stage,
+            min_shard_size=policy.min_shard_size,
+            quantized=quantized,
+            gather_group_size=getattr(
+                self.strategy, "zero_gather_group_size", 8
+            ),
+        )
+        if not ctx.big_leaves:
+            return fallback(
+                f"no float param leaf reaches min_shard_size="
+                f"{policy.min_shard_size}"
+            )
+        self._zero_tx = self._wrap_tx(self._configured_tx, skip_clip=True)
+        self._publish_zero_telemetry(ctx)
+        return ctx
+
+    def _publish_zero_telemetry(self, ctx) -> None:
+        """Wire-cost gauges for the ZeRO param all-gather: what the
+        configured gather costs per step vs what an fp32 gather would —
+        the quantization win as numbers, next to the profiler's
+        rlt_collective_bytes_total for the same program."""
+        reg = obs.registry()
+        if reg is None:
+            return
+        reg.gauge(
+            "rlt_zero_allgather_bytes", program="zero_train_step"
+        ).set(float(ctx.gather_wire_bytes()))
+        reg.gauge(
+            "rlt_zero_allgather_fp32_bytes", program="zero_train_step"
+        ).set(float(ctx.gather_fp32_bytes()))
+        reg.gauge("rlt_zero_sharded_params").set(float(len(ctx.big_leaves)))
+
+    def _build_zero_train_step(self):
+        """The explicit ZeRO train step: grads reduce-scattered over the
+        data axis, optimizer update on this rank's 1/N shard (fp32 masters
+        at stage 3, re-sliced params at stage 2), updated params
+        all-gathered per layer group — optionally as an int8 block-scaled
+        payload with error feedback carried in the ZeroState."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ray_lightning_tpu.parallel.zero import ZeroState
+
+        module = self._module
+        policy = self.precision_policy
+        compute_dtype = policy.compute_dtype
+        ctx = self._zero_ctx
+        tx = self._zero_tx
+        axis = ctx.axis
+        clip = self.gradient_clip_val
+        mp = self._matmul_precision
+        state_specs = ctx.state_specs(self._opt_state)
+
+        def _mean(v):
+            return (
+                jax.lax.pmean(v, axis)
+                if ctx.n > 1
+                and jnp.issubdtype(jnp.result_type(v), jnp.inexact)
+                else v
+            )
+
+        def train_step(params, zstate, batch, rng_root, step):
+            with matmul_precision_scope(mp):
+                rng = jax.random.fold_in(rng_root, step)
+                batch = cast_floats(batch, compute_dtype)
+                batch = round_matmul_inputs(mp, batch)
+
+                def loss_fn(p):
+                    if policy.cast_params_in_compute:
+                        p = cast_floats(p, compute_dtype)
+                    p = round_matmul_inputs(mp, p)
+                    module._capture_begin("train", rng)
+                    out = module.training_step(p, batch, step)
+                    logs = module._capture_end()
+                    if isinstance(out, dict):
+                        loss = out["loss"]
+                        mutated = out.get("mutated_params")
+                    else:
+                        loss, mutated = out, None
+                    return loss, (logs, mutated)
+
+                (loss, (logs, mutated)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                # batch-mean big grads land as this rank's [chunk] slice
+                mixed_g = ctx.scatter_grads(grads)
+                if clip:
+                    gnorm = ctx.global_grad_norm(mixed_g)
+                    scale = jnp.minimum(
+                        1.0, clip / jnp.maximum(gnorm, 1e-12)
+                    )
+                    mixed_g = jax.tree_util.tree_map(
+                        lambda g: g * scale.astype(g.dtype)
+                        if jnp.issubdtype(g.dtype, jnp.floating)
+                        else g,
+                        mixed_g,
+                    )
+                cur = ctx.current_mixed(params, zstate.masters)
+                updates, new_inner = tx.update(mixed_g, zstate.inner, cur)
+                new_mixed = optax.apply_updates(cur, updates)
+                new_params, new_masters, new_ef = ctx.gather_params(
+                    params, new_mixed, zstate.gather_ef
+                )
+                if mutated is not None and isinstance(new_params, dict):
+                    # forward-mutated collections (e.g. batch_stats) are
+                    # device-varying here — average them like DDP buffers
+                    mutated = jax.tree_util.tree_map(_mean, mutated)
+                    new_params = {
+                        k: (
+                            mutated[k]
+                            if (k != "params" and k in mutated)
+                            else v
+                        )
+                        for k, v in new_params.items()
+                    }
+                logs = {k: _mean(v) for k, v in logs.items()}
+                logs.setdefault("loss", _mean(loss))
+                return (
+                    new_params,
+                    ZeroState(new_inner, new_masters, tuple(new_ef)),
+                    logs,
+                )
+
+        mapped = shard_map(
+            train_step,
+            mesh=ctx.mesh,
+            in_specs=(P(), state_specs, P(axis), P(), P()),
+            out_specs=(P(), state_specs, P()),
+            check_rep=False,
+        )
+        # distinct program name: its cost report (and the profiler's
+        # collective attribution) must not collide with "train_step"
+        return _compile_cache.wrap(
+            jax.jit(mapped, donate_argnums=(0, 1)), "zero_train_step"
+        )
 
     def _stack_ef_residual(self, opt_state):
         """The error-feedback residual is device-varying over the dcn axis
@@ -713,24 +953,34 @@ class Trainer:
     # compiled steps
     # ------------------------------------------------------------------ #
     def _build_train_step(self):
+        # resolved at build time so RLT_MATMUL_PRECISION set after the
+        # Trainer ctor (or per elastic relaunch) still applies
+        self._matmul_precision = parse_matmul_precision()
+        self._train_program = "train_step"
         if self._alt_txs is not None:
             return self._build_alternating_train_step()
         if self._dcn_ctx is not None:
             return self._build_compressed_train_step()
+        if self._zero_ctx is not None:
+            self._train_program = "zero_train_step"
+            return self._build_zero_train_step()
         module = self._module
         tx = self._tx
         policy = self.precision_policy
         compute_dtype = policy.compute_dtype
+        mp = self._matmul_precision
 
-        def train_step(params, opt_state, batch, rng_root, step):
+        def _step_body(params, opt_state, batch, rng_root, step):
             rng = jax.random.fold_in(rng_root, step)
             batch = cast_floats(batch, compute_dtype)
+            batch = round_matmul_inputs(mp, batch)
 
             def loss_fn(p):
                 if policy.cast_params_in_compute:
                     # mixed precision: forward/backward on a bf16 view of
                     # the fp32 masters (grads flow back to the masters)
                     p = cast_floats(p, compute_dtype)
+                p = round_matmul_inputs(mp, p)
                 module._capture_begin("train", rng)
                 out = module.training_step(p, batch, step)
                 logs = module._capture_end()
@@ -757,6 +1007,12 @@ class Trainer:
             logs = dict(logs)
             logs.setdefault("loss", loss)
             return new_params, new_opt_state, logs
+
+        def train_step(params, opt_state, batch, rng_root, step):
+            # the precision scope is active while the body TRACES, which is
+            # when jax.default_matmul_precision takes effect under jit
+            with matmul_precision_scope(mp):
+                return _step_body(params, opt_state, batch, rng_root, step)
 
         return _compile_cache.wrap(
             jax.jit(train_step, donate_argnums=(0, 1)), "train_step"
@@ -936,9 +1192,13 @@ class Trainer:
             host_params = jax.tree_util.tree_map(
                 lambda a: np.zeros(a.shape, a.dtype), host_params
             )
-        self._params = self.strategy.place_params(host_params)
         self._tx = self._normalize_tx(model.configure_optimizers())
         self._dcn_ctx = self._setup_dcn_compression()
+        # explicit-ZeRO decision needs the optimizer/dcn verdicts above and
+        # must precede placement: its step keeps params REPLICATED (the
+        # shards live in the ZeroState masters, not in GSPMD placement)
+        self._zero_ctx = self._setup_zero()
+        self._params = self._place_params(host_params)
         if self._dcn_ctx is not None:
             from ray_lightning_tpu.parallel.compression import (
                 two_phase_dcn_reduce,
@@ -977,11 +1237,18 @@ class Trainer:
                 )
             # alternating: one state per optimizer, advanced sequentially
             init_fn = lambda p: tuple(tx.init(p) for tx in self._alt_txs)
+        elif self._zero_ctx is not None:
+            # reads self._zero_ctx at CALL time: an elastic resize swaps in
+            # the new-world context and this very closure re-initializes
+            init_fn = lambda p: self._zero_ctx.init_state(self._zero_tx, p)
         else:
             init_fn = self._tx.init
         self._opt_init_fn = init_fn  # elastic resizes re-init from this
         opt_shapes = jax.eval_shape(init_fn, self._params)
-        opt_shardings = self.strategy.optstate_shardings(opt_shapes)
+        if self._zero_ctx is not None:
+            opt_shardings = self._zero_ctx.state_shardings(opt_shapes)
+        else:
+            opt_shardings = self.strategy.optstate_shardings(opt_shapes)
         if opt_shardings is None:
             # moments inherit the param shardings through XLA propagation
             self._opt_state = jax.jit(init_fn)(self._params)
@@ -1259,6 +1526,33 @@ class Trainer:
             return agent.wait_for_resize()
         return None
 
+    def _place_params(self, host_params):
+        """Host params -> device arrays. Under the explicit ZeRO step the
+        params stay REPLICATED (the 1/N shards live in the ZeroState, not
+        in GSPMD placement); otherwise the strategy's policy decides."""
+        if self._zero_ctx is not None:
+            repl = self.strategy.replicated
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, repl), host_params
+            )
+        return self.strategy.place_params(host_params)
+
+    def _host_opt_state(self):
+        """Optimizer state as host-readable arrays. Explicit-ZeRO state is
+        sharded across processes, so a multi-process run gathers it to
+        replicated through a tiny jitted identity first (device_get cannot
+        read other processes' shards)."""
+        if self._zero_ctx is not None and jax.process_count() > 1:
+            repl = self.strategy.replicated
+            shardings = jax.tree_util.tree_map(
+                lambda _: repl, self._opt_state
+            )
+            gathered = jax.jit(lambda s: s, out_shardings=shardings)(
+                self._opt_state
+            )
+            return jax.device_get(gathered)
+        return jax.device_get(self._opt_state)
+
     def _salvage_live_state(self):
         """Host copies of (params, opt_state) if still readable. A failed
         train step poisons its donated inputs — those read back as deleted
@@ -1268,7 +1562,7 @@ class Trainer:
             for leaf in jax.tree_util.tree_leaves((self._params, self._opt_state)):
                 if hasattr(leaf, "is_deleted") and leaf.is_deleted():
                     return None
-            return jax.device_get((self._params, self._opt_state))
+            return (jax.device_get(self._params), self._host_opt_state())
         except Exception:
             return None
 
@@ -1278,7 +1572,7 @@ class Trainer:
         initialized at the new world size (mirrors ``_restore_checkpoint``)."""
         host_params, host_opt = salvage
         host_params = cast_floats(host_params, self.precision_policy.param_dtype)
-        self._params = self.strategy.place_params(host_params)
+        self._params = self._place_params(host_params)
         if host_opt is not None and self._opt_state is not None:
             self._opt_state = jax.tree_util.tree_map(
                 lambda tmpl, h: jax.device_put(h, tmpl.sharding)
@@ -1379,12 +1673,27 @@ class Trainer:
         self._rng_root = jax.random.key(self._seed_used)
 
         # -- rebuild placed templates exactly as _fit_impl does ------------
+        if self._zero_ctx is not None:
+            # re-chunk the ZeRO layout for the new world size; PAD_UNIT is
+            # world-independent, so the padded GLOBAL shapes — and with
+            # them the handoff/checkpoint state trees — are unchanged
+            new_ctx = self._setup_zero()
+            if new_ctx is None:
+                raise RuntimeError(
+                    f"elastic {cmd.kind} to world {new_world}: the explicit "
+                    "ZeRO layout cannot be rebuilt at this size and its "
+                    "optimizer state does not transfer to the GSPMD path"
+                )
+            self._zero_ctx = new_ctx
         host_zeros = jax.tree_util.tree_map(
             lambda s: np.zeros(s.shape, s.dtype), self._param_shape_tree
         )
-        self._params = strategy.place_params(host_zeros)
+        self._params = self._place_params(host_zeros)
         opt_shapes = jax.eval_shape(self._opt_init_fn, self._params)
-        opt_shardings = strategy.optstate_shardings(opt_shapes)
+        if self._zero_ctx is not None:
+            opt_shardings = self._zero_ctx.state_shardings(opt_shapes)
+        else:
+            opt_shardings = strategy.optstate_shardings(opt_shapes)
         if opt_shardings is None:
             self._opt_state = jax.jit(self._opt_init_fn)(self._params)
         else:
@@ -1559,7 +1868,7 @@ class Trainer:
                     if _first:
                         # one-time AOT cost analysis of the compiled step
                         prof.analyze(
-                            "train_step",
+                            self._train_program,
                             train_step,
                             (
                                 self._params,
@@ -1901,7 +2210,7 @@ class Trainer:
         if not weights_only:
             if self._opt_state is not None:
                 ckpt["optimizer_state"] = flax_serialization.to_state_dict(
-                    jax.device_get(self._opt_state)
+                    self._host_opt_state()
                 )
             from ray_lightning_tpu.callbacks.base import collect_callback_states
 
@@ -2012,10 +2321,10 @@ class Trainer:
             jax.device_get(self._params), ckpt["state_dict"]
         )
         host_params = cast_floats(host_params, self.precision_policy.param_dtype)
-        self._params = self.strategy.place_params(host_params)
+        self._params = self._place_params(host_params)
         if "optimizer_state" in ckpt and self._opt_state is not None:
             host_opt = flax_serialization.from_state_dict(
-                jax.device_get(self._opt_state), ckpt["optimizer_state"]
+                self._host_opt_state(), ckpt["optimizer_state"]
             )
             # the freshly-initialized opt_state is the sharding template —
             # restore each leaf with the sharding it already has (works for

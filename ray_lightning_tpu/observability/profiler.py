@@ -604,10 +604,22 @@ class FleetProfiler:
         if step >= w["stop_after"]:
             self._end_window(starved_s)
 
+    def _train_report(self):
+        """The training-step cost report under whichever program name the
+        trainer compiled it as ("train_step", or "zero_train_step" for the
+        explicit-ZeRO path)."""
+        rep = self._reports.get("train_step")
+        if rep is not None:
+            return rep
+        for program, rep in self._reports.items():
+            if program.endswith("train_step"):
+                return rep
+        return None
+
     def _emit_attr_spans(self, step: int, duration_s: float) -> None:
         """Per-step breakdown sub-spans on an "attribution" track."""
         rec = self._recorder
-        rep = self._reports.get("train_step")
+        rep = self._train_report()
         if rec is None or rep is None or duration_s <= 0:
             return
         try:
@@ -655,7 +667,7 @@ class FleetProfiler:
             peak_bytes_s = detect_peak_bandwidth_gbps() * 1e9
         except Exception:
             return out
-        rep = self._reports.get("train_step")
+        rep = self._train_report()
         compute_s = rep.flops / peak_flops_s if rep else 0.0
         collective_s = rep.collective_bytes / peak_bytes_s if rep else 0.0
         transfer_s = batch_bytes / peak_bytes_s
@@ -668,6 +680,15 @@ class FleetProfiler:
             host_input_s=round(host_s, 6),
             unattributed_s=round(max(0.0, mean - attributed), 6),
         )
+        if rep is not None and rep.collectives:
+            # per-op wait attribution: under explicit ZeRO the interesting
+            # movement is all-gather seconds SHRINKING when the int8 gather
+            # is on, not just total collective time shuffling between ops
+            out["collective_breakdown"] = {
+                op: round(info.get("bytes", 0) / peak_bytes_s, 6)
+                for op, info in sorted(rep.collectives.items())
+            }
+            out["program"] = rep.program
         return out
 
     def _publish_measured(self) -> None:

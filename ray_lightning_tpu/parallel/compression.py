@@ -291,3 +291,31 @@ def two_phase_dcn_reduce(
         )
 
     return compressor
+
+
+def quantized_all_gather(
+    shard: jnp.ndarray,
+    axis_name: str,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """EQuARX-style quantized param all-gather (inside shard_map).
+
+    ``shard`` is this rank's fp32 1-D segment (length a multiple of
+    ``block_size``). The int8 payload + bf16 block scales ride the wire
+    instead of fp32 — ~3.8x fewer collective bytes. Returns
+    ``(gathered, local_dequant)`` where ``gathered`` is the full [n*c]
+    vector dequantized IDENTICALLY on every rank (this rank's own segment
+    included — using the exact local shard would diverge the replicated
+    params across ranks), and ``local_dequant`` is what this rank's
+    segment dequantized to, so the caller can carry the quantization
+    error as feedback: ``residual = shard - local_dequant``.
+    """
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    blocks = shard.astype(jnp.float32).reshape(-1, block_size)
+    payload, scales = _quantize_blocks(blocks)
+    local = _dequantize_blocks(payload, scales).reshape(shard.shape)
+    g_payload = lax.all_gather(payload, axis_name, tiled=True)
+    g_scales = lax.all_gather(scales, axis_name, tiled=True)
+    gathered = _dequantize_blocks(g_payload, g_scales).reshape(-1)
+    return gathered.astype(shard.dtype), local.astype(shard.dtype)

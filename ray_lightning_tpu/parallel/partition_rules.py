@@ -1,0 +1,292 @@
+"""User-facing regex partition rules: ordered ``regex -> PartitionSpec``.
+
+The explicit counterpart of ``fsdp_param_shardings``' largest-divisible-axis
+inference: users name tensors by their '/'-joined tree path (the
+``match_partition_rules`` idiom) and the FIRST matching rule claims the
+tensor. Unmatched leaves fall back to the caller-supplied inference, and the
+same resolution is applied to every optax optimizer-state leaf by mirroring
+it onto the param whose path it embeds (mu/nu/trace leaves carry the param's
+path as a suffix), so ZeRO sharding of the update follows the user's rules
+without a second rule set.
+
+Wire syntax (the ``RLT_PARTITION_RULES`` env / ``partition_rules=`` strategy
+knob): ``"regex=spec;regex=spec"`` where ``spec`` is a comma-separated
+``PartitionSpec`` — axis names, ``None`` (or ``-``/``*``) for a replicated
+dim, ``+`` to join axes over one dim (``dp+fsdp``), and the single word
+``replicated`` for ``P()``. Example::
+
+    "attn/.*kernel=None,mp; mlp/.*kernel=fsdp; .*bias=replicated"
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_lightning_tpu.parallel.sharding import path_str, replicated_sharding
+
+SpecEntry = Optional[Union[str, Tuple[str, ...]]]
+
+
+@dataclass(frozen=True)
+class PartitionRule:
+    """One ordered rule: ``pattern`` (``re.search`` over the '/'-joined
+    path) claims a leaf and shards it as ``P(*spec)``."""
+
+    pattern: str
+    spec: Tuple[SpecEntry, ...]
+
+    def partition_spec(self) -> P:
+        return P(*self.spec)
+
+    def __str__(self) -> str:
+        return f"{self.pattern}={_spec_str(self.spec)}"
+
+
+def _spec_str(spec: Tuple[SpecEntry, ...]) -> str:
+    if not spec:
+        return "replicated"
+    return ",".join(
+        "+".join(e) if isinstance(e, tuple) else ("None" if e is None else e)
+        for e in spec
+    )
+
+
+def _parse_spec(text: str, rule_text: str) -> Tuple[SpecEntry, ...]:
+    text = text.strip()
+    if text.lower() in ("replicated", "p()", ""):
+        return ()
+    entries: List[SpecEntry] = []
+    for raw in text.split(","):
+        raw = raw.strip()
+        if raw.lower() in ("none", "-", "*", ""):
+            entries.append(None)
+        elif "+" in raw:
+            axes = tuple(a.strip() for a in raw.split("+") if a.strip())
+            if not axes:
+                raise ValueError(
+                    f"partition rule {rule_text!r}: empty multi-axis entry"
+                )
+            entries.append(axes)
+        else:
+            entries.append(raw)
+    return tuple(entries)
+
+
+def parse_partition_rules(
+    text: Union[str, Sequence[PartitionRule], None]
+) -> Optional[Tuple[PartitionRule, ...]]:
+    """Parse the wire syntax into ordered rules; pass-through for a
+    sequence of :class:`PartitionRule` (or ``(pattern, spec)`` pairs)."""
+    if text is None:
+        return None
+    if not isinstance(text, str):
+        rules = []
+        for item in text:
+            if isinstance(item, PartitionRule):
+                rules.append(item)
+            else:
+                pattern, spec = item
+                if isinstance(spec, str):
+                    spec = _parse_spec(spec, f"{pattern}={spec}")
+                elif isinstance(spec, P):
+                    spec = tuple(spec)
+                rules.append(PartitionRule(pattern, tuple(spec)))
+        return _validated(tuple(rules))
+    rules = []
+    for entry in text.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" not in entry:
+            raise ValueError(
+                f"partition rule {entry!r} is not of the form 'regex=spec' "
+                "(entries are ';'-separated; spec is a ','-separated "
+                "PartitionSpec, e.g. '.*kernel=None,mp')"
+            )
+        pattern, spec_text = entry.rsplit("=", 1)
+        pattern = pattern.strip()
+        rules.append(PartitionRule(pattern, _parse_spec(spec_text, entry)))
+    return _validated(tuple(rules))
+
+
+def _validated(rules: Tuple[PartitionRule, ...]) -> Tuple[PartitionRule, ...]:
+    for rule in rules:
+        try:
+            re.compile(rule.pattern)
+        except re.error as e:
+            raise ValueError(
+                f"partition rule {str(rule)!r}: bad regex ({e})"
+            ) from e
+    return rules
+
+
+def resolve_rule(
+    rules: Sequence[PartitionRule], path: str
+) -> Optional[PartitionRule]:
+    """First-match-wins over the '/'-joined path (``re.search``)."""
+    for rule in rules:
+        if re.search(rule.pattern, path):
+            return rule
+    return None
+
+
+@dataclass
+class RuleMatch:
+    """One leaf's resolution, kept for :meth:`ShardingReport.describe`."""
+
+    path: str
+    shape: Tuple[int, ...]
+    spec: P
+    rule: Optional[str]  # str(rule) for rule-claimed leaves, else None
+    reason: str  # "rule" | "scalar" | "inferred" | "replicated" |
+    #              "replicated_no_divisible_axis" | "inherited"
+
+
+@dataclass
+class ShardingReport:
+    """What claimed every tensor — params and optimizer state."""
+
+    entries: List[RuleMatch] = field(default_factory=list)
+
+    def silently_replicated(self) -> List[RuleMatch]:
+        """Leaves the fallback inference WANTED to shard but could not
+        (no axis divisible by the shard count) — the silent-replication
+        case ``describe`` makes visible."""
+        return [
+            e for e in self.entries
+            if e.reason == "replicated_no_divisible_axis"
+        ]
+
+    def describe(self) -> str:
+        lines = ["tensor shardings (what claimed each tensor):"]
+        for e in self.entries:
+            claim = e.rule if e.rule is not None else e.reason
+            lines.append(
+                f"  {e.path}  {tuple(e.shape)}  -> {e.spec}  [{claim}]"
+            )
+        silent = self.silently_replicated()
+        by_reason: Dict[str, int] = {}
+        for e in self.entries:
+            by_reason[e.reason] = by_reason.get(e.reason, 0) + 1
+        summary = ", ".join(f"{k}={v}" for k, v in sorted(by_reason.items()))
+        lines.append(f"  totals: {len(self.entries)} leaves ({summary})")
+        if silent:
+            lines.append(
+                f"  WARNING: {len(silent)} leaves replicated because no "
+                "axis divides the shard count: "
+                + ", ".join(e.path for e in silent)
+            )
+        return "\n".join(lines)
+
+
+def _mesh_axis_size(mesh: Mesh, entry: SpecEntry, rule: PartitionRule) -> int:
+    axes = entry if isinstance(entry, tuple) else (entry,)
+    size = 1
+    for a in axes:
+        if a is None:
+            continue
+        if a not in mesh.axis_names:
+            raise ValueError(
+                f"partition rule {str(rule)!r} names mesh axis {a!r}, but "
+                f"the mesh only has axes {tuple(mesh.axis_names)}"
+            )
+        size *= mesh.shape[a]
+    return size
+
+
+def sharding_for_rule(
+    mesh: Mesh, rule: PartitionRule, path: str, shape: Tuple[int, ...]
+) -> NamedSharding:
+    """Turn a matched rule into a NamedSharding, validating it against the
+    leaf — every error names the offending rule."""
+    if not shape:
+        # scalars are always replicated (match_partition_rules semantics)
+        return replicated_sharding(mesh)
+    if len(rule.spec) > len(shape):
+        raise ValueError(
+            f"partition rule {str(rule)!r} has {len(rule.spec)} spec entries "
+            f"but matched {path!r} of rank {len(shape)} (shape {shape})"
+        )
+    for dim, entry in zip(shape, rule.spec):
+        size = _mesh_axis_size(mesh, entry, rule)
+        if size > 1 and dim % size:
+            raise ValueError(
+                f"partition rule {str(rule)!r} shards a dim of size {dim} "
+                f"over {size} devices on {path!r} (shape {shape}): not "
+                "divisible"
+            )
+    return NamedSharding(mesh, rule.partition_spec())
+
+
+def apply_partition_rules(
+    mesh: Mesh,
+    params: Any,
+    rules: Sequence[PartitionRule],
+    fallback: Callable[[str, Any], Tuple[NamedSharding, str]],
+    report: Optional[ShardingReport] = None,
+) -> Any:
+    """Resolve every param leaf: first matching rule wins; unmatched leaves
+    go through ``fallback(path, leaf) -> (sharding, reason)``."""
+
+    def leaf_sharding(key_path, leaf):
+        path = path_str(key_path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        rule = resolve_rule(rules, path)
+        if rule is not None:
+            sh = sharding_for_rule(mesh, rule, path, shape)
+            if report is not None:
+                reason = "scalar" if not shape else "rule"
+                report.entries.append(
+                    RuleMatch(path, shape, sh.spec, str(rule), reason)
+                )
+            return sh
+        sh, reason = fallback(path, leaf)
+        if report is not None:
+            report.entries.append(RuleMatch(path, shape, sh.spec, None, reason))
+        return sh
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, params)
+
+
+def optstate_shardings_from_params(
+    mesh: Mesh,
+    opt_state: Any,
+    param_resolutions: Dict[str, Tuple[Tuple[int, ...], NamedSharding]],
+    fallback: Callable[[str, Any], Tuple[NamedSharding, str]],
+    report: Optional[ShardingReport] = None,
+) -> Any:
+    """Optimizer-state leaves inherit their param's resolved sharding.
+
+    An optax state leaf that mirrors a param (mu/nu/trace/…) carries the
+    param's tree path as a SUFFIX of its own ('0/mu/dense/kernel' mirrors
+    'dense/kernel') with the same shape; the longest such suffix wins.
+    Non-mirroring leaves (step counters, scalar schedules) go through the
+    fallback.
+    """
+
+    def leaf_sharding(key_path, leaf):
+        path = path_str(key_path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        best = None
+        for p_path, (p_shape, p_sh) in param_resolutions.items():
+            if shape != p_shape:
+                continue
+            if path == p_path or path.endswith("/" + p_path):
+                if best is None or len(p_path) > len(best[0]):
+                    best = (p_path, p_sh)
+        if best is not None:
+            if report is not None:
+                report.entries.append(
+                    RuleMatch(path, shape, best[1].spec, None, "inherited")
+                )
+            return best[1]
+        sh, reason = fallback(path, leaf)
+        if report is not None:
+            report.entries.append(RuleMatch(path, shape, sh.spec, None, reason))
+        return sh
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, opt_state)

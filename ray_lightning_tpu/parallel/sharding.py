@@ -11,10 +11,26 @@ the generic largest-divisible-axis rule below.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Optional, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def path_str(key_path) -> str:
+    """'/'-joined tree path for tree_map_with_path keys — the name space
+    partition rules match against (and warnings print)."""
+    parts = []
+    for k in key_path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
 
 
 @dataclass(frozen=True)
@@ -70,40 +86,128 @@ def _largest_divisible_axis(shape, divisor: int) -> Optional[int]:
     return best
 
 
+# param-name sets already warned about (one warning per distinct layout,
+# not one per trainer rebuild/elastic resize)
+_SILENT_REPLICATION_WARNED: set = set()
+
+
+def warn_silently_replicated(paths, divisor: int) -> None:
+    """One-shot warning naming params that stayed replicated although
+    sharding over ``divisor`` devices was requested (no divisible axis)."""
+    from ray_lightning_tpu.utils.common import rank_zero_warn
+
+    paths = tuple(paths)
+    if not paths:
+        return
+    key = (int(divisor), paths)
+    if key in _SILENT_REPLICATION_WARNED:
+        return
+    _SILENT_REPLICATION_WARNED.add(key)
+    rank_zero_warn(
+        "%d params stay REPLICATED although sharding over %d devices was "
+        "requested (no axis divisible by the shard count): %s — pad these "
+        "dims or claim them with a partition rule",
+        len(paths),
+        divisor,
+        ", ".join(paths),
+    )
+
+
+def shard_divisor(mesh: Mesh, shard_axes: Tuple[str, ...]) -> Tuple[Tuple[str, ...], int]:
+    """(usable axes, total shard count) for the largest-divisible-axis rule."""
+    axes = tuple(
+        a for a in shard_axes if a in mesh.axis_names and mesh.shape[a] > 1
+    )
+    divisor = 1
+    for a in axes:
+        divisor *= mesh.shape[a]
+    return axes, divisor
+
+
+def fsdp_leaf_sharding(
+    mesh: Mesh,
+    leaf: Any,
+    shard_axes: Tuple[str, ...],
+    min_shard_size: int = 2**14,
+) -> Tuple[NamedSharding, str]:
+    """One leaf through the largest-divisible-axis rule; returns the
+    sharding plus the reason ("inferred" | "replicated" |
+    "replicated_no_divisible_axis") for describe_shardings()."""
+    axes, divisor = shard_divisor(mesh, shard_axes)
+    shape = getattr(leaf, "shape", ())
+    size = getattr(leaf, "size", 0)
+    if not axes or not shape or size < min_shard_size:
+        return replicated_sharding(mesh), "replicated"
+    axis = _largest_divisible_axis(shape, divisor)
+    if axis is None:
+        return replicated_sharding(mesh), "replicated_no_divisible_axis"
+    spec = [None] * len(shape)
+    spec[axis] = axes[0] if len(axes) == 1 else axes
+    return NamedSharding(mesh, P(*spec)), "inferred"
+
+
 def fsdp_param_shardings(
     mesh: Mesh,
     params: Any,
     shard_axes: Tuple[str, ...],
     min_shard_size: int = 2**14,
+    on_leaf: Optional[Callable[[str, Any, NamedSharding, str], None]] = None,
 ) -> Any:
     """Per-leaf shardings: shard the largest axis divisible by the axis size.
 
     The generic rule that makes *any* model's params/opt-state ZeRO-shardable
     without per-layer annotations — the TPU-native counterpart of FairScale's
     parameter flattening+bucketing (which GSPMD makes unnecessary).
+
+    A leaf big enough to shard whose axes are ALL indivisible by the shard
+    count silently replicates; that costs memory exactly where sharding was
+    requested, so the first time it happens the offending params are named
+    in a one-shot warning (and surfaced to ``on_leaf`` with reason
+    ``"replicated_no_divisible_axis"`` for ``describe_shardings()``).
+    ``on_leaf(path, leaf, sharding, reason)`` observes every resolution.
     """
     axes = tuple(a for a in shard_axes if a in mesh.axis_names and mesh.shape[a] > 1)
     if not axes:
         repl = replicated_sharding(mesh)
-        return jax.tree_util.tree_map(lambda _: repl, params)
+
+        def replicate_all(key_path, leaf):
+            if on_leaf is not None:
+                on_leaf(path_str(key_path), leaf, repl, "replicated")
+            return repl
+
+        return jax.tree_util.tree_map_with_path(replicate_all, params)
     divisor = 1
     for a in axes:
         divisor *= mesh.shape[a]
     spec_entry = axes[0] if len(axes) == 1 else axes
+    silently_replicated = []
 
-    def leaf_sharding(leaf):
+    def leaf_sharding(key_path, leaf):
+        path = path_str(key_path)
         shape = getattr(leaf, "shape", ())
         size = getattr(leaf, "size", 0)
         if not shape or size < min_shard_size:
-            return replicated_sharding(mesh)
+            sh = replicated_sharding(mesh)
+            if on_leaf is not None:
+                on_leaf(path, leaf, sh, "replicated")
+            return sh
         axis = _largest_divisible_axis(shape, divisor)
         if axis is None:
-            return replicated_sharding(mesh)
+            silently_replicated.append(path)
+            sh = replicated_sharding(mesh)
+            if on_leaf is not None:
+                on_leaf(path, leaf, sh, "replicated_no_divisible_axis")
+            return sh
         spec = [None] * len(shape)
         spec[axis] = spec_entry
-        return NamedSharding(mesh, P(*spec))
+        sh = NamedSharding(mesh, P(*spec))
+        if on_leaf is not None:
+            on_leaf(path, leaf, sh, "inferred")
+        return sh
 
-    return jax.tree_util.tree_map(leaf_sharding, params)
+    out = jax.tree_util.tree_map_with_path(leaf_sharding, params)
+    warn_silently_replicated(silently_replicated, divisor)
+    return out
 
 
 def infer_param_shardings(

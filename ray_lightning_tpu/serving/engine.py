@@ -337,8 +337,26 @@ class InferenceEngine:
         )
         from ray_lightning_tpu.ops.rope import rope_angles
 
+        from ray_lightning_tpu.utils.precision import (
+            matmul_precision_scope,
+            parse_matmul_precision,
+            round_matmul_inputs,
+        )
+
         cfg = self.cfg
         ecfg = self.engine_config
+        # the SAME matmul-precision helper the train step applies — the
+        # decode-parity test pins that train and serve cannot drift
+        mp = self._matmul_precision = parse_matmul_precision()
+
+        def _with_precision(fn):
+            def wrapped(params, *rest):
+                with matmul_precision_scope(mp):
+                    params = round_matmul_inputs(mp, params)
+                    return fn(params, *rest)
+
+            return wrapped
+
         # one table covering every position a slot can reach, shared by
         # prefill and decode so rope factors cannot diverge between them
         table = rope_angles(
@@ -419,17 +437,17 @@ class InferenceEngine:
                 return sampled.astype(jnp.int32), cache["k"], cache["v"]
 
             self._prefill_fn = _compile_cache.wrap(
-                jax.jit(prefill_into_paged), "serve_prefill"
+                jax.jit(_with_precision(prefill_into_paged)), "serve_prefill"
             )
             self._decode_fn = _compile_cache.wrap(
-                jax.jit(decode_paged), "serve_decode"
+                jax.jit(_with_precision(decode_paged)), "serve_decode"
             )
         else:
             self._prefill_fn = _compile_cache.wrap(
-                jax.jit(prefill_into), "serve_prefill"
+                jax.jit(_with_precision(prefill_into)), "serve_prefill"
             )
             self._decode_fn = _compile_cache.wrap(
-                jax.jit(decode), "serve_decode"
+                jax.jit(_with_precision(decode)), "serve_decode"
             )
 
     def _program_specs(self):

@@ -24,11 +24,19 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_lightning_tpu.parallel.mesh import MeshSpec, build_mesh
+from ray_lightning_tpu.parallel.partition_rules import (
+    ShardingReport,
+    apply_partition_rules,
+    optstate_shardings_from_params,
+    parse_partition_rules,
+)
 from ray_lightning_tpu.parallel.sharding import (
     ShardingPolicy,
     batch_sharding,
-    infer_param_shardings,
+    fsdp_leaf_sharding,
     replicated_sharding,
+    shard_divisor,
+    warn_silently_replicated,
 )
 
 
@@ -48,6 +56,9 @@ class Strategy:
         prefetch_depth: Optional[int] = None,
         loader_num_workers: Optional[int] = None,
         xla_cache_dir: Optional[str] = None,
+        partition_rules: Optional[Any] = None,
+        zero_quantized_allgather: Optional[bool] = None,
+        zero_gather_group_size: int = 8,
     ):
         self.mesh_spec = mesh_spec or MeshSpec.data_parallel()
         self.sharding_policy = sharding_policy or ShardingPolicy.ddp()
@@ -58,6 +69,10 @@ class Strategy:
         self._prefetch_depth = prefetch_depth
         self._loader_num_workers = loader_num_workers
         self._xla_cache_dir = xla_cache_dir
+        self._partition_rules = partition_rules
+        self._zero_quantized_allgather = zero_quantized_allgather
+        self.zero_gather_group_size = int(zero_gather_group_size)
+        self._sharding_report: Optional[ShardingReport] = None
         self._mesh: Optional[Mesh] = None
         self._trainer = None
         self._module = None
@@ -81,6 +96,40 @@ class Strategy:
                 f"or 'int8', got {mode!r}"
             )
         return mode
+
+    @property
+    def partition_rules(self):
+        """Ordered regex -> PartitionSpec rules claiming param (and, by
+        inheritance, optimizer-state) tensors by tree path. Constructor
+        argument wins (a wire string or a sequence of
+        :class:`~ray_lightning_tpu.parallel.partition_rules.PartitionRule`);
+        otherwise the ``RLT_PARTITION_RULES`` env var
+        (``"regex=spec;regex=spec"``). ``None`` = inference only."""
+        rules = self._partition_rules
+        if rules is None:
+            rules = os.environ.get("RLT_PARTITION_RULES") or None
+        return parse_partition_rules(rules)
+
+    @property
+    def zero_quantized_allgather(self) -> bool:
+        """Quantize the explicit-ZeRO param all-gather (int8 block-scaled
+        payload + error feedback, EQuARX-style). Constructor argument wins;
+        otherwise ``RLT_ZERO_QUANTIZED_ALLGATHER``. Requires
+        ``zero_stage >= 3`` (enforced when the step is built)."""
+        value = self._zero_quantized_allgather
+        if value is None:
+            raw = os.environ.get("RLT_ZERO_QUANTIZED_ALLGATHER", "")
+            if raw == "":
+                return False
+            if raw.lower() in ("1", "true", "yes", "on"):
+                return True
+            if raw.lower() in ("0", "false", "no", "off"):
+                return False
+            raise ValueError(
+                f"RLT_ZERO_QUANTIZED_ALLGATHER must be a boolean flag, got "
+                f"{raw!r}"
+            )
+        return bool(value)
 
     @property
     def heartbeat_interval(self) -> float:
@@ -264,16 +313,60 @@ class Strategy:
 
     def param_shardings(self, params: Any) -> Any:
         # a module may own its sharding layout (e.g. the llama family's
-        # megatron tp + fsdp rules); otherwise apply the generic policy
+        # megatron tp + fsdp rules); otherwise partition rules first, then
+        # the generic largest-divisible-axis inference for unmatched leaves
         module_fn = getattr(self._module, "param_shardings", None)
         if callable(module_fn):
             sh = module_fn(self.mesh)
             if sh is not None:
                 self._optstate_rule = None  # propagate from params via XLA
+                self._sharding_report = None
                 return sh
-        sh, self._optstate_rule = infer_param_shardings(
-            self.mesh, params, self.sharding_policy
+        policy = self.sharding_policy
+        mesh = self.mesh
+        rules = self.partition_rules or ()
+        report = ShardingReport()
+        axes = policy.effective_shard_axes
+
+        if policy.zero_stage >= 3:
+            def fallback(path, leaf):
+                return fsdp_leaf_sharding(
+                    mesh, leaf, axes, policy.min_shard_size
+                )
+        else:
+            repl = replicated_sharding(mesh)
+
+            def fallback(path, leaf):
+                return repl, "replicated"
+
+        sh = apply_partition_rules(mesh, params, rules, fallback, report)
+        _, divisor = shard_divisor(mesh, axes)
+        warn_silently_replicated(
+            [e.path for e in report.silently_replicated()], divisor
         )
+        resolutions: Dict[str, Any] = {}
+        flat_sh, _ = jax.tree_util.tree_flatten(sh)
+        for entry, leaf_sh in zip(report.entries, flat_sh):
+            resolutions[entry.path] = (entry.shape, leaf_sh)
+        self._sharding_report = report
+
+        if policy.zero_stage >= 1:
+            def opt_fallback(path, leaf):
+                return fsdp_leaf_sharding(
+                    mesh, leaf, axes, policy.min_shard_size
+                )
+        else:
+            repl0 = replicated_sharding(mesh)
+
+            def opt_fallback(path, leaf):
+                return repl0, "replicated"
+
+        def optstate_rule(opt_state: Any) -> Any:
+            return optstate_shardings_from_params(
+                mesh, opt_state, resolutions, opt_fallback, report
+            )
+
+        self._optstate_rule = optstate_rule
         return sh
 
     def optstate_shardings(self, opt_state: Any) -> Optional[Any]:
@@ -284,6 +377,18 @@ class Strategy:
         if self._optstate_rule is None:
             return None
         return self._optstate_rule(opt_state)
+
+    def describe_shardings(self) -> str:
+        """Human-readable report of what claimed every tensor (rule /
+        inference / inheritance), including leaves that stayed replicated
+        because no axis divides the shard count. Populated by
+        ``param_shardings``/``optstate_shardings`` during setup."""
+        if self._sharding_report is None:
+            return (
+                "no sharding report: params not resolved yet, or the module "
+                "owns its sharding layout (module.param_shardings)"
+            )
+        return self._sharding_report.describe()
 
     def place_params(self, params: Any) -> Any:
         """Host pytree -> device arrays with the policy's shardings."""
@@ -365,6 +470,9 @@ class XLAStrategy(Strategy):
         prefetch_depth: Optional[int] = None,
         loader_num_workers: Optional[int] = None,
         xla_cache_dir: Optional[str] = None,
+        partition_rules: Optional[Any] = None,
+        zero_quantized_allgather: Optional[bool] = None,
+        zero_gather_group_size: int = 8,
     ):
         super().__init__(
             mesh_spec,
@@ -376,6 +484,9 @@ class XLAStrategy(Strategy):
             prefetch_depth=prefetch_depth,
             loader_num_workers=loader_num_workers,
             xla_cache_dir=xla_cache_dir,
+            partition_rules=partition_rules,
+            zero_quantized_allgather=zero_quantized_allgather,
+            zero_gather_group_size=zero_gather_group_size,
         )
         self._num_devices = devices
 

@@ -66,6 +66,9 @@ class RayStrategy(XLAStrategy):
         prefetch_depth: Optional[int] = None,
         loader_num_workers: Optional[int] = None,
         xla_cache_dir: Optional[str] = None,
+        partition_rules: Optional[Any] = None,
+        zero_quantized_allgather: Optional[bool] = None,
+        zero_gather_group_size: int = 8,
         **kwargs: Any,
     ):
         super().__init__(
@@ -78,6 +81,9 @@ class RayStrategy(XLAStrategy):
             prefetch_depth=prefetch_depth,
             loader_num_workers=loader_num_workers,
             xla_cache_dir=xla_cache_dir,
+            partition_rules=partition_rules,
+            zero_quantized_allgather=zero_quantized_allgather,
+            zero_gather_group_size=zero_gather_group_size,
         )
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")

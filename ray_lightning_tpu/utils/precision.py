@@ -97,3 +97,110 @@ def cast_floats(tree: Any, dtype) -> Any:
         return leaf
 
     return jax.tree_util.tree_map(cast, tree)
+
+
+# --------------------------------------------------------------------------- #
+# matmul precision policy (RLT_MATMUL_PRECISION)
+# --------------------------------------------------------------------------- #
+# Orthogonal to the storage policy above: controls what the MXU does INSIDE
+# every dot/conv, traced into both the train step and serving decode via the
+# single `matmul_precision_scope` helper so the two paths cannot drift.
+#
+#   "default"      -> leave jax's default (bf16 inputs on TPU MXU)
+#   "bf16"         -> explicit lowest-precision passes ("default" lowering)
+#   "highest"      -> full fp32 (three-pass bf16 or native fp32)
+#   "tensorfloat32"-> the middle setting jax exposes ("float32" precision)
+#   "fp8-emulated" -> SOFTWARE emulation: operands are rounded through
+#                     float8_e4m3fn before the matmul (the matmul itself
+#                     still runs at the default precision). A fidelity
+#                     probe for pre-silicon fp8 experiments, not a speedup.
+#
+# `promises_decode_parity(a, b)` states which policies guarantee
+# token-identical greedy decode; the parity test pins that contract.
+
+MATMUL_PRECISION_ENV = "RLT_MATMUL_PRECISION"
+
+_MATMUL_POLICIES = ("default", "bf16", "tensorfloat32", "highest", "fp8-emulated")
+# what each policy asks of jax.default_matmul_precision (None = leave alone)
+_JAX_PRECISION = {
+    "default": None,
+    "bf16": "default",
+    "tensorfloat32": "float32",
+    "highest": "highest",
+    "fp8-emulated": None,
+}
+
+
+def parse_matmul_precision(value: Optional[str] = None) -> str:
+    """Resolve the matmul policy: explicit arg > RLT_MATMUL_PRECISION env >
+    "default". Raises ValueError naming the bad value."""
+    import os
+
+    if value is None:
+        value = os.environ.get(MATMUL_PRECISION_ENV) or "default"
+    key = str(value).strip().lower()
+    aliases = {"fp8": "fp8-emulated", "f32": "highest", "fp32": "highest",
+               "tf32": "tensorfloat32"}
+    key = aliases.get(key, key)
+    if key not in _MATMUL_POLICIES:
+        raise ValueError(
+            f"unknown matmul precision {value!r} (from "
+            f"{MATMUL_PRECISION_ENV} or the precision knob); supported: "
+            f"{list(_MATMUL_POLICIES)}"
+        )
+    return key
+
+
+class _NullScope:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def matmul_precision_scope(policy: Optional[str] = None):
+    """Context manager applying the matmul policy at TRACE time — wrap the
+    ``jax.jit``/trace call of the train step AND the serving decode with
+    this one helper (it is the shared mechanism the parity test pins)."""
+    key = parse_matmul_precision(policy)
+    jax_prec = _JAX_PRECISION[key]
+    if jax_prec is None:
+        return _NullScope()
+    return jax.default_matmul_precision(jax_prec)
+
+
+def round_matmul_inputs(policy: str, *operands):
+    """fp8-emulated support: round float operands through float8_e4m3fn
+    (value grid only — storage and the matmul stay in the original dtype).
+    Operands may be pytrees (a batch tuple, a params dict) — every float
+    leaf is snapped. Identity for every other policy."""
+    if policy != "fp8-emulated":
+        return operands if len(operands) != 1 else operands[0]
+
+    def one(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(jnp.float8_e4m3fn).astype(x.dtype)
+        return x
+
+    out = tuple(jax.tree_util.tree_map(one, x) for x in operands)
+    return out if len(out) != 1 else out[0]
+
+
+def promises_decode_parity(a: Optional[str], b: Optional[str]) -> bool:
+    """Whether two matmul policies promise token-identical greedy decode.
+
+    On CPU (where tests run) matmul precision hints are lowering no-ops, so
+    "default"/"bf16"/"tensorfloat32"/"highest" all promise parity with each
+    other; "fp8-emulated" changes VALUES on every backend and never promises
+    parity with anything but itself.
+    """
+    ka, kb = parse_matmul_precision(a), parse_matmul_precision(b)
+    if ka == kb:
+        return True
+    if "fp8-emulated" in (ka, kb):
+        return False
+    if jax.default_backend() == "cpu":
+        return True
+    # on accelerators only hint-identical policies promise bit parity
+    return _JAX_PRECISION[ka] == _JAX_PRECISION[kb]

@@ -36,6 +36,7 @@ def _isolated_cache_dir(tmp_path, monkeypatch):
     monkeypatch.setenv("RLT_BENCH_COMPILE_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_ARBITRATION_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_GOODPUT_SWEEP", "0")
+    monkeypatch.setenv("RLT_BENCH_ZERO_SWEEP", "0")
 
 
 def _result(value, **detail):
@@ -350,6 +351,58 @@ def test_dcn_sweep_failure_is_reported_not_fatal(monkeypatch, capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["value"] == 42.0
     assert "timeout" in out["detail"]["dcn_compression"]["error"]
+
+
+def test_zero_sweep_attaches_detail(monkeypatch, capsys):
+    """The ZeRO sweep child's JSON lands in detail.zero (CPU-pinned spawn),
+    a failed sweep reports its error without costing the measurement."""
+    monkeypatch.setenv("RLT_BENCH_ZERO_SWEEP", "1")
+    sweep = {
+        "platform": "cpu",
+        "configs": {
+            "ddp": {"step_ms": 2.0},
+            "zero3_int8_gather": {"step_ms": 2.2},
+        },
+        "quantized_allgather_savings": 0.74,
+    }
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append(list(cmd))
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        if "--_zero_sweep" in cmd:
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            return True, dict(sweep), None
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    assert any("--_zero_sweep" in c for c in calls)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 42.0
+    assert out["detail"]["zero"]["quantized_allgather_savings"] == 0.74
+
+
+def test_zero_sweep_failure_is_reported_not_fatal(monkeypatch, capsys):
+    monkeypatch.setenv("RLT_BENCH_ZERO_SWEEP", "1")
+
+    def fake_run(cmd, timeout, env):
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        if "--_zero_sweep" in cmd:
+            return False, None, "timeout after 600s"
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 42.0
+    assert "timeout" in out["detail"]["zero"]["error"]
 
 
 def test_input_sweep_attaches_detail(monkeypatch, capsys):

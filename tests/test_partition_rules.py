@@ -1,0 +1,232 @@
+"""Regex partition rules: wire-syntax parsing, first-match-wins resolution,
+validation errors that name the offending rule, optimizer-state inheritance,
+the strategy knob plumbing (ctor > RLT_PARTITION_RULES env), and the
+describe_shardings report including silent-replication counting."""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_lightning_tpu.parallel.mesh import MeshSpec
+from ray_lightning_tpu.parallel.partition_rules import (
+    PartitionRule,
+    ShardingReport,
+    apply_partition_rules,
+    parse_partition_rules,
+    resolve_rule,
+    sharding_for_rule,
+)
+from ray_lightning_tpu.parallel.sharding import (
+    ShardingPolicy,
+    replicated_sharding,
+)
+from ray_lightning_tpu.strategies.base import XLAStrategy
+from ray_lightning_tpu.strategies.ray_strategies import RayShardedStrategy
+
+pytestmark = pytest.mark.zero
+
+
+def _mesh(dp=8):
+    return Mesh(np.array(jax.devices()[:dp]).reshape(dp), ("dp",))
+
+
+# --------------------------------------------------------------------- #
+# wire syntax
+# --------------------------------------------------------------------- #
+def test_parse_wire_syntax():
+    rules = parse_partition_rules(
+        "attn/.*kernel=None,dp; mlp/.*kernel=dp+fsdp; .*bias=replicated"
+    )
+    assert [r.pattern for r in rules] == [
+        "attn/.*kernel", "mlp/.*kernel", ".*bias",
+    ]
+    assert rules[0].spec == (None, "dp")
+    assert rules[1].spec == (("dp", "fsdp"),)
+    assert rules[2].spec == ()
+    assert rules[2].partition_spec() == P()
+
+
+def test_parse_spec_aliases():
+    rules = parse_partition_rules("a=-,dp; b=*,None; c=P()")
+    assert rules[0].spec == (None, "dp")
+    assert rules[1].spec == (None, None)
+    assert rules[2].spec == ()
+
+
+def test_parse_passthrough_pairs():
+    rules = parse_partition_rules([("kernel", "None,dp"), ("bias", P())])
+    assert rules[0].spec == (None, "dp")
+    assert rules[1].spec == ()
+    assert parse_partition_rules(rules) == rules
+    assert parse_partition_rules(None) is None
+
+
+def test_parse_rejects_malformed_entry():
+    with pytest.raises(ValueError, match="not of the form"):
+        parse_partition_rules("kernel")
+
+
+def test_parse_rejects_bad_regex():
+    with pytest.raises(ValueError, match=r"\*kernel"):
+        parse_partition_rules("*kernel=dp")
+
+
+# --------------------------------------------------------------------- #
+# resolution + validation
+# --------------------------------------------------------------------- #
+def test_first_match_wins():
+    rules = parse_partition_rules("dense_1/kernel=replicated; kernel=dp")
+    assert resolve_rule(rules, "dense_1/kernel").spec == ()
+    assert resolve_rule(rules, "dense_0/kernel").spec == ("dp",)
+    assert resolve_rule(rules, "dense_0/bias") is None
+
+
+def test_bad_spec_error_names_the_rule():
+    mesh = _mesh()
+    rule = PartitionRule("kernel", ("dp",))
+    # dim 6 not divisible by 8 devices: the error must carry the rule text
+    with pytest.raises(ValueError, match=r"'kernel=dp'"):
+        sharding_for_rule(mesh, rule, "net/kernel", (6, 4))
+    # unknown mesh axis
+    with pytest.raises(ValueError, match="names mesh axis 'tp'"):
+        sharding_for_rule(mesh, PartitionRule("kernel", ("tp",)), "k", (8, 4))
+    # rank mismatch
+    with pytest.raises(ValueError, match="rank 1"):
+        sharding_for_rule(
+            mesh, PartitionRule("b", (None, "dp")), "net/b", (8,)
+        )
+
+
+def test_scalar_leaves_replicated_even_when_claimed():
+    mesh = _mesh()
+    sh = sharding_for_rule(mesh, PartitionRule(".*", ("dp",)), "count", ())
+    assert sh.spec == P()
+
+
+def test_apply_rules_with_fallback_and_report():
+    mesh = _mesh()
+    params = {
+        "dense": {"kernel": jnp.zeros((16, 8)), "bias": jnp.zeros((8,))},
+        "head": {"kernel": jnp.zeros((8, 4))},
+    }
+    report = ShardingReport()
+    rules = parse_partition_rules("dense/kernel=dp")
+
+    def fallback(path, leaf):
+        return replicated_sharding(mesh), "replicated"
+
+    sh = apply_partition_rules(mesh, params, rules, fallback, report)
+    assert sh["dense"]["kernel"].spec == P("dp")
+    assert sh["dense"]["bias"].spec == P()
+    assert sh["head"]["kernel"].spec == P()
+    reasons = {e.path: e.reason for e in report.entries}
+    assert reasons["dense/kernel"] == "rule"
+    assert reasons["dense/bias"] == "replicated"
+    text = report.describe()
+    assert "dense/kernel" in text and "dense/kernel=dp" in text
+
+
+# --------------------------------------------------------------------- #
+# strategy plumbing: params, opt-state inheritance, env knob, report
+# --------------------------------------------------------------------- #
+def _strategy(**kw):
+    kw.setdefault("mesh_spec", MeshSpec(axes={"dp": -1}))
+    kw.setdefault(
+        "sharding_policy",
+        ShardingPolicy(zero_stage=1, data_axes=("dp",), min_shard_size=1),
+    )
+    return XLAStrategy(**kw)
+
+
+def test_strategy_param_and_optstate_rules():
+    strategy = _strategy(partition_rules="dense/kernel=dp; .*=replicated")
+    params = {
+        "dense": {"kernel": jnp.zeros((16, 8)), "bias": jnp.zeros((8,))},
+    }
+    sh = strategy.param_shardings(params)
+    assert sh["dense"]["kernel"].spec == P("dp")
+    assert sh["dense"]["bias"].spec == P()
+    # optimizer state inherits by param-path suffix with matching shape
+    opt_state = optax.adam(1e-3).init(params)
+    osh = strategy.optstate_shardings(opt_state)
+    mu = osh[0].mu
+    assert mu["dense"]["kernel"].spec == P("dp")
+    assert mu["dense"]["bias"].spec == P()
+    # the scalar adam step counter goes through the fallback, not a rule
+    flat = jax.tree_util.tree_leaves(osh)
+    assert all(hasattr(s, "spec") for s in flat)
+    report = strategy.describe_shardings()
+    assert "inherited" in report and "dense/kernel=dp" in report
+
+
+def test_strategy_unmatched_falls_back_to_inference():
+    # zero-3: unmatched big leaves go through largest-divisible-axis fsdp
+    strategy = _strategy(
+        sharding_policy=ShardingPolicy(
+            zero_stage=3, data_axes=("dp",), min_shard_size=1
+        ),
+        partition_rules="bias=replicated",
+    )
+    params = {"dense": {"kernel": jnp.zeros((16, 8)), "bias": jnp.zeros((8,))}}
+    sh = strategy.param_shardings(params)
+    # kernel: unmatched -> inferred over dp on the largest divisible axis
+    assert sh["dense"]["kernel"].spec != P()
+    assert sh["dense"]["bias"].spec == P()
+    assert "inferred" in strategy.describe_shardings()
+
+
+def test_strategy_counts_silently_replicated(recwarn):
+    strategy = _strategy(
+        sharding_policy=ShardingPolicy(
+            zero_stage=3, data_axes=("dp",), min_shard_size=1
+        ),
+    )
+    # 3x5: inference wants to shard over 8 devices, no divisible axis
+    params = {"odd": {"kernel": jnp.zeros((3, 5))}}
+    sh = strategy.param_shardings(params)
+    assert sh["odd"]["kernel"].spec == P()
+    report = strategy.describe_shardings()
+    assert "WARNING" in report and "odd/kernel" in report
+
+
+def test_env_knob_and_ctor_precedence(monkeypatch):
+    monkeypatch.setenv("RLT_PARTITION_RULES", "kernel=dp")
+    strategy = _strategy()
+    assert strategy.partition_rules[0].pattern == "kernel"
+    # ctor wins over env
+    strategy = _strategy(partition_rules="bias=replicated")
+    assert strategy.partition_rules[0].pattern == "bias"
+    monkeypatch.delenv("RLT_PARTITION_RULES")
+    assert _strategy().partition_rules is None
+
+
+def test_quantized_allgather_knob(monkeypatch):
+    assert _strategy().zero_quantized_allgather is False
+    assert _strategy(zero_quantized_allgather=True).zero_quantized_allgather
+    monkeypatch.setenv("RLT_ZERO_QUANTIZED_ALLGATHER", "yes")
+    assert _strategy().zero_quantized_allgather is True
+    monkeypatch.setenv("RLT_ZERO_QUANTIZED_ALLGATHER", "off")
+    assert _strategy().zero_quantized_allgather is False
+    monkeypatch.setenv("RLT_ZERO_QUANTIZED_ALLGATHER", "maybe")
+    with pytest.raises(ValueError, match="RLT_ZERO_QUANTIZED_ALLGATHER"):
+        _strategy().zero_quantized_allgather
+
+
+def test_ray_strategy_knobs_survive_pickling():
+    strategy = RayShardedStrategy(
+        num_workers=2,
+        zero_stage=3,
+        platform="cpu",
+        partition_rules="kernel=dp",
+        zero_quantized_allgather=True,
+        zero_gather_group_size=4,
+    )
+    clone = pickle.loads(pickle.dumps(strategy))
+    assert clone.partition_rules[0].pattern == "kernel"
+    assert clone.zero_quantized_allgather is True
+    assert clone.zero_gather_group_size == 4
+    assert clone.zero_stage == 3
