@@ -1513,6 +1513,220 @@ def _attach_zero_sweep(result: dict, here: str, env: dict) -> None:
         }
 
 
+def _speculative_sweep(args: argparse.Namespace) -> int:
+    """Child: the self-speculation sweep (--_speculative_sweep).
+
+    Serves a copy-heavy workload (repetitive prompts on a tiny float32
+    model — the regime prompt-lookup speculation exists for) at
+    ``speculate_k`` in {0, 2, 4} and reports tokens/s, decode ticks and
+    accepted-tokens-per-slot-tick at each k, plus the token-identity
+    verdict across all k (the promises_decode_parity contract: k must
+    never change a token). CPU-pinned like the other sweeps — this
+    measures the acceptance math and the tick-count win, not chip FLOPs.
+    """
+    import dataclasses
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.llama import LlamaConfig, init_params
+    from ray_lightning_tpu.serving import EngineConfig, InferenceEngine
+
+    # small vocab + periodic prompts push greedy decode into loops the
+    # n-gram proposer can ride — the copy-heavy regime
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(), dtype=jnp.float32, vocab_size=32
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [
+        [3, 7, 11, 3, 7, 11, 3, 7],
+        [5, 5, 9, 5, 5, 9, 5, 5],
+        [2, 4, 6, 8, 2, 4, 6, 8],
+        [13, 1, 13, 1, 13, 1, 13, 1],
+        [6, 6, 6, 6, 6, 6, 6, 6],
+        [9, 2, 7, 9, 2, 7, 9, 2],
+    ]
+    max_new = int(os.environ.get("RLT_BENCH_SPECULATIVE_TOKENS", "40"))
+    k_levels = []
+    streams = {}
+    for k in (0, 2, 4):
+        engine = InferenceEngine(
+            params,
+            cfg,
+            EngineConfig(
+                num_slots=4, max_prompt_len=8, max_len=64,
+                temperature=0.0, speculate_k=k,
+            ),
+        )
+        comps = [
+            engine.submit(p, max_new_tokens=max_new) for p in prompts
+        ]
+        # compile off the clock: one step builds both programs
+        engine.step()
+        t0 = time.perf_counter()
+        engine.run_until_idle()
+        wall = time.perf_counter() - t0
+        streams[k] = [c.tokens for c in comps]
+        st = engine.stats
+        level = {
+            "k": k,
+            "tokens_per_sec": round(st["tokens_out"] / max(wall, 1e-9), 2),
+            "decode_ticks": int(st["decode_steps"]),
+            "tokens_out": int(st["tokens_out"]),
+            "compile_stats": engine.compile_stats(),
+        }
+        if k > 0:
+            level["accepted_per_tick"] = round(
+                st["accepted_tokens"] / max(st["spec_row_ticks"], 1), 3
+            )
+        k_levels.append(level)
+    payload = {
+        "platform": "cpu",
+        "preset": "copy-heavy",
+        "k_levels": k_levels,
+        "accepted_per_tick_k4": next(
+            lvl.get("accepted_per_tick") for lvl in k_levels if lvl["k"] == 4
+        ),
+        "token_identical": all(
+            streams[k] == streams[0] for k in (2, 4)
+        ),
+    }
+    print(json.dumps(payload))
+    return 0
+
+
+def _attach_speculative_sweep(result: dict, here: str, env: dict) -> None:
+    """Attach detail.speculative (self-speculation acceptance + tokens/s
+    at k in {0, 2, 4} and the cross-k token-identity verdict).
+    RLT_BENCH_SPECULATIVE_SWEEP=0 disables."""
+    if os.environ.get("RLT_BENCH_SPECULATIVE_SWEEP", "1") == "0":
+        return
+    sweep_env = dict(env)
+    sweep_env["JAX_PLATFORMS"] = "cpu"
+    ok, sweep, serr = _run(
+        [sys.executable, here, "--_speculative_sweep"],
+        _env_timeout("RLT_BENCH_SPECULATIVE_TIMEOUT", 300.0),
+        sweep_env,
+    )
+    detail = result.setdefault("detail", {})
+    if ok and isinstance(sweep, dict) and "k_levels" in sweep:
+        detail["speculative"] = sweep
+    else:
+        detail["speculative"] = {
+            "error": (sweep or {}).get("error")
+            or serr
+            or "sweep produced no JSON"
+        }
+
+
+def _paged_kernel_sweep(args: argparse.Namespace) -> int:
+    """Child: the fused paged-attention kernel sweep (--_paged_kernel_sweep).
+
+    Times one paged decode step through ``decode_step_paged`` with the
+    Pallas kernel forced ON vs OFF (the lax gather baseline) on the same
+    cache/pool state, checks greedy-token parity between the two, and
+    places the measured step on the roofline (bandwidth_util / MFU via
+    the cost-analysis pass). On CPU the kernel runs in interpret mode, so
+    the ratio is a correctness/plumbing signal there — the bandwidth
+    story is the TPU run's."""
+    import dataclasses
+    import functools
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_lightning_tpu.models.generation import decode_step_paged
+    from ray_lightning_tpu.models.llama import LlamaConfig, init_params
+    from ray_lightning_tpu.observability import profiler as _prof
+    from ray_lightning_tpu.ops.rope import rope_angles
+    from ray_lightning_tpu.serving.paged_kv import PagedKVPool
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    num_slots, max_len = 4, 64
+    pool = PagedKVPool(cfg, num_slots, max_len, block_size=8, num_blocks=64)
+    rng = np.random.default_rng(0)
+    pos_host = np.zeros((num_slots,), np.int32)
+    for i in range(num_slots):
+        slot = pool.acquire(f"r{i}", prompt_len=24, max_new_tokens=30)
+        slot.pos = 23
+        pool.ensure_writable(slot)
+        pos_host[slot.index] = slot.pos
+    table = rope_angles(max_len, cfg.head_dim, cfg.rope_theta)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, num_slots), jnp.int32)
+    pos = jnp.asarray(pos_host)
+    tables = jnp.asarray(pool.block_tables)
+    reps = max(1, int(os.environ.get("RLT_BENCH_PAGED_KERNEL_STEPS", "20")))
+
+    out = {}
+    toks = {}
+    for name, use_kernel in (("kernel", True), ("lax", False)):
+        fn = jax.jit(functools.partial(
+            decode_step_paged, cfg=cfg, rope_table=table, kernel=use_kernel
+        ))
+        logits, _ = fn(params, pool.cache, tokens, pos, tables)
+        jax.block_until_ready(logits)  # compile off the clock
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            logits, _ = fn(params, pool.cache, tokens, pos, tables)
+        jax.block_until_ready(logits)
+        step_s = (time.perf_counter() - t0) / reps
+        toks[name] = np.asarray(jnp.argmax(logits, axis=-1)).tolist()
+        entry = {"decode_step_ms": round(step_s * 1e3, 3)}
+        rep = _prof.analyze_jitted(
+            fn, params, pool.cache, tokens, pos, tables,
+            program=f"paged_decode_{name}",
+        )
+        if rep is not None:
+            roof = _prof.roofline(rep, step_time_s=step_s)
+            entry["bandwidth_util"] = roof.get("bandwidth_util")
+            entry["mfu"] = roof.get("mfu")
+            entry["measured_bound"] = roof.get("measured_bound")
+        out[name] = entry
+    payload = {
+        "platform": "cpu",
+        "interpret": True,
+        "kernel": out["kernel"],
+        "lax": out["lax"],
+        "tokens_identical": toks["kernel"] == toks["lax"],
+        "kernel_vs_lax": round(
+            out["lax"]["decode_step_ms"]
+            / max(out["kernel"]["decode_step_ms"], 1e-9), 3
+        ),
+    }
+    print(json.dumps(payload))
+    return 0
+
+
+def _attach_paged_kernel_sweep(result: dict, here: str, env: dict) -> None:
+    """Attach detail.paged_kernel (fused paged-attention decode step ms +
+    roofline placement, kernel vs lax, with the greedy-token parity
+    verdict). RLT_BENCH_PAGED_KERNEL_SWEEP=0 disables."""
+    if os.environ.get("RLT_BENCH_PAGED_KERNEL_SWEEP", "1") == "0":
+        return
+    sweep_env = dict(env)
+    sweep_env["JAX_PLATFORMS"] = "cpu"
+    ok, sweep, serr = _run(
+        [sys.executable, here, "--_paged_kernel_sweep"],
+        _env_timeout("RLT_BENCH_PAGED_KERNEL_TIMEOUT", 300.0),
+        sweep_env,
+    )
+    detail = result.setdefault("detail", {})
+    if ok and isinstance(sweep, dict) and "kernel" in sweep:
+        detail["paged_kernel"] = sweep
+    else:
+        detail["paged_kernel"] = {
+            "error": (sweep or {}).get("error")
+            or serr
+            or "sweep produced no JSON"
+        }
+
+
 def _last_json_dict(stdout: str):
     for line in reversed((stdout or "").strip().splitlines()):
         try:
@@ -1805,6 +2019,8 @@ def main() -> int:
     parser.add_argument("--_arbitration_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_goodput_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_zero_sweep", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--_speculative_sweep", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--_paged_kernel_sweep", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
 
     if args._probe:
@@ -1825,6 +2041,10 @@ def main() -> int:
         return _goodput_sweep(args)
     if args._zero_sweep:
         return _zero_sweep(args)
+    if args._speculative_sweep:
+        return _speculative_sweep(args)
+    if args._paged_kernel_sweep:
+        return _paged_kernel_sweep(args)
 
     probe_timeout = _env_timeout("RLT_BENCH_PROBE_TIMEOUT", 600.0)
     bench_timeout = _env_timeout("RLT_BENCH_TIMEOUT", 1800.0)
@@ -1922,6 +2142,8 @@ def main() -> int:
                     _attach_arbitration_sweep(result, here, env)
                     _attach_goodput_sweep(result, here, env)
                     _attach_zero_sweep(result, here, env)
+                    _attach_speculative_sweep(result, here, env)
+                    _attach_paged_kernel_sweep(result, here, env)
                     if _is_on_chip(result):
                         _save_tpu_cache(result, _args_key(args))
                     print(json.dumps(result))
@@ -1975,6 +2197,8 @@ def main() -> int:
         _attach_arbitration_sweep(result, here, env)
         _attach_goodput_sweep(result, here, env)
         _attach_zero_sweep(result, here, env)
+        _attach_speculative_sweep(result, here, env)
+        _attach_paged_kernel_sweep(result, here, env)
     if error:
         result.setdefault("detail", {})["error"] = error
     print(json.dumps(result))

@@ -422,6 +422,7 @@ def decode_step_paged(
     block_tables: jnp.ndarray,
     cfg: LlamaConfig,
     rope_table: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    kernel: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """One decode step over a BLOCK-PAGED cache. token: [B] int32; pos:
     [B] int32 (per-row positions, as in ``decode_step_ragged``);
@@ -451,7 +452,22 @@ def decode_step_paged(
 
     Sliding-window configs are refused: block tables map positions 1:1
     to cache slots, which is unsound for rolling buffers.
+
+    ``kernel``: use the fused Pallas block-table-walking attention
+    kernel (ops/paged_attention.py) instead of the gather + einsum read
+    path. ``None`` (default) defers to ``paged_kernel_enabled()``
+    (env ``RLT_PAGED_KERNEL``; off on CPU unless forced, so the default
+    CPU path stays byte-identical to the pre-kernel implementation).
+    The kernel's flash-style accumulation reorders float adds, so logits
+    agree to float tolerance and greedy tokens agree exactly — the
+    parity the serving tests pin.
     """
+    from ray_lightning_tpu.ops.paged_attention import (
+        paged_decode_attention,
+        paged_kernel_enabled,
+    )
+
+    use_kernel = paged_kernel_enabled() if kernel is None else bool(kernel)
     hd = cfg.head_dim
     if cfg.sliding_window:
         raise ValueError(
@@ -497,21 +513,31 @@ def decode_step_paged(
         # because trash contents are never attendable
         k_cache = k_cache.at[phys, :, off, :].set(k.astype(k_cache.dtype))
         v_cache = v_cache.at[phys, :, off, :].set(v.astype(v_cache.dtype))
-        # gather each row's blocks and lay them out in logical order:
-        # [B, max_blocks, Hkv, bs, hd] -> [B, Hkv, max_blocks * bs, hd]
-        kk = k_cache[block_tables].transpose(0, 2, 1, 3, 4).reshape(
-            B, nkv, C, hd
-        )
-        vv = v_cache[block_tables].transpose(0, 2, 1, 3, 4).reshape(
-            B, nkv, C, hd
-        )
         qf = q.reshape(B, nkv, group, hd).astype(jnp.float32)
-        logits = jnp.einsum(
-            "bhgd,bhtd->bhgt", qf, kk.astype(jnp.float32)
-        ) / jnp.sqrt(jnp.float32(hd))
-        logits = jnp.where(valid, logits, -jnp.inf)
-        probs = jax.nn.softmax(logits, axis=-1)
-        att = jnp.einsum("bhgt,bhtd->bhgd", probs, vv.astype(jnp.float32))
+        if use_kernel:
+            # fused path: the kernel walks the block table itself (the
+            # table rides in as a scalar-prefetch operand), so the
+            # [B, Hkv, C, hd] logical gather is never materialized
+            att = paged_decode_attention(
+                qf, k_cache, v_cache, block_tables, pos
+            )
+        else:
+            # gather each row's blocks and lay them out in logical order:
+            # [B, max_blocks, Hkv, bs, hd] -> [B, Hkv, max_blocks*bs, hd]
+            kk = k_cache[block_tables].transpose(0, 2, 1, 3, 4).reshape(
+                B, nkv, C, hd
+            )
+            vv = v_cache[block_tables].transpose(0, 2, 1, 3, 4).reshape(
+                B, nkv, C, hd
+            )
+            logits = jnp.einsum(
+                "bhgd,bhtd->bhgt", qf, kk.astype(jnp.float32)
+            ) / jnp.sqrt(jnp.float32(hd))
+            logits = jnp.where(valid, logits, -jnp.inf)
+            probs = jax.nn.softmax(logits, axis=-1)
+            att = jnp.einsum(
+                "bhgt,bhtd->bhgd", probs, vv.astype(jnp.float32)
+            )
         att = att.reshape(B, nh * hd).astype(x.dtype)
         x = x + att @ lp["wo"]
         h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -522,6 +548,181 @@ def decode_step_paged(
                 lp["moe"], h2[:, None, :], top_k=cfg.expert_top_k
             )
             x = x + moe_out[:, 0]
+        else:
+            gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
+            x = x + gated @ lp["w_down"]
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"]
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
+def _apply_rope_block(x: jnp.ndarray, c: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """x: [B, H, K, hd], row b / query i at its own position; c/s:
+    [B, K, hd/2] gathered per (row, query)."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    c = c[:, None, :, :]
+    s = s[:, None, :, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)
+
+
+def decode_step_verify(
+    params: Dict[str, Any],
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,
+    pos: jnp.ndarray,
+    cfg: LlamaConfig,
+    rope_table: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    block_tables: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Score K candidate positions per row in ONE pass — the verify step
+    of self-speculative decoding. tokens: [B, K] int32, row b's candidate
+    tokens for positions ``pos[b] .. pos[b] + K - 1`` (t_0 is the row's
+    pending token, t_1.. are proposals, the tail is padding for rows with
+    fewer proposals); pos: [B] int32 base positions. Returns
+    (logits [B, K, V] fp32 — logits[b, i] conditions on t_0..t_i — and
+    the updated cache).
+
+    K is STATIC: rows with fewer than K-1 real proposals ride along with
+    padding tokens whose writes are clamped and whose outputs the host
+    discards, so the zero-recompile contract holds at any acceptance
+    pattern. With ``block_tables=None`` the cache is the slot layout
+    ([L, B, Hkv, C, hd], as ``decode_step_ragged``); with block tables it
+    is the paged layout ([L, N, Hkv, bs, hd], as ``decode_step_paged``).
+
+    Why garbage never leaks, in three invariants:
+
+    - query i of row b attends only positions ``<= pos[b] + i`` (the
+      validity mask), and positions ``pos[b] .. pos[b] + K - 1`` are all
+      freshly written THIS call from the fed tokens — so logits[b, i] is
+      exact whenever t_0..t_i are the tokens the model would have
+      emitted, which is precisely the prefix the host accepts;
+    - positions past the accept frontier hold garbage (k, v) from
+      rejected candidates, but the next call rewrites every position it
+      exposes before attending (the same idempotent-rewrite trick that
+      serves prefill's last token), so stale garbage is structurally
+      unreachable;
+    - write positions are CLAMPED to the last cache slot (slot layout)
+      or redirected through the block table (paged: unallocated tail ->
+      trash), and real queries never expose that slot because the
+      serving budget caps real candidate positions at
+      ``prompt_len + max_new_tokens - 2 <= C - 2``.
+
+    Greedy acceptance over these logits is token-identical to stepping
+    ``decode_step_ragged``/``decode_step_paged`` one token at a time —
+    the ``promises_decode_parity`` contract (utils/precision.py) carries
+    over unchanged because the per-position math is the same einsum
+    against the same cache contents.
+
+    Sliding-window configs are refused (the serving pools already refuse
+    them; a rolling buffer's wrap interacts unsoundly with multi-position
+    writes).
+    """
+    hd = cfg.head_dim
+    if cfg.sliding_window:
+        raise ValueError(
+            "decode_step_verify requires dense-causal configs: a rolling "
+            "sliding-window buffer wraps positions at pos % window, and a "
+            "K-position write burst could wrap onto its own still-"
+            "attendable band"
+        )
+    paged = block_tables is not None
+    if paged:
+        bs = cache["k"].shape[3]
+        C = block_tables.shape[1] * bs
+    else:
+        C = cache["k"].shape[3]
+    if rope_table is None:
+        rope_table = _default_table_or_raise(cfg, max(C, cfg.max_seq))
+    cos, sin = rope_table
+    total = int(cos.shape[0])
+    B, K = tokens.shape
+    x = params["embed"][tokens]  # [B, K, D]
+
+    qpos = pos[:, None] + jnp.arange(K)[None, :]  # [B, K] logical positions
+    # rope rows per (row, query); clamp padding queries into the table
+    ridx = jnp.minimum(qpos, total - 1)
+    c = cos[ridx]  # [B, K, hd/2]
+    s = sin[ridx]
+    # write positions: clamped so padding queries past the budget land in
+    # the last slot (slot layout: never attendable, see docstring) or in
+    # the trash-padded block-table tail (paged)
+    wpos = jnp.minimum(qpos, C - 1)  # [B, K]
+    if paged:
+        blk = wpos // bs  # [B, K]
+        phys = jnp.take_along_axis(block_tables, blk, axis=1)  # [B, K]
+        off = wpos % bs
+    else:
+        rows = jnp.arange(B)
+    positions = jnp.arange(C)
+    # [B, K, C]: query i of row b sees cache positions <= pos[b] + i
+    keep = positions[None, None, :] <= qpos[:, :, None]
+    valid = keep[:, None, None, :, :]  # [B, 1, 1, K, C]
+
+    def layer_fn(x, inputs):
+        lp, k_cache, v_cache = inputs
+        nh = lp["wq"].shape[-1] // hd
+        nkv = lp["wk"].shape[-1] // hd
+        group = nh // nkv
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if "bq" in lp:  # Qwen2-family qkv bias
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(B, K, nh, hd).transpose(0, 2, 1, 3)  # [B, nh, K, hd]
+        k = k.reshape(B, K, nkv, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, K, nkv, hd).transpose(0, 2, 1, 3)
+        q = _apply_rope_block(q, c, s)
+        k = _apply_rope_block(k, c, s)
+        # scatter all K (k, v) per row BEFORE attending — query i then
+        # sees candidate positions <= i through the same cache read path
+        # as the one-token steps. [B, nkv, K, hd] -> [B, K, nkv, hd] to
+        # line up with the advanced-indexing result layout.
+        kw = k.transpose(0, 2, 1, 3)
+        vw = v.transpose(0, 2, 1, 3)
+        if paged:
+            k_cache = k_cache.at[phys, :, off, :].set(kw.astype(k_cache.dtype))
+            v_cache = v_cache.at[phys, :, off, :].set(vw.astype(v_cache.dtype))
+            kk = k_cache[block_tables].transpose(0, 2, 1, 3, 4).reshape(
+                B, nkv, C, hd
+            )
+            vv = v_cache[block_tables].transpose(0, 2, 1, 3, 4).reshape(
+                B, nkv, C, hd
+            )
+        else:
+            k_cache = k_cache.at[rows[:, None], :, wpos, :].set(
+                kw.astype(k_cache.dtype)
+            )
+            v_cache = v_cache.at[rows[:, None], :, wpos, :].set(
+                vw.astype(v_cache.dtype)
+            )
+            kk, vv = k_cache, v_cache
+        qf = q.reshape(B, nkv, group, K, hd).astype(jnp.float32)
+        logits = jnp.einsum(
+            "bhgqd,bhtd->bhgqt", qf, kk.astype(jnp.float32)
+        ) / jnp.sqrt(jnp.float32(hd))
+        logits = jnp.where(valid, logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        att = jnp.einsum("bhgqt,bhtd->bhgqd", probs, vv.astype(jnp.float32))
+        att = att.reshape(B, nh, K, hd).transpose(0, 2, 1, 3).reshape(
+            B, K, nh * hd
+        ).astype(x.dtype)
+        x = x + att @ lp["wo"]
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        if cfg.n_experts and "moe" in lp:
+            from ray_lightning_tpu.parallel.moe import moe_ffn_lossless
+
+            # lossless routing, as everywhere at inference: h2 is already
+            # [B, K, D] = [batch, seq, d], the shape moe_ffn_lossless takes
+            x = x + moe_ffn_lossless(lp["moe"], h2, top_k=cfg.expert_top_k)
         else:
             gated = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
             x = x + gated @ lp["w_down"]

@@ -41,6 +41,14 @@ MAX_BUNDLES_ENV = "RLT_INCIDENT_MAX_BUNDLES"
 MAX_BUNDLES_DEFAULT = 16
 COOLDOWN_ENV = "RLT_INCIDENT_COOLDOWN_S"
 COOLDOWN_DEFAULT = 60.0
+# Probe-failure bundles dedup CROSS-RUN (the in-memory per-kind cooldown
+# above cannot: record_probe_failure builds a fresh recorder per bench
+# invocation, so every rerun of a persistently-broken native probe used
+# to mint a new bundle until the cap pruned real incidents). The newest
+# existing bench_probe_failed bundle's directory timestamp gates the
+# next one instead.
+PROBE_COOLDOWN_ENV = "RLT_PROBE_INCIDENT_COOLDOWN_S"
+PROBE_COOLDOWN_DEFAULT = 3600.0
 # Trailing flight-record bytes frozen into each bundle.
 EVENT_WINDOW_BYTES = 256 * 1024
 
@@ -82,6 +90,15 @@ def cooldown_s() -> float:
         return max(0.0, float(os.environ.get(COOLDOWN_ENV, COOLDOWN_DEFAULT)))
     except ValueError:
         return COOLDOWN_DEFAULT
+
+
+def probe_cooldown_s() -> float:
+    try:
+        return max(0.0, float(
+            os.environ.get(PROBE_COOLDOWN_ENV, PROBE_COOLDOWN_DEFAULT)
+        ))
+    except ValueError:
+        return PROBE_COOLDOWN_DEFAULT
 
 
 def _slug(kind: str) -> str:
@@ -314,6 +331,24 @@ def record_probe_failure(
         writer.close()
     reg = _metrics_registry()
     reg.counter(BENCH_PROBE_FAILURES_METRIC).inc()
+    # cross-run dedup: each bench invocation builds a fresh recorder, so
+    # the recorder's in-memory cooldown can never see a PREVIOUS run's
+    # bundle — gate on the newest on-disk bench_probe_failed bundle
+    # instead (its dirname timestamp is the capture time). The flight-
+    # record event and the failure counter above always land; only the
+    # duplicate bundle is suppressed.
+    window = probe_cooldown_s()
+    if window > 0:
+        newest = max(
+            (b["ts"] or 0 for b in list_bundles(run_dir)
+             if b["kind"] == "bench_probe_failed"),
+            default=None,
+        )
+        if newest is not None and time.time() - newest < window:
+            reg.counter(
+                INCIDENTS_SUPPRESSED_METRIC, kind="bench_probe_failed"
+            ).inc()
+            return None
     rec = IncidentRecorder(run_dir, registry=reg, events_path=events_path)
     return rec.maybe_capture(
         "bench_probe_failed",

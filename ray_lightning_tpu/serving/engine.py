@@ -77,6 +77,7 @@ from ray_lightning_tpu.serving.scheduler import (
     Request,
     RequestQueueFull,
 )
+from ray_lightning_tpu.serving.speculative import ngram_propose
 
 __all__ = [
     "Completion",
@@ -93,6 +94,10 @@ LATENCY_BOUNDS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
 )
+
+# per-slot-tick accepted-token counts (1 = no speculation win, K = every
+# proposal accepted); integer-ish bounds up to the largest sane k
+ACCEPTED_BOUNDS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
 
 
 class EngineClosed(RuntimeError):
@@ -122,6 +127,15 @@ class EngineConfig:
     see ``serving/resilience.py``). ``head_skip_limit`` /
     ``head_aging_ticks`` bound the scheduler's skip-ahead window behind
     a block-deferred FIFO head (0 = strict FIFO, the default).
+
+    ``speculate_k`` (default env ``RLT_SERVE_SPECULATE_K`` or 0): 0 =
+    one token per tick (today's path, byte-identical); k >= 2 = self-
+    speculative decode — each tick feeds every slot's pending token plus
+    up to k-1 n-gram-proposed continuations through one
+    ``decode_step_verify`` call and delivers the greedily-accepted
+    prefix as a multi-token burst. Requires greedy sampling
+    (temperature 0): greedy acceptance is what keeps the output
+    token-identical to the unspeculated engine and to ``generate()``.
     """
 
     num_slots: int = 4
@@ -141,6 +155,7 @@ class EngineConfig:
     shed_watermark: float = 0.9
     head_skip_limit: int = 0
     head_aging_ticks: int = 16
+    speculate_k: Optional[int] = None  # None -> RLT_SERVE_SPECULATE_K or 0
 
     def resolved_block_size(self) -> int:
         if self.block_size is not None:
@@ -149,6 +164,14 @@ class EngineConfig:
             return int(os.environ.get("RLT_SERVE_BLOCK_SIZE", "16"))
         except ValueError:
             return 16
+
+    def resolved_speculate_k(self) -> int:
+        if self.speculate_k is not None:
+            return int(self.speculate_k)
+        try:
+            return int(os.environ.get("RLT_SERVE_SPECULATE_K", "0"))
+        except ValueError:
+            return 0
 
     def validate(self) -> None:
         if self.max_prompt_len < 1:
@@ -177,6 +200,20 @@ class EngineConfig:
                     f"max_len ({self.max_len}) must be a multiple of "
                     f"block_size ({bs}) for the paged layout"
                 )
+        k = self.resolved_speculate_k()
+        if k < 0 or k == 1:
+            raise ValueError(
+                f"speculate_k must be 0 (off) or >= 2, got {k}: k = 1 "
+                "verifies only the pending token, which is the ordinary "
+                "decode step with extra overhead"
+            )
+        if k > 0 and self.temperature > 0.0:
+            raise ValueError(
+                f"speculate_k={k} requires greedy sampling "
+                f"(temperature 0, got {self.temperature}): greedy "
+                "verification is what makes the accepted stream "
+                "token-identical to the unspeculated engine"
+            )
 
 
 class Completion:
@@ -281,6 +318,10 @@ class InferenceEngine:
         self._admit_seq = 0
         # request_id -> remaining-token budget armed by a drop-stream fault
         self._drop_stream: Dict[str, int] = {}
+        # request_id -> full token history (prompt + generated), the
+        # prompt-lookup corpus for the self-speculation proposer; only
+        # populated when speculate_k > 0 so k=0 stays allocation-free
+        self._history: Dict[str, List[int]] = {}
         self._completions: Dict[str, Completion] = {}
         self._on_token: Dict[str, Callable[[str, int], Any]] = {}
         self._rng = jax.random.key(ecfg.seed)
@@ -318,6 +359,10 @@ class InferenceEngine:
             "tokens_out": 0,
             "busy_slot_steps": 0,
             "completed": 0,
+            # speculative accounting: accepted_tokens / spec_row_ticks is
+            # the mean accepted-tokens-per-slot-tick the bench reports
+            "accepted_tokens": 0,
+            "spec_row_ticks": 0,
         }
         self._build_compiled()
 
@@ -332,8 +377,14 @@ class InferenceEngine:
             _sample_logits,
             decode_step_paged,
             decode_step_ragged,
+            decode_step_verify,
             init_kv_cache,
             prefill,
+        )
+        from ray_lightning_tpu.ops.paged_attention import (
+            fused_sample,
+            fused_sample_supported,
+            paged_kernel_enabled,
         )
         from ray_lightning_tpu.ops.rope import rope_angles
 
@@ -345,9 +396,27 @@ class InferenceEngine:
 
         cfg = self.cfg
         ecfg = self.engine_config
+        spec_k = self._speculate_k = ecfg.resolved_speculate_k()
         # the SAME matmul-precision helper the train step applies — the
         # decode-parity test pins that train and serve cannot drift
         mp = self._matmul_precision = parse_matmul_precision()
+
+        # the fused Pallas sampler only covers the (greedy | pure
+        # temperature) policies where it is bitwise-identical to
+        # _sample_logits; anything else keeps the lax sampler, so the
+        # kernel knob can never change a token
+        use_fused = paged_kernel_enabled() and fused_sample_supported(
+            ecfg.temperature, ecfg.top_k, ecfg.top_p
+        )
+
+        def sample(logits, key):
+            if use_fused:
+                return fused_sample(
+                    logits, key, ecfg.temperature, ecfg.top_k, ecfg.top_p
+                )
+            return _sample_logits(
+                logits, key, ecfg.temperature, ecfg.top_k, ecfg.top_p
+            )
 
         def _with_precision(fn):
             def wrapped(params, *rest):
@@ -382,9 +451,20 @@ class InferenceEngine:
             logits, cache = decode_step_ragged(
                 params, {"k": cache_k, "v": cache_v}, token, pos, cfg, table
             )
-            sampled = _sample_logits(
-                logits, key, ecfg.temperature, ecfg.top_k, ecfg.top_p
+            sampled = sample(logits, key)
+            return sampled.astype(jnp.int32), cache["k"], cache["v"]
+
+        def decode_verify(params, cache_k, cache_v, tokens, pos, key):
+            # speculative verify: tokens is [num_slots, K] (pending token
+            # + K-1 proposals), logits come back [S, K, V] and every
+            # position is greedily sampled — the host accept loop keeps
+            # the longest matching prefix, so any row that proposed
+            # nothing degenerates to the k=0 program's math exactly
+            logits, cache = decode_step_verify(
+                params, {"k": cache_k, "v": cache_v}, tokens, pos, cfg, table
             )
+            S, K, V = logits.shape
+            sampled = sample(logits.reshape(S * K, V), key).reshape(S, K)
             return sampled.astype(jnp.int32), cache["k"], cache["v"]
 
         if self.kv_layout == "paged":
@@ -431,23 +511,36 @@ class InferenceEngine:
                     params, {"k": cache_k, "v": cache_v}, token, pos,
                     tables, cfg, table,
                 )
-                sampled = _sample_logits(
-                    logits, key, ecfg.temperature, ecfg.top_k, ecfg.top_p
+                sampled = sample(logits, key)
+                return sampled.astype(jnp.int32), cache["k"], cache["v"]
+
+            def decode_verify_paged(
+                params, cache_k, cache_v, tokens, pos, tables, key
+            ):
+                logits, cache = decode_step_verify(
+                    params, {"k": cache_k, "v": cache_v}, tokens, pos, cfg,
+                    table, block_tables=tables,
                 )
+                S, K, V = logits.shape
+                sampled = sample(logits.reshape(S * K, V), key).reshape(S, K)
                 return sampled.astype(jnp.int32), cache["k"], cache["v"]
 
             self._prefill_fn = _compile_cache.wrap(
                 jax.jit(_with_precision(prefill_into_paged)), "serve_prefill"
             )
             self._decode_fn = _compile_cache.wrap(
-                jax.jit(_with_precision(decode_paged)), "serve_decode"
+                jax.jit(_with_precision(
+                    decode_verify_paged if spec_k > 0 else decode_paged
+                )), "serve_decode"
             )
         else:
             self._prefill_fn = _compile_cache.wrap(
                 jax.jit(_with_precision(prefill_into)), "serve_prefill"
             )
             self._decode_fn = _compile_cache.wrap(
-                jax.jit(_with_precision(decode)), "serve_decode"
+                jax.jit(_with_precision(
+                    decode_verify if spec_k > 0 else decode
+                )), "serve_decode"
             )
 
     def _program_specs(self):
@@ -461,7 +554,12 @@ class InferenceEngine:
         ecfg = self.engine_config
         ck, cv = self.pool.cache["k"], self.pool.cache["v"]
         prompt = jnp.zeros((1, ecfg.max_prompt_len), jnp.int32)
-        token = jnp.zeros((self.pool.num_slots,), jnp.int32)
+        if self._speculate_k > 0:
+            token = jnp.zeros(
+                (self.pool.num_slots, self._speculate_k), jnp.int32
+            )
+        else:
+            token = jnp.zeros((self.pool.num_slots,), jnp.int32)
         pos = jnp.zeros((self.pool.num_slots,), jnp.int32)
         key = jax.random.key(0)
         if self.kv_layout == "paged":
@@ -652,10 +750,93 @@ class InferenceEngine:
                 tr.prefilled(time.perf_counter() - t0)
             slot.pos = req.prompt_len - 1
             slot.pending_token = req.tokens[-1]
+            if self._speculate_k > 0:
+                self._history[req.request_id] = list(req.tokens)
             self.stats["prefills"] += 1
 
         completed: List[str] = []
-        if plan.decode_slots:
+        K = self._speculate_k
+        if plan.decode_slots and K > 0:
+            # speculative tick: every row carries its pending token plus
+            # up to K-1 prompt-lookup proposals; rows with no proposal
+            # (or at the end of their budget) ride the same fixed-shape
+            # program with padded columns that are sampled and discarded
+            token = np.zeros((self.pool.num_slots, K), np.int32)
+            pos = np.zeros((self.pool.num_slots,), np.int32)
+            proposals: Dict[int, List[int]] = {}
+            for slot in plan.decode_slots:
+                rid = slot.request_id
+                # budget: a row may deliver at most `remaining` tokens
+                # this tick, so propose at most remaining-1 — also what
+                # keeps every speculative write inside the blocks the
+                # paged allocator reserved at admission
+                remaining = slot.max_new_tokens - slot.generated
+                props = ngram_propose(
+                    self._history.get(rid, ()), min(K - 1, remaining - 1)
+                )
+                proposals[slot.index] = props
+                if paged:
+                    # on-demand growth must cover the deepest speculative
+                    # write position, not just slot.pos (a host-side
+                    # table-value change, never a shape change)
+                    self.pool.ensure_writable(
+                        slot, upto_pos=slot.pos + len(props)
+                    )
+                token[slot.index, 0] = slot.pending_token
+                for j, p in enumerate(props):
+                    token[slot.index, 1 + j] = p
+                pos[slot.index] = slot.pos
+            self._rng, sub = jax.random.split(self._rng)
+            with _obs.span("serve_decode"):
+                if paged:
+                    sampled, ck, cv = self._decode_fn(
+                        self.params, ck, cv, jnp.asarray(token),
+                        jnp.asarray(pos),
+                        jnp.asarray(self.pool.block_tables), sub,
+                    )
+                else:
+                    sampled, ck, cv = self._decode_fn(
+                        self.params, ck, cv, jnp.asarray(token),
+                        jnp.asarray(pos), sub,
+                    )
+                sampled_host = np.asarray(sampled)  # the per-step sync point
+            now = time.perf_counter()
+            reg = _obs.registry()
+            for slot in plan.decode_slots:
+                rid = slot.request_id
+                if rid is None:
+                    # released mid-step (re-entrant shutdown from an
+                    # on_token callback): nothing to deliver
+                    continue
+                out = sampled_host[slot.index]
+                props = proposals.get(slot.index, [])
+                # greedy accept: out[j] is the model's token AFTER
+                # consuming proposals[:j]; the first mismatch both ends
+                # the accepted prefix AND contributes its correction —
+                # so at least one token always lands, same as k=0
+                accepted = 1
+                for j, p in enumerate(props):
+                    if int(out[j]) == int(p):
+                        accepted += 1
+                    else:
+                        break
+                before = self.stats["tokens_out"]
+                for j in range(accepted):
+                    if not self._deliver_token(
+                        slot, rid, int(out[j]), now, reg, completed
+                    ):
+                        break
+                delivered = int(self.stats["tokens_out"] - before)
+                self.stats["spec_row_ticks"] += 1
+                self.stats["accepted_tokens"] += delivered
+                if delivered > 0 and reg is not None:
+                    reg.histogram(
+                        "rlt_serve_accepted_tokens",
+                        bounds=ACCEPTED_BOUNDS,
+                    ).observe(float(delivered), exemplar=rid)
+            self.stats["decode_steps"] += 1
+            self.stats["busy_slot_steps"] += len(plan.decode_slots)
+        elif plan.decode_slots:
             token = np.zeros((self.pool.num_slots,), np.int32)
             pos = np.zeros((self.pool.num_slots,), np.int32)
             for slot in plan.decode_slots:
@@ -688,77 +869,10 @@ class InferenceEngine:
                     # released mid-step (re-entrant shutdown from an
                     # on_token callback): nothing to deliver
                     continue
-                tok = int(sampled_host[slot.index])
-                drop_after = self._drop_stream.get(rid)
-                if drop_after is not None and slot.generated >= drop_after:
-                    # scripted drop-stream fault: the request's stream
-                    # dies here — this token is never delivered, the
-                    # journal resumes from the tokens the client has
-                    self._drop_stream.pop(rid, None)
-                    completed.append(rid)
-                    self._finish(
-                        rid, "error",
-                        _faults.ServeFault(
-                            f"scripted serving fault: {rid} stream dropped "
-                            f"after {slot.generated} tokens"
-                        ),
-                    )
-                    if slot.trace is not None:
-                        self._tracer.finish(slot.trace, "error")
-                    self.pool.release(slot.index)
-                    continue
-                completion = self._completions.get(rid)
-                if completion is not None and not completion.done:
-                    completion.tokens.append(tok)
-                    if completion.ttft_s is None:
-                        completion.ttft_s = now - completion.submitted_at
-                        self._recent_ttfts.append(completion.ttft_s)
-                        if reg is not None:
-                            reg.histogram(
-                                "rlt_serve_ttft_seconds",
-                                bounds=LATENCY_BOUNDS,
-                            ).observe(
-                                completion.ttft_s, exemplar=rid
-                            )
-                    elif reg is not None and slot.last_token_at is not None:
-                        reg.histogram(
-                            "rlt_serve_itl_seconds", bounds=LATENCY_BOUNDS
-                        ).observe(
-                            now - slot.last_token_at, exemplar=rid
-                        )
-                    cb = self._on_token.get(rid)
-                    if cb is not None:
-                        try:
-                            cb(rid, tok)
-                        except Exception:
-                            pass  # broken stream consumer must not stall decode
-                    if slot.request_id != rid:
-                        # the callback re-entrantly shut down / finished
-                        # this request; the slot is no longer its tenant
-                        continue
-                if slot.first_token_at is None:
-                    slot.first_token_at = now
-                slot.last_token_at = now
-                tr = slot.trace
-                if tr is not None:
-                    tr.token()
-                slot.generated += 1
-                slot.pos += 1
-                slot.pending_token = tok
-                self.stats["tokens_out"] += 1
-                if reg is not None:
-                    reg.counter("rlt_serve_tokens_total").inc()
-                reason = None
-                if slot.eos_id is not None and tok == slot.eos_id:
-                    reason = "eos"
-                elif slot.generated >= slot.max_new_tokens:
-                    reason = "length"
-                if reason is not None:
-                    completed.append(rid)
-                    self._finish(rid, reason)
-                    if tr is not None:
-                        self._tracer.finish(tr, reason)
-                    self.pool.release(slot.index)
+                self._deliver_token(
+                    slot, rid, int(sampled_host[slot.index]), now, reg,
+                    completed,
+                )
             self.stats["decode_steps"] += 1
             self.stats["busy_slot_steps"] += len(plan.decode_slots)
 
@@ -769,6 +883,100 @@ class InferenceEngine:
             "completed": completed,
         }
 
+    def _deliver_token(
+        self,
+        slot,
+        rid: str,
+        tok: int,
+        now: float,
+        reg,
+        completed: List[str],
+    ) -> bool:
+        """Deliver ONE sampled token to a slot's request — the shared
+        per-token tail of :meth:`step` for both the classic one-token
+        tick and a speculative burst (called once per accepted token, in
+        order). Returns ``False`` when the slot stopped consuming tokens
+        (stream dropped by a scripted fault, request finished on
+        EOS/length, or detached re-entrantly by its callback) — which
+        truncates the remainder of a burst: tokens past EOS are never
+        delivered, never journaled, and the garbage the verify pass wrote
+        for them is recycled with the slot."""
+        drop_after = self._drop_stream.get(rid)
+        if drop_after is not None and slot.generated >= drop_after:
+            # scripted drop-stream fault: the request's stream
+            # dies here — this token is never delivered, the
+            # journal resumes from the tokens the client has
+            self._drop_stream.pop(rid, None)
+            completed.append(rid)
+            self._finish(
+                rid, "error",
+                _faults.ServeFault(
+                    f"scripted serving fault: {rid} stream dropped "
+                    f"after {slot.generated} tokens"
+                ),
+            )
+            if slot.trace is not None:
+                self._tracer.finish(slot.trace, "error")
+            self.pool.release(slot.index)
+            return False
+        completion = self._completions.get(rid)
+        if completion is not None and not completion.done:
+            completion.tokens.append(tok)
+            if completion.ttft_s is None:
+                completion.ttft_s = now - completion.submitted_at
+                self._recent_ttfts.append(completion.ttft_s)
+                if reg is not None:
+                    reg.histogram(
+                        "rlt_serve_ttft_seconds",
+                        bounds=LATENCY_BOUNDS,
+                    ).observe(
+                        completion.ttft_s, exemplar=rid
+                    )
+            elif reg is not None and slot.last_token_at is not None:
+                reg.histogram(
+                    "rlt_serve_itl_seconds", bounds=LATENCY_BOUNDS
+                ).observe(
+                    now - slot.last_token_at, exemplar=rid
+                )
+            cb = self._on_token.get(rid)
+            if cb is not None:
+                try:
+                    cb(rid, tok)
+                except Exception:
+                    pass  # broken stream consumer must not stall decode
+            if slot.request_id != rid:
+                # the callback re-entrantly shut down / finished
+                # this request; the slot is no longer its tenant
+                return False
+        if slot.first_token_at is None:
+            slot.first_token_at = now
+        slot.last_token_at = now
+        tr = slot.trace
+        if tr is not None:
+            tr.token()
+        slot.generated += 1
+        slot.pos += 1
+        slot.pending_token = tok
+        hist = self._history.get(rid)
+        if hist is not None:
+            hist.append(tok)
+        self.stats["tokens_out"] += 1
+        if reg is not None:
+            reg.counter("rlt_serve_tokens_total").inc()
+        reason = None
+        if slot.eos_id is not None and tok == slot.eos_id:
+            reason = "eos"
+        elif slot.generated >= slot.max_new_tokens:
+            reason = "length"
+        if reason is not None:
+            completed.append(rid)
+            self._finish(rid, reason)
+            if tr is not None:
+                self._tracer.finish(tr, reason)
+            self.pool.release(slot.index)
+            return False
+        return True
+
     def _finish(
         self,
         request_id: str,
@@ -777,6 +985,7 @@ class InferenceEngine:
     ) -> None:
         completion = self._completions.pop(request_id, None)
         self._on_token.pop(request_id, None)
+        self._history.pop(request_id, None)
         if completion is not None:
             completion._finish(reason, error)
         self.stats["completed"] += 1

@@ -570,12 +570,22 @@ class PagedKVPool:
             table[j] = alloc.blocks[j]
         return table
 
-    def ensure_writable(self, slot: Slot) -> None:
+    def ensure_writable(
+        self, slot: Slot, upto_pos: Optional[int] = None
+    ) -> None:
         """Grow the slot's block table (on demand, from its reservation)
-        until the block holding ``slot.pos`` — the position the next
-        decode step writes — is allocated."""
+        until the block holding ``upto_pos`` — default ``slot.pos``, the
+        position the next decode step writes — is allocated.
+
+        Speculative decode passes ``upto_pos = slot.pos + n_proposals``:
+        a verify burst writes candidate (k, v) at every proposed
+        position before acceptance is known, so all of them must map to
+        physical blocks. The engine clamps proposals to the remaining
+        token budget, which keeps ``upto_pos`` within the admission-time
+        reservation (``blocks_for``) — growth still cannot fail."""
         alloc = self._alloc_of[slot.index]
-        needed = slot.pos // self.block_size + 1
+        pos = slot.pos if upto_pos is None else int(upto_pos)
+        needed = pos // self.block_size + 1
         while len(alloc.blocks) < needed:
             block = self.allocator.grow(slot.request_id)
             self.block_tables[slot.index, len(alloc.blocks) - 1] = block

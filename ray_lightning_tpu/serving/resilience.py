@@ -425,6 +425,12 @@ class RequestJournal:
         still decoding) streams into the void instead of duplicating
         tokens. The client callback always sees the journal-level
         request id and the merged stream.
+
+        Speculative decoding (``speculate_k > 0``) delivers several
+        tokens per engine tick through this same callback, one call per
+        token — ``delivered`` therefore stays an exact per-token replay
+        log, and a resume after a mid-burst stream drop re-submits
+        prompt + delivered and replays bitwise.
         """
 
         def on_token(_rid: str, token: int) -> None:

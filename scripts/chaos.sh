@@ -37,6 +37,14 @@ echo "== arbiter kill loop under the lock-order sanitizer =="
 RLT_SANITIZE=1 python -m pytest tests/test_arbiter.py tests/test_elastic.py \
     -v -m "arbiter or elastic" -p no:cacheprovider "$@"
 
+echo "== speculative decoding under stream-drop faults (k>0 kill loop) =="
+# speculation must stay token-identical through journal recovery: the
+# drop-stream fault fires MID-BURST and the resumed stream replays
+# bitwise (delivered-token accounting is per token, not per tick)
+RLT_SERVE_SPECULATE_K=4 python -m pytest tests/test_speculative.py -v \
+    -m speculative -k "drop_stream or token_identity or eos_mid_burst" \
+    -p no:cacheprovider "$@"
+
 echo "== legacy relaunch/retry path (slow) =="
 python -m pytest tests/test_cli_and_checkpointing.py -v -m slow \
     -k "retries or relaunch" -p no:cacheprovider "$@"

@@ -37,6 +37,8 @@ def _isolated_cache_dir(tmp_path, monkeypatch):
     monkeypatch.setenv("RLT_BENCH_ARBITRATION_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_GOODPUT_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_ZERO_SWEEP", "0")
+    monkeypatch.setenv("RLT_BENCH_SPECULATIVE_SWEEP", "0")
+    monkeypatch.setenv("RLT_BENCH_PAGED_KERNEL_SWEEP", "0")
 
 
 def _result(value, **detail):
