@@ -1621,6 +1621,135 @@ def _attach_speculative_sweep(result: dict, here: str, env: dict) -> None:
         }
 
 
+def _disagg_sweep(args: argparse.Namespace) -> int:
+    """Child: the disaggregated-serving sweep (--_disagg_sweep).
+
+    Serves the same burst through a colocated 2-replica fleet and a
+    1-prefill + 1-decode disaggregated fleet (same total replicas, paged
+    KV) and reports TTFT p95 / ITL p99 / tokens/s per mode, the
+    migration counters (attempts, migrated, fallback rate), and the
+    cross-mode token-identity verdict — the tentpole contract that the
+    handoff never changes a token. CPU-pinned like the other sweeps:
+    this measures the handoff plumbing and scheduling interleave, not
+    chip FLOPs."""
+    import dataclasses
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.llama import LlamaConfig, init_params
+    from ray_lightning_tpu.serving import LocalReplicaFleet
+
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(), dtype=jnp.float32, vocab_size=64
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = __import__("numpy").random.default_rng(7)
+    max_new = int(os.environ.get("RLT_BENCH_DISAGG_TOKENS", "16"))
+    reqs = [
+        [int(t) for t in rng.integers(1, 64, 6)] for _ in range(8)
+    ]
+    engine_kwargs = dict(
+        num_slots=4, max_prompt_len=8, max_len=48, max_queue=64,
+        kv_layout="paged", block_size=4,
+    )
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return vals[min(int(q * len(vals)), len(vals) - 1)]
+
+    def serve(prefill_replicas):
+        fleet = LocalReplicaFleet(
+            lambda: (params, cfg),
+            engine_kwargs=engine_kwargs,
+            initial_replicas=2,
+            prefill_replicas=prefill_replicas,
+        )
+        try:
+            arrivals = {i: [] for i in range(len(reqs))}
+            t0 = time.perf_counter()
+            entries = [
+                fleet.submit(
+                    p, max_new_tokens=max_new,
+                    on_token=lambda _rid, _t, i=i: arrivals[i].append(
+                        time.perf_counter()
+                    ),
+                )
+                for i, p in enumerate(reqs)
+            ]
+            streams = [e.result(timeout=600) for e in entries]
+            wall = time.perf_counter() - t0
+            ttfts = [
+                (ts[0] - t0) * 1e3 for ts in arrivals.values() if ts
+            ]
+            itls = [
+                (b - a) * 1e3
+                for ts in arrivals.values()
+                for a, b in zip(ts, ts[1:])
+            ]
+            stats = fleet.stats()
+            out = {
+                "mode": (
+                    "disaggregated" if prefill_replicas else "colocated"
+                ),
+                "requests": len(reqs),
+                "completed": stats["completed"],
+                "tokens_per_sec": round(
+                    sum(len(s) for s in streams) / max(wall, 1e-9), 2
+                ),
+                "ttft_p95_ms": round(pct(ttfts, 0.95), 2),
+                "itl_p99_ms": round(pct(itls, 0.99), 2),
+            }
+            if prefill_replicas:
+                m = stats["migration"]
+                out["migration"] = m
+                out["fallback_rate"] = round(
+                    m["fallbacks"] / max(m["attempts"], 1), 3
+                )
+            return out, streams
+        finally:
+            fleet.shutdown()
+
+    colo, colo_streams = serve(0)
+    disagg, disagg_streams = serve(1)
+    payload = {
+        "platform": "cpu",
+        "configs": [colo, disagg],
+        "token_identical": colo_streams == disagg_streams,
+    }
+    print(json.dumps(payload))
+    return 0
+
+
+def _attach_disagg_sweep(result: dict, here: str, env: dict) -> None:
+    """Attach detail.disagg (colocated vs disaggregated prefill/decode
+    serving: TTFT p95 / ITL p99 / migration fallback rate and the
+    cross-mode token-identity verdict). RLT_BENCH_DISAGG_SWEEP=0
+    disables."""
+    if os.environ.get("RLT_BENCH_DISAGG_SWEEP", "1") == "0":
+        return
+    sweep_env = dict(env)
+    sweep_env["JAX_PLATFORMS"] = "cpu"
+    ok, sweep, serr = _run(
+        [sys.executable, here, "--_disagg_sweep"],
+        _env_timeout("RLT_BENCH_DISAGG_TIMEOUT", 300.0),
+        sweep_env,
+    )
+    detail = result.setdefault("detail", {})
+    if ok and isinstance(sweep, dict) and "configs" in sweep:
+        detail["disagg"] = sweep
+    else:
+        detail["disagg"] = {
+            "error": (sweep or {}).get("error")
+            or serr
+            or "sweep produced no JSON"
+        }
+
+
 def _paged_kernel_sweep(args: argparse.Namespace) -> int:
     """Child: the fused paged-attention kernel sweep (--_paged_kernel_sweep).
 
@@ -2020,6 +2149,7 @@ def main() -> int:
     parser.add_argument("--_goodput_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_zero_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_speculative_sweep", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--_disagg_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_paged_kernel_sweep", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
 
@@ -2043,6 +2173,8 @@ def main() -> int:
         return _zero_sweep(args)
     if args._speculative_sweep:
         return _speculative_sweep(args)
+    if args._disagg_sweep:
+        return _disagg_sweep(args)
     if args._paged_kernel_sweep:
         return _paged_kernel_sweep(args)
 
@@ -2143,6 +2275,7 @@ def main() -> int:
                     _attach_goodput_sweep(result, here, env)
                     _attach_zero_sweep(result, here, env)
                     _attach_speculative_sweep(result, here, env)
+                    _attach_disagg_sweep(result, here, env)
                     _attach_paged_kernel_sweep(result, here, env)
                     if _is_on_chip(result):
                         _save_tpu_cache(result, _args_key(args))
@@ -2198,6 +2331,7 @@ def main() -> int:
         _attach_goodput_sweep(result, here, env)
         _attach_zero_sweep(result, here, env)
         _attach_speculative_sweep(result, here, env)
+        _attach_disagg_sweep(result, here, env)
         _attach_paged_kernel_sweep(result, here, env)
     if error:
         result.setdefault("detail", {})["error"] = error

@@ -245,17 +245,23 @@ def _cmd_serve(args) -> int:
         block_size=args.block_size,
     )
     fleet = None
-    if args.max_retries > 0:
-        # retries need the request journal: serve through a one-replica
-        # fleet so a replica fault re-runs the request transparently
+    if args.max_retries > 0 or args.replicas > 1 or args.prefill_replicas > 0:
+        # retries, multi-replica routing, and disaggregated prefill/decode
+        # all need the request journal: serve through a fleet so a replica
+        # fault re-runs the request transparently and prefill-pool engines
+        # can ship KV to the decode pool
         from ray_lightning_tpu.serving import LocalReplicaFleet
 
-        fleet = LocalReplicaFleet(
-            lambda: (params, cfg),
-            engine_kwargs=dataclasses.asdict(engine_cfg),
-            initial_replicas=1,
-            max_retries=args.max_retries,
-        )
+        try:
+            fleet = LocalReplicaFleet(
+                lambda: (params, cfg),
+                engine_kwargs=dataclasses.asdict(engine_cfg),
+                initial_replicas=args.replicas,
+                max_retries=args.max_retries,
+                prefill_replicas=args.prefill_replicas,
+            )
+        except ValueError as exc:  # e.g. --prefill-replicas without paged
+            raise SystemExit(str(exc))
         engine = fleet._replicas[0]
     else:
         engine = InferenceEngine(params, cfg, engine_cfg)
@@ -723,6 +729,17 @@ def main(argv: Optional[list] = None) -> int:
         "--priority", type=int, default=0,
         help="admission class: 0 is never shed; >= 1 is sheddable under "
         "queue pressure or SLO burn",
+    )
+    serve.add_argument(
+        "--replicas", type=int, default=1,
+        help="> 1 serves through a multi-replica fleet (request journal + "
+        "least-loaded routing)",
+    )
+    serve.add_argument(
+        "--prefill-replicas", type=int, default=0,
+        help="> 0 disaggregates the fleet: the first N replicas form the "
+        "prefill pool and ship checksummed KV to the decode pool "
+        "(requires --kv-layout paged and N < --replicas)",
     )
     serve.add_argument(
         "--max-retries", type=int, default=0,

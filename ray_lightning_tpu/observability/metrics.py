@@ -58,6 +58,16 @@ SERVE_DEADLINE_EXPIRED_METRIC = "rlt_serve_deadline_expired_total"
 SERVE_BREAKER_STATE_METRIC = "rlt_serve_breaker_state"
 SERVE_CAPACITY_BLOCKED_METRIC = "rlt_serve_capacity_blocked_total"
 
+# Disaggregated-serving migration metrics (the fleet's KV-shipment pump
+# in serving/replica.py is the single emit site).
+SERVE_MIGRATION_ATTEMPTS_METRIC = "rlt_serve_migration_attempts_total"
+SERVE_MIGRATION_VERIFIED_METRIC = "rlt_serve_migration_verified_total"
+SERVE_MIGRATION_CORRUPT_METRIC = "rlt_serve_migration_corrupt_total"
+SERVE_MIGRATION_RETRIES_METRIC = "rlt_serve_migration_retries_total"
+SERVE_MIGRATION_FALLBACKS_METRIC = "rlt_serve_migration_fallbacks_total"
+SERVE_MIGRATION_BYTES_METRIC = "rlt_serve_migration_bytes_total"
+SERVE_MIGRATION_TRANSFER_MS_METRIC = "rlt_serve_migration_transfer_ms"
+
 # `# HELP` text for the exposition; metrics not listed fall back to a
 # name-derived placeholder so every family still carries a HELP line.
 HELP: Dict[str, str] = {
@@ -76,6 +86,13 @@ HELP: Dict[str, str] = {
     "rlt_serve_shed_total": "Serving requests rejected by the load-shed policy.",
     "rlt_serve_deadline_expired_total": "Serving requests evicted past their deadline (queued or decoding).",
     "rlt_serve_breaker_state": "Replica circuit-breaker state (0 closed, 1 half-open, 2 open).",
+    "rlt_serve_migration_attempts_total": "KV-shipment migration attempts (prefill pool to decode pool).",
+    "rlt_serve_migration_verified_total": "KV shipments that passed checksum/fingerprint verification and were admitted.",
+    "rlt_serve_migration_corrupt_total": "KV shipments rejected by receiver-side checksum verification (never decoded).",
+    "rlt_serve_migration_retries_total": "Migration attempts retried after a failed send/verify/admit step.",
+    "rlt_serve_migration_fallbacks_total": "Migrations abandoned to colocated decode on the prefill replica.",
+    "rlt_serve_migration_bytes_total": "KV payload bytes shipped by admitted migrations.",
+    "rlt_serve_migration_transfer_ms": "End-to-end migration transfer time (export to admitted), milliseconds.",
     "rlt_goodput_seconds_total": "Wall time per goodput category (category, src labels).",
     "rlt_goodput_fraction": "Fraction of fleet wall time spent in productive compute.",
     "rlt_anomaly_score": "Current robust z-score (or drop) per anomaly detector.",

@@ -70,6 +70,30 @@ family by prefix and only errors on specs that belong to no family at
 all. ``RLT_FAULT_FUSE`` at-most-once semantics are identical (``@every``
 burns one fuse per firing tick).
 
+The migration family (disaggregated prefill/decode serving,
+``serving/migration.py`` + the fleet's migration pump) shares the
+``replica`` prefix — its kinds disambiguate it from engine faults, and
+the two parsers skip each other's specs by regex::
+
+    replica<R>:<kind>@<req<N>|every:<N>>[:<arg>]
+
+    replica0:drop-shipment@req1        # the 1st shipment leaving
+                                       # prefill replica 0 vanishes
+    replica0:corrupt-shipment@every:2  # every 2nd shipment from
+                                       # replica 0 has a block payload
+                                       # bit-flipped in flight
+    replica0:stall-shipment@req2:0.5   # the 2nd shipment stalls 0.5s
+                                       # at the send point
+    replica1:crash-mid-admit@req1      # decode replica 1 dies while
+                                       # admitting its 1st shipment —
+                                       # after verify, before resume
+
+Send-point kinds (``drop``/``corrupt``/``stall``) key on the SOURCE
+replica and its 1-based shipment sequence; ``crash-mid-admit`` keys on
+the DESTINATION replica and its 1-based import sequence, and raises
+:class:`ServeFault` inside ``import_shipment`` so the receiver's engine
+dies exactly the way a real mid-admit crash would.
+
 The chip-arbiter family (``runtime/arbiter.py`` hooks these per
 transfer) targets the driver-level rebalancing state machine itself::
 
@@ -334,6 +358,23 @@ _SERVE_SPEC_RE = re.compile(
     r"(?::(?P<arg>[0-9.]+))?$"
 )
 
+# migration faults share the replica<R> prefix; the kinds disambiguate.
+# parse_serve_faults skips anything this regex matches and vice versa, so
+# both sub-families coexist in one RLT_FAULT value.
+MIGRATION_KINDS = (
+    "drop-shipment",
+    "corrupt-shipment",
+    "stall-shipment",
+    "crash-mid-admit",
+)
+
+_MIGRATION_SPEC_RE = re.compile(
+    r"^replica(?P<replica>\d+):"
+    r"(?P<kind>drop-shipment|corrupt-shipment|stall-shipment|crash-mid-admit)"
+    r"@(?:req(?P<req>\d+)|every:(?P<every>\d+))"
+    r"(?::(?P<arg>[0-9.]+))?$"
+)
+
 
 class ServeFault(RuntimeError):
     """Raised by a serving ``crash`` fault inside the engine loop.
@@ -393,12 +434,15 @@ def parse_serve_faults(text: Optional[str]) -> List[ServeFaultSpec]:
             continue
         if _spec_family(raw) not in (None, "replica"):
             continue  # another family's spec; its own parser owns it
+        if _MIGRATION_SPEC_RE.match(raw):
+            continue  # migration-family spec; parse_migration_faults owns it
         m = _SERVE_SPEC_RE.match(raw)
         if m is None:
             raise ValueError(
                 f"bad {FAULT_ENV} serving spec {raw!r}: expected "
                 "replica<R>:<crash|hang|slow-decode|drop-stream>"
-                "@<tick<N>|req<N>|every:<N>>[:<arg>]"
+                "@<tick<N>|req<N>|every:<N>>[:<arg>] (or a migration kind, "
+                "see parse_migration_faults)"
             )
         kind = m.group("kind")
         tick = int(m.group("tick")) if m.group("tick") is not None else None
@@ -506,6 +550,171 @@ def serve_request_fault(
                 )
             return spec
     return None
+
+
+# --------------------------------------------------------------------------
+# KV-migration fault points (disaggregated prefill/decode serving)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MigrationFaultSpec:
+    """One scripted migration fault for ``replica``. Send-point kinds
+    (``drop-shipment``/``corrupt-shipment``/``stall-shipment``) target the
+    Nth shipment LEAVING the source replica (1-based, per fleet lifetime);
+    ``crash-mid-admit`` targets the Nth shipment ARRIVING at the
+    destination replica (1-based, per engine lifetime). ``every`` matches
+    every positive multiple of N. ``arg`` is the stall length in seconds
+    for ``stall-shipment``."""
+
+    replica: int
+    kind: str
+    req: Optional[int] = None
+    every: Optional[int] = None
+    arg: float = 0.0
+
+    @property
+    def fuse_id(self) -> str:
+        if self.every is not None:
+            where = f"every{self.every}"
+        else:
+            where = f"req{self.req}"
+        return f"replica{self.replica}-{self.kind}-{where}"
+
+    def fuse_id_at(self, seq: int) -> str:
+        if self.every is not None:
+            return f"{self.fuse_id}-s{seq}"
+        return self.fuse_id
+
+    def matches_seq(self, seq: int) -> bool:
+        if self.every is not None:
+            return seq > 0 and seq % self.every == 0
+        return self.req is not None and self.req == seq
+
+
+def parse_migration_faults(text: Optional[str]) -> List[MigrationFaultSpec]:
+    """Parse the migration specs out of an ``RLT_FAULT`` value. Training
+    (``rank...``) and arbiter (``arbiter...``) specs are skipped by
+    prefix; engine serving specs (crash/hang/slow-decode/drop-stream under
+    the same ``replica`` prefix) are skipped by regex. Raises ValueError
+    naming a bad ``replica...`` spec that belongs to neither sub-family
+    — mirroring :func:`parse_serve_faults`, so a typo'd kind is caught no
+    matter which parser runs first."""
+    if not text:
+        return []
+    specs: List[MigrationFaultSpec] = []
+    for raw in text.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        if _spec_family(raw) not in (None, "replica"):
+            continue  # another family's spec; its own parser owns it
+        if _SERVE_SPEC_RE.match(raw):
+            continue  # engine serving spec; parse_serve_faults owns it
+        m = _MIGRATION_SPEC_RE.match(raw)
+        if m is None:
+            raise ValueError(
+                f"bad {FAULT_ENV} migration spec {raw!r}: expected "
+                "replica<R>:<drop-shipment|corrupt-shipment|stall-shipment|"
+                "crash-mid-admit>@<req<N>|every:<N>>[:<seconds>] (or an "
+                "engine serving kind, see parse_serve_faults)"
+            )
+        kind = m.group("kind")
+        req = int(m.group("req")) if m.group("req") is not None else None
+        every = int(m.group("every")) if m.group("every") is not None else None
+        if every is not None and every < 1:
+            raise ValueError(
+                f"bad {FAULT_ENV} migration spec {raw!r}: @every needs N >= 1"
+            )
+        if req is not None and req < 1:
+            raise ValueError(
+                f"bad {FAULT_ENV} migration spec {raw!r}: shipments are "
+                "1-based; @req needs N >= 1"
+            )
+        if kind == "stall-shipment" and m.group("arg") is None:
+            raise ValueError(
+                f"bad {FAULT_ENV} migration spec {raw!r}: stall-shipment "
+                "needs a length, e.g. replica0:stall-shipment@req2:0.5"
+            )
+        specs.append(
+            MigrationFaultSpec(
+                replica=int(m.group("replica")),
+                kind=kind,
+                req=req,
+                every=every,
+                arg=float(m.group("arg") or 0.0),
+            )
+        )
+    return specs
+
+
+_migration_cache: Tuple[Optional[str], List[MigrationFaultSpec]] = (None, [])
+
+
+def _migration_env_specs() -> List[MigrationFaultSpec]:
+    global _migration_cache
+    text = os.environ.get(FAULT_ENV)
+    if text != _migration_cache[0]:
+        _migration_cache = (text, parse_migration_faults(text))
+    return _migration_cache[1]
+
+
+def migration_send_fault(
+    replica: Optional[int], seq: int
+) -> Optional[MigrationFaultSpec]:
+    """Fleet send-point hook: ``seq`` is the 1-based count of shipments
+    that have left source ``replica``. ``stall-shipment`` sleeps ``arg``
+    seconds here (the caller times the send against its timeout budget);
+    ``drop-shipment``/``corrupt-shipment`` are returned for the caller to
+    simulate — the fleet owns the shipment object, so the loss/bit-flip
+    happens where a real transport fault would. Returns the matching spec
+    (fuse already burned) or None."""
+    if replica is None:
+        return None
+    specs = _migration_env_specs()
+    if not specs:
+        return None
+    for spec in specs:
+        if (
+            spec.replica == replica
+            and spec.kind in (
+                "drop-shipment", "corrupt-shipment", "stall-shipment"
+            )
+            and spec.matches_seq(seq)
+            and not _fuse_blown(spec, seq)
+        ):
+            _blow_fuse(spec, seq)
+            if spec.kind == "stall-shipment":
+                time.sleep(spec.arg)
+            return spec
+    return None
+
+
+def migration_admit_fault(replica: Optional[int], seq: int) -> None:
+    """Receiver admit-point hook, called inside ``import_shipment`` after
+    checksum verify but before the slot resumes: ``seq`` is the 1-based
+    count of shipments this engine has been offered. A matching
+    ``crash-mid-admit`` raises :class:`ServeFault` — the decode replica's
+    engine dies holding a half-admitted request, which the fleet must
+    treat as a failed migration attempt (retry elsewhere or fall back to
+    the prefill replica)."""
+    if replica is None:
+        return
+    specs = _migration_env_specs()
+    if not specs:
+        return
+    for spec in specs:
+        if (
+            spec.replica == replica
+            and spec.kind == "crash-mid-admit"
+            and spec.matches_seq(seq)
+            and not _fuse_blown(spec, seq)
+        ):
+            _blow_fuse(spec, seq)
+            raise ServeFault(
+                f"scripted migration fault: replica{replica} crash while "
+                f"admitting shipment #{seq}"
+            )
 
 
 # --------------------------------------------------------------------------

@@ -18,6 +18,12 @@ Five layers, bottom-up:
   runtime: least-loaded routing, heartbeat-driven relaunch, and an
   :class:`~.replica.Autoscaler` scaling the fleet on queue depth and
   TTFT p95 with graceful drain on scale-down.
+- :mod:`.migration` — disaggregated prefill/decode serving: the
+  checksummed, versioned :class:`~.migration.KVShipment` carrying a
+  prefilled request's paged KV blocks from the prefill pool to a decode
+  replica, plus the retry/timeout :class:`~.migration.MigrationPolicy`
+  the fleet's migration pump enforces (bounded attempts, exponential
+  backoff, graceful fallback to colocated decode).
 - :mod:`.resilience` — the serving-resilience primitives threaded
   through all of the above: a driver-side :class:`~.resilience.
   RequestJournal` that makes requests survive replica deaths (resubmit
@@ -33,6 +39,18 @@ from ray_lightning_tpu.serving.engine import (  # noqa: F401
     InferenceEngine,
 )
 from ray_lightning_tpu.serving.kv_pool import KVSlotPool, Slot  # noqa: F401
+from ray_lightning_tpu.serving.migration import (  # noqa: F401
+    KVShipment,
+    MigrationPolicy,
+    MigrationRejected,
+    MigrationStats,
+    ShipmentCorrupt,
+    ShipmentError,
+    ShipmentMismatch,
+    build_shipment,
+    kv_fingerprint,
+    verify_shipment,
+)
 from ray_lightning_tpu.serving.paged_kv import (  # noqa: F401
     BlockAllocation,
     BlockAllocator,
@@ -77,8 +95,12 @@ __all__ = [
     "EngineConfig",
     "InferenceEngine",
     "JournalEntry",
+    "KVShipment",
     "KVSlotPool",
     "LocalReplicaFleet",
+    "MigrationPolicy",
+    "MigrationRejected",
+    "MigrationStats",
     "OutOfBlocks",
     "PagedKVPool",
     "Plan",
@@ -90,9 +112,15 @@ __all__ = [
     "ServeFuture",
     "ServeReplicaActor",
     "ShedPolicy",
+    "ShipmentCorrupt",
+    "ShipmentError",
+    "ShipmentMismatch",
     "Slot",
     "autoscale_decision",
+    "build_shipment",
     "install_sigterm_drain",
+    "kv_fingerprint",
     "needs_relaunch",
     "pick_least_loaded",
+    "verify_shipment",
 ]

@@ -69,8 +69,9 @@ from ray_lightning_tpu.observability import metrics as _metrics
 from ray_lightning_tpu.observability import reqtrace as _reqtrace
 from ray_lightning_tpu.runtime import compile_cache as _compile_cache
 from ray_lightning_tpu.runtime import faults as _faults
+from ray_lightning_tpu.serving import migration as _migration
 from ray_lightning_tpu.serving.kv_pool import KVSlotPool
-from ray_lightning_tpu.serving.paged_kv import PagedKVPool
+from ray_lightning_tpu.serving.paged_kv import TRASH_BLOCK, PagedKVPool
 from ray_lightning_tpu.serving.resilience import RequestShed, ShedPolicy
 from ray_lightning_tpu.serving.scheduler import (
     ContinuousBatchScheduler,
@@ -136,6 +137,14 @@ class EngineConfig:
     prefix as a multi-token burst. Requires greedy sampling
     (temperature 0): greedy acceptance is what keeps the output
     token-identical to the unspeculated engine and to ``generate()``.
+
+    ``role`` (disaggregated serving, see ``serving/migration.py``):
+    ``"both"`` (default — the colocated engine, byte-identical to the
+    pre-disaggregation behavior), ``"prefill"`` (prefill requests and
+    park the result for KV shipment to a decode replica; retains full
+    decode capability as the migration fallback), or ``"decode"``
+    (additionally accepts shipped KV via ``import_shipment``). The
+    prefill role requires the paged layout: shipments are block chains.
     """
 
     num_slots: int = 4
@@ -156,6 +165,7 @@ class EngineConfig:
     head_skip_limit: int = 0
     head_aging_ticks: int = 16
     speculate_k: Optional[int] = None  # None -> RLT_SERVE_SPECULATE_K or 0
+    role: str = "both"  # "both" | "prefill" | "decode" (disaggregation)
 
     def resolved_block_size(self) -> int:
         if self.block_size is not None:
@@ -214,6 +224,17 @@ class EngineConfig:
                 "verification is what makes the accepted stream "
                 "token-identical to the unspeculated engine"
             )
+        if self.role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill' or 'decode', got "
+                f"{self.role!r}"
+            )
+        if self.role == "prefill" and self.kv_layout != "paged":
+            raise ValueError(
+                "role='prefill' requires kv_layout='paged': KV shipments "
+                "are paged block chains (the slot layout has no block "
+                "granularity to ship)"
+            )
 
 
 class Completion:
@@ -262,6 +283,41 @@ class Completion:
         self.finish_reason = reason
         self.error = error
         self._done.set()
+
+
+class _ImportTicket:
+    """One cross-thread KV-import request, executed by the engine loop.
+
+    The fleet's migration pump hands the ticket over and waits on
+    ``event``; the engine loop thread runs the admit (verify → fault
+    point → acquire → install → resume) so every pool/allocator mutation
+    stays serialized with prefill/decode — the pump never touches pool
+    state directly. ``abandoned`` is set by a pump that gave up waiting
+    (admit timeout): the engine skips the ticket instead of admitting a
+    request whose migration already moved on."""
+
+    __slots__ = (
+        "shipment", "request_id", "max_new_tokens", "eos_id", "on_token",
+        "deadline_ms", "priority", "retries", "completion", "error",
+        "abandoned", "event",
+    )
+
+    def __init__(
+        self, shipment, request_id, max_new_tokens, eos_id, on_token,
+        deadline_ms, priority, retries,
+    ):
+        self.shipment = shipment
+        self.request_id = request_id
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.on_token = on_token
+        self.deadline_ms = deadline_ms
+        self.priority = int(priority)
+        self.retries = int(retries)
+        self.completion: Optional[Completion] = None
+        self.error: Optional[BaseException] = None
+        self.abandoned = False
+        self.event = threading.Event()
 
 
 class InferenceEngine:
@@ -335,6 +391,19 @@ class InferenceEngine:
         self._stop_when_idle = False
         # recent TTFTs for the autoscaler's p95 signal (host-side, tiny)
         self._recent_ttfts: deque = deque(maxlen=128)
+        # recent inter-token latencies: the decode pool's autoscaling
+        # signal (ITL p99 drives decode capacity; queue depth drives
+        # prefill capacity)
+        self._recent_itls: deque = deque(maxlen=256)
+        # disaggregated serving state (all guarded by self._work; the
+        # engine loop thread is the only mutator of pool/allocator state)
+        self._role = ecfg.role
+        # rid -> {"slot": index, "pinned": chain keys} for parked prefills
+        self._exports: Dict[str, Dict[str, Any]] = {}
+        self._ready_exports: List[str] = []  # rids awaiting fleet pickup
+        self._export_actions: List[tuple] = []  # (rid, "finish"|"cancel")
+        self._pending_imports: List[_ImportTicket] = []
+        self._import_seq = 0
         # request-scoped tracing: None when telemetry is off, so every
         # per-request/per-token trace site stays a single attribute check
         self._tracer: Optional[_reqtrace.RequestTracer] = (
@@ -713,11 +782,14 @@ class InferenceEngine:
         # and dies, which is exactly the replica death the journal and
         # breakers must recover from
         _faults.fire_serve_tick_faults(self.replica_index, self._ticks)
+        self._process_export_actions()
+        self._process_imports()
         self._evict_expired_slots()
         plan = self.scheduler.tick()
         ecfg = self.engine_config
         ck, cv = self.pool.cache["k"], self.pool.cache["v"]
 
+        new_exports: List[str] = []
         paged = self.kv_layout == "paged"
         for req, slot in plan.prefills:
             self._admit_seq += 1
@@ -752,11 +824,52 @@ class InferenceEngine:
             slot.pending_token = req.tokens[-1]
             if self._speculate_k > 0:
                 self._history[req.request_id] = list(req.tokens)
+            if self._role == "prefill":
+                # park the slot for migration: pin its prefix chains NOW
+                # (engine thread — serialized with every other allocator
+                # op) so a sibling release can't drop them to refcount 0
+                # and have them evicted while the shipment is in flight
+                slot.export_pending = True
+                pinned = self.pool.allocator.pin_request(req.request_id)
+                self._exports[req.request_id] = {
+                    "slot": slot.index, "pinned": pinned,
+                    "prompt": tuple(req.tokens),
+                }
+                new_exports.append(req.request_id)
             self.stats["prefills"] += 1
+
+        # export-pending slots are parked: their KV is in flight to a
+        # decode replica, so this engine must not decode them — not even
+        # the same-tick first decode of a fresh prefill, or the source
+        # would emit a token the receiver then duplicates (a failed
+        # migration clears the flag and they resume in place). The filter
+        # runs AFTER the prefill loop so it sees slots parked this tick;
+        # it is a no-op for "both"/"decode" roles — homogeneous fleets
+        # run the exact pre-disaggregation path.
+        decode_slots = plan.decode_slots
+        block_tables = self.pool.block_tables if paged else None
+        if self._role == "prefill":
+            decode_slots = [s for s in decode_slots if not s.export_pending]
+            parked = [
+                s.index
+                for s in self.pool.slots
+                if s.occupied and s.export_pending
+            ]
+            if paged and parked:
+                # A parked slot is occupied but excluded from the decode
+                # batch, so its row rides the fixed-shape program as a
+                # padding row (token 0, pos 0) — with its LIVE block
+                # table still in place, that padding write would land in
+                # the request's first prompt block and corrupt the KV
+                # the shipment (and any in-place fallback decode)
+                # depends on. Point parked rows at the trash block, the
+                # same sink free slots use.
+                block_tables = block_tables.copy()
+                block_tables[parked, :] = TRASH_BLOCK
 
         completed: List[str] = []
         K = self._speculate_k
-        if plan.decode_slots and K > 0:
+        if decode_slots and K > 0:
             # speculative tick: every row carries its pending token plus
             # up to K-1 prompt-lookup proposals; rows with no proposal
             # (or at the end of their budget) ride the same fixed-shape
@@ -764,7 +877,7 @@ class InferenceEngine:
             token = np.zeros((self.pool.num_slots, K), np.int32)
             pos = np.zeros((self.pool.num_slots,), np.int32)
             proposals: Dict[int, List[int]] = {}
-            for slot in plan.decode_slots:
+            for slot in decode_slots:
                 rid = slot.request_id
                 # budget: a row may deliver at most `remaining` tokens
                 # this tick, so propose at most remaining-1 — also what
@@ -792,7 +905,7 @@ class InferenceEngine:
                     sampled, ck, cv = self._decode_fn(
                         self.params, ck, cv, jnp.asarray(token),
                         jnp.asarray(pos),
-                        jnp.asarray(self.pool.block_tables), sub,
+                        jnp.asarray(block_tables), sub,
                     )
                 else:
                     sampled, ck, cv = self._decode_fn(
@@ -802,7 +915,7 @@ class InferenceEngine:
                 sampled_host = np.asarray(sampled)  # the per-step sync point
             now = time.perf_counter()
             reg = _obs.registry()
-            for slot in plan.decode_slots:
+            for slot in decode_slots:
                 rid = slot.request_id
                 if rid is None:
                     # released mid-step (re-entrant shutdown from an
@@ -835,11 +948,11 @@ class InferenceEngine:
                         bounds=ACCEPTED_BOUNDS,
                     ).observe(float(delivered), exemplar=rid)
             self.stats["decode_steps"] += 1
-            self.stats["busy_slot_steps"] += len(plan.decode_slots)
-        elif plan.decode_slots:
+            self.stats["busy_slot_steps"] += len(decode_slots)
+        elif decode_slots:
             token = np.zeros((self.pool.num_slots,), np.int32)
             pos = np.zeros((self.pool.num_slots,), np.int32)
-            for slot in plan.decode_slots:
+            for slot in decode_slots:
                 if paged:
                     # on-demand growth: the block holding slot.pos must be
                     # physical before the compiled scatter writes it (a
@@ -853,7 +966,7 @@ class InferenceEngine:
                     sampled, ck, cv = self._decode_fn(
                         self.params, ck, cv, jnp.asarray(token),
                         jnp.asarray(pos),
-                        jnp.asarray(self.pool.block_tables), sub,
+                        jnp.asarray(block_tables), sub,
                     )
                 else:
                     sampled, ck, cv = self._decode_fn(
@@ -863,7 +976,7 @@ class InferenceEngine:
                 sampled_host = np.asarray(sampled)  # the per-step sync point
             now = time.perf_counter()
             reg = _obs.registry()
-            for slot in plan.decode_slots:
+            for slot in decode_slots:
                 rid = slot.request_id
                 if rid is None:
                     # released mid-step (re-entrant shutdown from an
@@ -874,12 +987,19 @@ class InferenceEngine:
                     completed,
                 )
             self.stats["decode_steps"] += 1
-            self.stats["busy_slot_steps"] += len(plan.decode_slots)
+            self.stats["busy_slot_steps"] += len(decode_slots)
 
         self.pool.cache = {"k": ck, "v": cv}
+        if new_exports:
+            # publish AFTER the cache swap: the fleet's migration pump
+            # snapshots block payloads from self.pool.cache, which only
+            # now holds this tick's prefill writes
+            with self._work:
+                self._ready_exports.extend(new_exports)
+                self._work.notify_all()
         return {
             "prefills": len(plan.prefills),
-            "decoded": len(plan.decode_slots),
+            "decoded": len(decode_slots),
             "completed": completed,
         }
 
@@ -932,12 +1052,13 @@ class InferenceEngine:
                     ).observe(
                         completion.ttft_s, exemplar=rid
                     )
-            elif reg is not None and slot.last_token_at is not None:
-                reg.histogram(
-                    "rlt_serve_itl_seconds", bounds=LATENCY_BOUNDS
-                ).observe(
-                    now - slot.last_token_at, exemplar=rid
-                )
+            elif slot.last_token_at is not None:
+                itl = now - slot.last_token_at
+                self._recent_itls.append(itl)
+                if reg is not None:
+                    reg.histogram(
+                        "rlt_serve_itl_seconds", bounds=LATENCY_BOUNDS
+                    ).observe(itl, exemplar=rid)
             cb = self._on_token.get(rid)
             if cb is not None:
                 try:
@@ -994,6 +1115,284 @@ class InferenceEngine:
             reg.counter("rlt_serve_completions_total", reason=reason).inc()
 
     # ------------------------------------------------------------------ #
+    # disaggregated serving: KV export (prefill role) / import (decode)
+    # ------------------------------------------------------------------ #
+    def kv_fingerprint(self) -> str:
+        """Engine/layout identity a KV shipment must match to be
+        admitted. Paged layout only — shipments are block chains."""
+        if self.kv_layout != "paged":
+            raise ValueError(
+                "kv_fingerprint requires kv_layout='paged'"
+            )
+        cfg = self.cfg
+        return _migration.kv_fingerprint(
+            self.kv_layout,
+            self.pool.block_size,
+            (cfg.n_layers, cfg.n_kv_heads, self.pool.block_size,
+             cfg.head_dim),
+            str(self.pool.cache["k"].dtype),
+            self.pool.max_len,
+        )
+
+    def drain_ready_exports(self) -> List[str]:
+        """Pop the request ids whose prefill finished and whose KV is
+        ready to ship (prefill role only; empty otherwise)."""
+        with self._work:
+            out = self._ready_exports
+            self._ready_exports = []
+        return out
+
+    def export_shipment(self, request_id: str) -> "_migration.KVShipment":
+        """Snapshot a parked prefill's prompt-block KV into a checksummed
+        :class:`~.migration.KVShipment`.
+
+        Read-only and callable from the fleet's pump thread: the slot is
+        export-parked (the decode filter skips it, so its blocks are
+        never written), its prefix chains were pinned at arm time, and
+        ``self.pool.cache`` arrays are immutable jax values — a
+        concurrent tick swaps the dict but never mutates the blocks this
+        slot owns. The shipment carries ALL prompt blocks (including
+        source-shared ones): the receiver may not hold the chain."""
+        with self._work:
+            rec = self._exports.get(request_id)
+        if rec is None:
+            raise KeyError(f"request {request_id!r} has no parked export")
+        slot = self.pool.slots[rec["slot"]]
+        if slot.request_id != request_id:
+            raise KeyError(
+                f"request {request_id!r} no longer owns slot {rec['slot']}"
+            )
+        alloc = self.pool._alloc_of[rec["slot"]]
+        bs = self.pool.block_size
+        n_prompt_blocks = (slot.prompt_len - 1) // bs + 1
+        cache = self.pool.cache
+        block_k = []
+        block_v = []
+        for j in range(n_prompt_blocks):
+            bid = alloc.blocks[j]
+            block_k.append(np.asarray(cache["k"][:, bid]))
+            block_v.append(np.asarray(cache["v"][:, bid]))
+        prompt = self._export_prompt(request_id, slot)
+        return _migration.build_shipment(
+            request_id=request_id,
+            prompt=prompt,
+            fingerprint=self.kv_fingerprint(),
+            block_size=bs,
+            block_k=tuple(block_k),
+            block_v=tuple(block_v),
+        )
+
+    def _export_prompt(self, request_id: str, slot) -> tuple:
+        """The prompt tokens behind a parked slot. The scheduler's
+        Request is gone by prefill time, so the engine keeps the prompt
+        in the export record (stored at arm time by :meth:`step`)."""
+        with self._work:
+            rec = self._exports.get(request_id)
+        if rec is None or "prompt" not in rec:
+            raise KeyError(
+                f"request {request_id!r} has no recorded export prompt"
+            )
+        return tuple(rec["prompt"])
+
+    def finish_export(self, request_id: str) -> None:
+        """Migration landed: release the parked slot and finish the
+        source-side completion as ``"migrated"``. Executed by the engine
+        loop at the next tick (cross-thread pool mutations are always
+        routed through the loop)."""
+        with self._work:
+            self._export_actions.append((request_id, "finish"))
+            self._work.notify_all()
+
+    def cancel_export(self, request_id: str) -> None:
+        """Migration gave up: un-park the slot so the request decodes in
+        place on this (prefill) replica — the graceful-degradation
+        fallback. Executed by the engine loop at the next tick."""
+        with self._work:
+            self._export_actions.append((request_id, "cancel"))
+            self._work.notify_all()
+
+    def _process_export_actions(self) -> None:
+        """Engine-loop half of finish_export/cancel_export."""
+        if not self._export_actions:
+            return
+        with self._work:
+            actions = self._export_actions
+            self._export_actions = []
+        for rid, action in actions:
+            with self._work:
+                rec = self._exports.pop(rid, None)
+            if rec is None:
+                continue
+            slot = self.pool.slots[rec["slot"]]
+            if slot.request_id != rid:
+                continue  # slot already recycled (expiry / engine death)
+            self.pool.allocator.unpin(rec["pinned"])
+            if action == "finish":
+                slot.export_pending = False
+                self._finish(rid, "migrated")
+                if slot.trace is not None:
+                    self._tracer.finish(slot.trace, "migrated")
+                self.pool.release(slot.index)
+            else:  # cancel: resume decoding right here
+                slot.export_pending = False
+
+    def import_shipment(
+        self,
+        shipment: "_migration.KVShipment",
+        max_new_tokens: int,
+        request_id: Optional[str] = None,
+        eos_id: Any = "__default__",
+        on_token: Optional[Callable[[str, int], Any]] = None,
+        deadline_ms: Optional[float] = None,
+        priority: int = 0,
+        retries: int = 0,
+        timeout: Optional[float] = 30.0,
+    ) -> Completion:
+        """Admit a prefilled request from a KV shipment (decode role).
+
+        Callable from any thread: the admit itself (verify → fault point
+        → worst-case reservation → device install → resume) runs on the
+        engine loop thread via a ticket, so pool and allocator state are
+        never touched cross-thread. Blocks up to ``timeout`` seconds for
+        the verdict; on timeout the ticket is abandoned (the loop skips
+        it) and ``TimeoutError`` raises.
+
+        Raises :class:`~.migration.ShipmentMismatch` /
+        :class:`~.migration.ShipmentCorrupt` (rejected before any
+        payload touches the cache), :class:`~.migration.MigrationRejected`
+        (no slot/blocks under the worst-case reservation),
+        :class:`EngineClosed`, and whatever a scripted crash-mid-admit
+        fault kills the engine with."""
+        if self.kv_layout != "paged":
+            raise ValueError(
+                "import_shipment requires kv_layout='paged'"
+            )
+        rid = request_id or f"req-{next(self._req_counter)}"
+        if eos_id == "__default__":
+            eos_id = self.engine_config.eos_id
+        ticket = _ImportTicket(
+            shipment, rid, max_new_tokens, eos_id, on_token, deadline_ms,
+            priority, retries,
+        )
+        with self._work:
+            if self._closed:
+                raise EngineClosed(
+                    "engine is draining/shut down; no new shipments"
+                )
+            self._pending_imports.append(ticket)
+            self._work.notify_all()
+        if not ticket.event.wait(timeout):
+            with self._work:
+                ticket.abandoned = True
+            if not ticket.event.is_set():
+                raise TimeoutError(
+                    f"shipment {rid!r} not admitted within {timeout}s"
+                )
+        if ticket.error is not None:
+            raise ticket.error
+        assert ticket.completion is not None
+        return ticket.completion
+
+    def _process_imports(self) -> None:
+        """Engine-loop half of :meth:`import_shipment`. A scripted
+        crash-mid-admit fault re-raises out of here so the engine dies
+        exactly as a real receiver crash would — after answering the
+        waiting pump, so the sender observes the failed attempt instead
+        of a timeout."""
+        if not self._pending_imports:
+            return
+        with self._work:
+            tickets = self._pending_imports
+            self._pending_imports = []
+        for ticket in tickets:
+            with self._work:
+                if ticket.abandoned:
+                    continue
+            try:
+                ticket.completion = self._admit_import(ticket)
+            except BaseException as e:
+                ticket.error = e
+                ticket.event.set()
+                if isinstance(e, _faults.ServeFault):
+                    raise
+                continue
+            ticket.event.set()
+
+    def _admit_import(self, ticket: "_ImportTicket") -> Completion:
+        import jax.numpy as jnp
+
+        shipment = ticket.shipment
+        # gate order is the contract: checksum/fingerprint verification
+        # happens BEFORE the fault point and BEFORE any device write — a
+        # corrupt shipment is never decoded, not even by a crashing
+        # receiver
+        _migration.verify_shipment(shipment, self.kv_fingerprint())
+        self._import_seq += 1
+        _faults.migration_admit_fault(self.replica_index, self._import_seq)
+        prompt = tuple(int(t) for t in shipment.prompt)
+        rid = ticket.request_id
+        if rid in self._completions:
+            raise ValueError(f"duplicate request_id {rid!r}")
+        deadline = (
+            time.perf_counter() + float(ticket.deadline_ms) / 1e3
+            if ticket.deadline_ms is not None
+            else None
+        )
+        slot = self.pool.acquire(
+            rid, len(prompt), int(ticket.max_new_tokens),
+            eos_id=ticket.eos_id, prompt_tokens=prompt,
+            deadline=deadline, priority=ticket.priority,
+        )
+        if slot is None:
+            raise _migration.MigrationRejected(
+                f"shipment {rid!r}: no slot/blocks under the worst-case "
+                "reservation — decode replica at capacity"
+            )
+        # install the payloads this replica does not already share: the
+        # receiver's own prefix-cache hits (alloc.shared leading blocks)
+        # hold identical bytes by chain-key construction, everything
+        # else gets the shipped blocks. Eager scatter, not one of the
+        # two tracked jitted programs — compile_stats stays flat.
+        alloc = self.pool._alloc_of[slot.index]
+        bs = self.pool.block_size
+        n_prompt_blocks = (len(prompt) - 1) // bs + 1
+        write = [
+            (alloc.blocks[j], j)
+            for j in range(alloc.shared, n_prompt_blocks)
+        ]
+        if write:
+            ids = jnp.asarray([b for b, _ in write])
+            ck, cv = self.pool.cache["k"], self.pool.cache["v"]
+            ks = np.stack([shipment.block_k[j] for _, j in write], axis=1)
+            vs = np.stack([shipment.block_v[j] for _, j in write], axis=1)
+            ck = ck.at[:, ids].set(jnp.asarray(ks, ck.dtype))
+            cv = cv.at[:, ids].set(jnp.asarray(vs, cv.dtype))
+            self.pool.cache = {"k": ck, "v": cv}
+        # resume exactly where the colocated path would be after its own
+        # prefill: the next decode step re-runs the last prompt token at
+        # pos P-1 (idempotent KV rewrite), so the first emitted token —
+        # and every one after — is token-identical to generate()
+        slot.pos = len(prompt) - 1
+        slot.pending_token = prompt[-1]
+        if self._speculate_k > 0:
+            self._history[rid] = list(prompt)
+        completion = Completion(rid)
+        if self._tracer is not None:
+            slot.trace = self._tracer.start(
+                rid, len(prompt), int(ticket.max_new_tokens),
+                retries=ticket.retries,
+            )
+        with self._work:
+            self._completions[rid] = completion
+            if ticket.on_token is not None:
+                self._on_token[rid] = ticket.on_token
+        self.stats["prefills"] += 1
+        reg = _obs.registry()
+        if reg is not None:
+            reg.counter("rlt_serve_requests_total").inc()
+        return completion
+
+    # ------------------------------------------------------------------ #
     # deadlines + shedding
     # ------------------------------------------------------------------ #
     def _slo_breached(self) -> bool:
@@ -1016,6 +1415,13 @@ class InferenceEngine:
         now = time.perf_counter()
         for slot in self.pool.active_slots():
             if slot.deadline is not None and now > slot.deadline:
+                if slot.export_pending:
+                    # expiring a parked export: drop the record and unpin
+                    # its chains so they become evictable again
+                    with self._work:
+                        rec = self._exports.pop(slot.request_id, None)
+                    if rec is not None:
+                        self.pool.allocator.unpin(rec["pinned"])
                 self._expire(slot.request_id, slot.trace)
                 self.pool.release(slot.index)
 
@@ -1045,6 +1451,8 @@ class InferenceEngine:
         while True:
             with self._work:
                 while not self.scheduler.has_work():
+                    if self._pending_imports or self._export_actions:
+                        break  # migration work needs a tick even when idle
                     if self._stop_when_idle:
                         return
                     if led is not None:
@@ -1157,17 +1565,23 @@ class InferenceEngine:
 
         ``ttft_p95_ms`` is the p95 of the last ~128 first-token
         latencies (0.0 until any request finishes its first token) —
-        the latency half of the autoscaler's scale-up condition."""
-        ttfts = list(self._recent_ttfts)
-        p95 = 0.0
-        if ttfts:
-            from ray_lightning_tpu.observability.metrics import percentile
+        the latency half of the autoscaler's scale-up condition.
+        ``itl_p99_ms`` is the p99 of the last ~256 inter-token
+        latencies — the decode-pool scale signal under disaggregation.
+        ``role`` threads the pool membership through load beats so the
+        router and autoscaler can filter per pool."""
+        from ray_lightning_tpu.observability.metrics import percentile
 
-            p95 = percentile(ttfts, 95.0) * 1000.0
+        ttfts = list(self._recent_ttfts)
+        p95 = percentile(ttfts, 95.0) * 1000.0 if ttfts else 0.0
+        itls = list(self._recent_itls)
+        itl_p99 = percentile(itls, 99.0) * 1000.0 if itls else 0.0
         return {
             "queue_depth": self.scheduler.queue_depth,
             "active": self.pool.occupancy,
             "ttft_p95_ms": round(p95, 3),
+            "itl_p99_ms": round(itl_p99, 3),
+            "role": self._role,
         }
 
     def drain_request_records(self) -> List[Dict[str, Any]]:

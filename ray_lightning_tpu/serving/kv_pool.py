@@ -64,6 +64,11 @@ class Slot:
     # request was not head-sampled) — the decode loop's only per-token
     # tracing cost is reading this attribute
     trace: Optional[object] = None
+    # disaggregated serving: a prefill-role engine parks a freshly
+    # prefilled slot here while its KV shipment is in flight — the
+    # decode loop skips the slot, and a failed migration clears the flag
+    # so the request falls back to decoding in place
+    export_pending: bool = False
 
     @property
     def occupied(self) -> bool:
@@ -83,6 +88,7 @@ class Slot:
         self.deadline = None
         self.priority = 0
         self.trace = None
+        self.export_pending = False
 
 
 class KVSlotPool:

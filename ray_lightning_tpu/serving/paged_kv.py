@@ -91,6 +91,7 @@ class _ChainNode:
     refcount: int = 0  # active requests referencing this block
     children: int = 0  # cached chain nodes extending this one
     last_used: int = 0  # allocator LRU clock
+    pinned: int = 0  # in-flight KV shipments referencing this block
 
 
 @dataclass
@@ -150,6 +151,7 @@ class BlockAllocator:
         self._allocs: Dict[str, BlockAllocation] = {}
         self._chains: Dict[bytes, _ChainNode] = {}
         self._idle_cached = 0  # chain nodes with refcount == 0 (evictable)
+        self._pinned_idle = 0  # of those, pinned by an in-flight shipment
         self._reserved_total = 0
         self._clock = 0
         # lifetime counters (stats() + the serving gauges)
@@ -186,8 +188,11 @@ class BlockAllocator:
 
     def available(self) -> int:
         """Blocks an admission may claim: free + evictable cached,
-        minus everything already promised to active requests."""
-        return len(self._free) + self._idle_cached - self._reserved_total
+        minus everything already promised to active requests. Pinned
+        idle chains (an in-flight KV shipment references their bytes)
+        are NOT evictable and never counted as claimable supply."""
+        evictable = self._idle_cached - self._pinned_idle
+        return len(self._free) + evictable - self._reserved_total
 
     # ------------------------------------------------------------------ #
     # admission / growth / release
@@ -258,6 +263,8 @@ class BlockAllocator:
         for node in matched:
             if node.refcount == 0:
                 self._idle_cached -= 1
+                if node.pinned > 0:
+                    self._pinned_idle -= 1
             node.refcount += 1
             node.last_used = self._clock
         self.prefix_hits_total += shared
@@ -327,9 +334,50 @@ class BlockAllocator:
             node.last_used = self._clock
             if node.refcount == 0:
                 self._idle_cached += 1
+                if node.pinned > 0:
+                    self._pinned_idle += 1
         self._free.extend(alloc.blocks[alloc.cached:])
         self._reserved_total -= alloc.reserved
         self.released_total += 1
+
+    # ------------------------------------------------------------------ #
+    # shipment pinning
+    # ------------------------------------------------------------------ #
+    def pin_request(self, request_id: str) -> List[bytes]:
+        """Pin the cached-chain blocks of an active request for the
+        lifetime of an in-flight KV shipment. Returns the pinned chain
+        keys — the caller MUST hand them back to :meth:`unpin` when the
+        shipment lands or is abandoned.
+
+        This closes the migration eviction race: a shipment's payload
+        references chain blocks by content, and if a sibling request
+        releases the chain mid-transfer the refcount transiently hits 0
+        — without the pin, allocation pressure could LRU-evict and
+        rewrite those physical blocks while the shipment (or a retry
+        resend reading from the cache) still needs their bytes."""
+        alloc = self._allocs.get(request_id)
+        if alloc is None:
+            raise KeyError(f"request {request_id!r} is not admitted")
+        self.pin(alloc.chain_keys)
+        return list(alloc.chain_keys)
+
+    def pin(self, chain_keys: Sequence[bytes]) -> None:
+        for key in chain_keys:
+            node = self._chains.get(key)
+            if node is None:
+                continue
+            node.pinned += 1
+            if node.refcount == 0 and node.pinned == 1:
+                self._pinned_idle += 1
+
+    def unpin(self, chain_keys: Sequence[bytes]) -> None:
+        for key in chain_keys:
+            node = self._chains.get(key)
+            if node is None:
+                continue
+            node.pinned = max(0, node.pinned - 1)
+            if node.refcount == 0 and node.pinned == 0:
+                self._pinned_idle -= 1
 
     # ------------------------------------------------------------------ #
     # internals
@@ -362,11 +410,13 @@ class BlockAllocator:
 
     def _evict_lru(self) -> Optional[int]:
         """Evict the least-recently-used refcount-0 LEAF chain node
-        (leaf-first keeps every cached chain reachable from its root)."""
+        (leaf-first keeps every cached chain reachable from its root).
+        Pinned nodes are untouchable: an in-flight KV shipment still
+        references their bytes even when no active request does."""
         victim_key = None
         victim = None
         for key, node in self._chains.items():
-            if node.refcount == 0 and node.children == 0:
+            if node.refcount == 0 and node.children == 0 and node.pinned == 0:
                 if victim is None or node.last_used < victim.last_used:
                     victim_key, victim = key, node
         if victim is None:
@@ -388,6 +438,9 @@ class BlockAllocator:
             "blocks_reserved": self._reserved_total,
             "blocks_highwater": self.blocks_highwater,
             "chains_cached": len(self._chains),
+            "chains_pinned": sum(
+                1 for n in self._chains.values() if n.pinned > 0
+            ),
             "admitted_total": self.admitted_total,
             "released_total": self.released_total,
             "grown_total": self.grown_total,

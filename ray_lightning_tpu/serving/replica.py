@@ -35,6 +35,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_lightning_tpu import observability as _obs
 from ray_lightning_tpu.observability import metrics as _metrics
+from ray_lightning_tpu.runtime import faults as _faults
+from ray_lightning_tpu.serving import migration as _migration
 from ray_lightning_tpu.serving.resilience import (
     BREAKER_CLOSED,
     CircuitBreaker,
@@ -76,6 +78,7 @@ def pick_least_loaded(
     num_replicas: int,
     rr_counter: int,
     indices: Optional[Sequence[int]] = None,
+    role: Optional[str] = None,
 ) -> int:
     """Pick a replica index: min (queue_depth + active); replicas with no
     load report yet count as load 0 (fresh replicas attract traffic).
@@ -85,7 +88,13 @@ def pick_least_loaded(
     ``indices`` restricts routing to an explicit set of replica indices
     (an elastic fleet's indices are sparse: draining replicas are
     excluded, added ones need not be contiguous); the default is the
-    dense ``range(num_replicas)``."""
+    dense ``range(num_replicas)``.
+
+    ``role`` restricts routing to one disaggregated pool: only replicas
+    whose load report carries that ``role`` (``"both"`` always matches;
+    a replica with no report yet is excluded — pool membership unknown).
+    The ``None`` default skips the filter entirely, so homogeneous
+    fleets route byte-identically to before."""
     if indices is None:
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
@@ -94,6 +103,13 @@ def pick_least_loaded(
         indices = list(indices)
         if not indices:
             raise ValueError("no routable replicas")
+    if role is not None:
+        indices = [
+            i for i in indices
+            if (loads.get(i) or {}).get("role") in (role, "both")
+        ]
+        if not indices:
+            raise ValueError(f"no routable replicas in the {role!r} pool")
 
     def load_of(i: int) -> float:
         entry = loads.get(i) or {}
@@ -112,13 +128,18 @@ def autoscale_decision(
     queue_high: float = 4.0,
     ttft_high_ms: Optional[float] = None,
     slo_breached: bool = False,
+    itl_high_ms: Optional[float] = None,
+    role: Optional[str] = None,
 ) -> int:
     """Pure scaling verdict: +1 (add a replica), -1 (drain one), or 0.
 
     Scale UP when demand outruns the fleet — mean queue depth per
     replica exceeds ``queue_high``, any replica's recent TTFT p95
     exceeds ``ttft_high_ms`` (latency degrades before queues explode
-    when prompts are long), or an SLO burn-rate breach is firing
+    when prompts are long), any replica's inter-token latency p99
+    exceeds ``itl_high_ms`` (the decode-pool signal under
+    disaggregation: decode saturation degrades ITL while queues sit on
+    the prefill pool), or an SLO burn-rate breach is firing
     (``slo_breached``, see :mod:`~..observability.slo` — a principled
     verdict rather than a raw percentile). Scale DOWN only when the
     fleet is completely idle (zero queued AND zero active everywhere)
@@ -126,16 +147,29 @@ def autoscale_decision(
     trade capacity for nothing. Bounds are clamped to [min_replicas,
     max_replicas]; hysteresis (cooldowns, consecutive idle ticks) is the
     :class:`Autoscaler`'s job, not this function's — keeping the verdict
-    stateless is what makes it unit-testable."""
+    stateless is what makes it unit-testable.
+
+    ``role`` scopes the verdict to one disaggregated pool: only load
+    reports carrying that ``role`` (or ``"both"``) count, and
+    ``num_replicas`` should then be that pool's size. The ``None``
+    default considers every report — homogeneous fleets are unchanged.
+    The intended split: the PREFILL pool scales on ``queue_high``
+    (admission queues back up there) and the DECODE pool on
+    ``itl_high_ms`` (its saturation signal)."""
     if min_replicas < 1:
         raise ValueError("min_replicas must be >= 1")
     if max_replicas < min_replicas:
         raise ValueError("max_replicas must be >= min_replicas")
     entries = [e or {} for e in loads.values()]
+    if role is not None:
+        entries = [e for e in entries if e.get("role") in (role, "both")]
     total_queued = sum(float(e.get("queue_depth", 0)) for e in entries)
     total_active = sum(float(e.get("active", 0)) for e in entries)
     worst_ttft = max(
         (float(e.get("ttft_p95_ms", 0.0)) for e in entries), default=0.0
+    )
+    worst_itl = max(
+        (float(e.get("itl_p99_ms", 0.0)) for e in entries), default=0.0
     )
     if num_replicas < max_replicas:
         if slo_breached:
@@ -143,6 +177,8 @@ def autoscale_decision(
         if total_queued / max(num_replicas, 1) > queue_high:
             return 1
         if ttft_high_ms is not None and worst_ttft > ttft_high_ms:
+            return 1
+        if itl_high_ms is not None and worst_itl > itl_high_ms:
             return 1
     if (
         num_replicas > min_replicas
@@ -182,6 +218,8 @@ class Autoscaler:
         cooldown_s: float = 0.0,
         idle_ticks_down: int = 2,
         slo_monitor: Optional[Any] = None,
+        itl_high_ms: Optional[float] = None,
+        role: Optional[str] = None,
     ):
         if idle_ticks_down < 1:
             raise ValueError("idle_ticks_down must be >= 1")
@@ -190,6 +228,12 @@ class Autoscaler:
         self.max_replicas = int(max_replicas)
         self.queue_high = float(queue_high)
         self.ttft_high_ms = ttft_high_ms
+        # per-pool autoscaling under disaggregation: one Autoscaler per
+        # pool, scoped by role. The prefill scaler keys off queue depth
+        # (queue_high), the decode scaler off itl_high_ms; role=None is
+        # the homogeneous whole-fleet scaler, unchanged.
+        self.itl_high_ms = itl_high_ms
+        self.role = role
         self.cooldown_s = float(cooldown_s)
         self.idle_ticks_down = int(idle_ticks_down)
         # optional observability.slo.SLOMonitor: a firing burn-rate
@@ -211,19 +255,29 @@ class Autoscaler:
     def tick(self, now: Optional[float] = None) -> int:
         """Evaluate once; returns the applied delta (-1, 0, +1)."""
         now = time.monotonic() if now is None else now
-        n = int(self.fleet.num_replicas)
+        loads = self.fleet.loads()
+        if self.role is None:
+            n = int(self.fleet.num_replicas)
+        else:
+            # pool size = replicas reporting membership in this pool
+            n = sum(
+                1 for e in loads.values()
+                if (e or {}).get("role") in (self.role, "both")
+            )
         slo_breached = False
         if self.slo_monitor is not None:
             self.slo_monitor.evaluate(reg=_obs.registry())
             slo_breached = self.slo_monitor.breached()
         delta = autoscale_decision(
-            self.fleet.loads(),
+            loads,
             n,
             self.min_replicas,
             self.max_replicas,
             queue_high=self.queue_high,
             ttft_high_ms=self.ttft_high_ms,
             slo_breached=slo_breached,
+            itl_high_ms=self.itl_high_ms,
+            role=self.role,
         )
         if delta <= 0:
             # the scale-up pressure is gone: clear any capacity_blocked
@@ -243,7 +297,10 @@ class Autoscaler:
                 delta = 0
         if delta > 0:
             try:
-                self.fleet.add_replica()
+                if self.role is None:
+                    self.fleet.add_replica()
+                else:
+                    self.fleet.add_replica(role=self.role)
             except CapacityBlocked as exc:
                 # the fleet wants a replica it has no device for: report
                 # it loudly (the arbiter's borrow signal) instead of
@@ -268,7 +325,10 @@ class Autoscaler:
                 self.capacity_blocked_streak = 0
                 self.last_outcome = "scale_up"
         elif delta < 0:
-            self.fleet.remove_replica()
+            if self.role is None:
+                self.fleet.remove_replica()
+            else:
+                self.fleet.remove_replica(role=self.role)
             self.scale_downs += 1
             self._idle_streak = 0
             self.last_outcome = "scale_down"
@@ -334,6 +394,40 @@ class _LoadTap:
             return {k: dict(v) for k, v in self.loads.items()}
 
 
+class _Migration:
+    """Pump-side state of one in-flight prefill→decode KV migration.
+
+    Keyed by the SOURCE attempt rid. The shipment is exported once and
+    reused across retries (a corrupt delivery is simulated on a copy, so
+    the clean bytes survive for the next attempt). ``tried`` accumulates
+    decode replicas already attempted so a retry lands elsewhere."""
+
+    __slots__ = (
+        "entry", "source", "source_rid", "source_completion",
+        "shipment", "attempts", "next_at", "tried", "started_at",
+    )
+
+    def __init__(self, entry, source, source_rid, source_completion):
+        self.entry = entry
+        self.source = int(source)
+        self.source_rid = source_rid
+        self.source_completion = source_completion
+        self.shipment = None
+        self.attempts = 0
+        self.next_at = 0.0
+        self.tried: set = set()
+        self.started_at = time.perf_counter()
+
+
+# Transfer-time histogram bounds (milliseconds): in-process handoffs sit
+# in the sub-ms buckets, cross-host RDMA/TCP shipments in the tens-to-
+# hundreds range.
+_TRANSFER_MS_BOUNDS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 5000.0,
+)
+
+
 # --------------------------------------------------------------------- #
 # threads-as-replicas fleet (single process; the autoscaler's CPU target)
 # --------------------------------------------------------------------- #
@@ -388,6 +482,8 @@ class LocalReplicaFleet:
         drain_timeout: float = 60.0,
         pump_interval_s: float = 0.02,
         capacity: Optional[int] = None,
+        prefill_replicas: int = 0,
+        migration_policy: Optional[_migration.MigrationPolicy] = None,
     ):
         # device capacity: how many replicas the fleet's share of the
         # reservation can host. None = unbounded (the pre-arbiter
@@ -423,8 +519,40 @@ class LocalReplicaFleet:
         # optional DriverAggregator: flight-record events + incident
         # sources (attach_aggregator) — None keeps the fleet standalone
         self._aggregator: Optional[Any] = None
-        for _ in range(int(initial_replicas)):
-            self.add_replica()
+        # ---- disaggregated prefill/decode serving -------------------- #
+        # prefill_replicas > 0 splits the fleet: the first N initial
+        # replicas form the PREFILL pool (engines park freshly prefilled
+        # slots and the pump ships their KV), the rest form the DECODE
+        # pool. 0 keeps the fleet homogeneous — every engine role "both",
+        # byte-identical to the colocated path.
+        pf = int(prefill_replicas)
+        if pf < 0:
+            raise ValueError("prefill_replicas must be >= 0")
+        if pf and pf >= int(initial_replicas):
+            raise ValueError(
+                f"prefill_replicas ({pf}) must leave at least one decode "
+                f"replica (initial_replicas={initial_replicas})"
+            )
+        if pf and self._engine_kwargs.get("kv_layout") != "paged":
+            raise ValueError(
+                "disaggregated serving ships paged KV block chains: set "
+                "engine_kwargs kv_layout='paged'"
+            )
+        self.disaggregated = pf > 0
+        self.migration_policy = migration_policy or _migration.MigrationPolicy()
+        self.migration_stats = _migration.MigrationStats()
+        self.roles: Dict[int, str] = {}
+        self._migrations: Dict[str, _Migration] = {}  # source rid -> state
+        self._ship_seq: Dict[int, int] = {}  # source idx -> shipments sent
+        # warm-chain affinity: first-block chain key -> prefill replica
+        # whose prefix cache holds it (best-effort, bounded)
+        self._affinity: Dict[bytes, int] = {}
+        self._affinity_bs = int(self._engine_kwargs.get("block_size", 16))
+        for k in range(int(initial_replicas)):
+            if self.disaggregated:
+                self.add_replica(role="prefill" if k < pf else "decode")
+            else:
+                self.add_replica()
         self._pump_thread = threading.Thread(
             target=self._pump_loop, daemon=True, name="rlt-fleet-pump"
         )
@@ -465,11 +593,20 @@ class LocalReplicaFleet:
                 self.breakers[index] = breaker
             return breaker
 
-    def add_replica(self, index: Optional[int] = None) -> int:
+    def add_replica(
+        self, index: Optional[int] = None, role: Optional[str] = None
+    ) -> int:
         """Build + start one engine. ``index=None`` allocates a fresh
         index (scale-up); an explicit index is the relaunch path — the
         new engine inherits the index's circuit breaker, so a replica
         that died with an open breaker still has to pass its probe.
+
+        ``role`` assigns the replica to a disaggregated pool
+        (``"prefill"`` / ``"decode"``). Default: a relaunch keeps its
+        old pool (a dead prefill replica comes back as a prefill
+        replica); scale-up lands in the decode pool when disaggregated
+        (decode is the elastic pool — prefill capacity is sized
+        explicitly), and role ``"both"`` when homogeneous.
 
         Scale-up (``index=None``) raises :class:`CapacityBlocked` when
         the fleet is already at its device ``capacity``; relaunches keep
@@ -498,8 +635,19 @@ class LocalReplicaFleet:
                 self._next_index += 1
             else:
                 self._next_index = max(self._next_index, index + 1)
+        if role is None:
+            role = self.roles.get(
+                index, "decode" if self.disaggregated else "both"
+            )
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"unknown replica role {role!r}")
+        ekw = dict(self._engine_kwargs)
+        if role != "both":
+            # the homogeneous path never touches the kwargs: EngineConfig
+            # stays literally what HEAD built, byte-identical
+            ekw["role"] = role
         engine = InferenceEngine(
-            params, cfg, EngineConfig(**self._engine_kwargs),
+            params, cfg, EngineConfig(**ekw),
             replica_index=index,
         )
         # resolve both programs before the replica becomes routable: on a
@@ -509,21 +657,38 @@ class LocalReplicaFleet:
         engine.start()
         with self._lock:
             self._replicas[index] = engine
+            self.roles[index] = role
             self.routed_total.setdefault(index, 0)
         self._breaker(index)
         self.added_total += 1
         self._publish_size()
         return index
 
-    def remove_replica(self, index: Optional[int] = None) -> Optional[int]:
+    def num_replicas_of(self, role: str) -> int:
+        """Routable replicas in one pool (``"both"`` counts for both)."""
+        with self._lock:
+            return sum(
+                1 for i in self._replicas
+                if self.roles.get(i, "both") in (role, "both")
+            )
+
+    def remove_replica(
+        self, index: Optional[int] = None, role: Optional[str] = None
+    ) -> Optional[int]:
         """Gracefully drain one replica (default: the newest). Returns
         its index, or ``None`` when the fleet is down to one replica —
-        the fleet never drains itself to zero."""
+        the fleet never drains itself to zero. ``role`` scopes the
+        pick (and the one-replica floor) to one disaggregated pool:
+        a decode scale-down never drains the last decode replica."""
         with self._lock:
-            if len(self._replicas) <= 1:
+            candidates = [
+                i for i in self._replicas
+                if role is None or self.roles.get(i, "both") in (role, "both")
+            ]
+            if len(self._replicas) <= 1 or len(candidates) <= 1:
                 return None
             if index is None:
-                index = max(self._replicas)
+                index = max(candidates)
             engine = self._replicas.pop(index)  # leaves routing NOW
             self._draining[index] = engine
 
@@ -645,20 +810,61 @@ class LocalReplicaFleet:
             for i, eng in replicas.items()
             if i not in exclude and eng.alive
         }
-        closed: List[int] = []
-        probe: Optional[int] = None
-        for i in sorted(live):
-            breaker = self._breaker(i)
-            if breaker.state == BREAKER_CLOSED:
-                closed.append(i)
-            elif probe is None and breaker.allow_request():
-                # the one post-cooldown probe: this request IS the canary
-                probe = i
+
+        def _scan(cands: List[int]) -> Tuple[List[int], Optional[int]]:
+            closed: List[int] = []
+            probe: Optional[int] = None
+            for i in sorted(cands):
+                breaker = self._breaker(i)
+                if breaker.state == BREAKER_CLOSED:
+                    closed.append(i)
+                elif probe is None and breaker.allow_request():
+                    # the one post-cooldown probe: this request IS the
+                    # canary
+                    probe = i
+            return closed, probe
+
+        affinity_pool = False
+        if self.disaggregated:
+            # pool-aware routing: new work prefills on the PREFILL pool
+            # (the pump migrates its KV to a decode replica after the
+            # prompt pass)...
+            prefill = [
+                i for i in live if self.roles.get(i) == "prefill"
+            ]
+            closed, probe = _scan(prefill)
+            affinity_pool = True
+            if not closed and probe is None:
+                # ...and when no prefill replica is routable (all dead,
+                # breaker-open, or draining), the ladder degrades to
+                # COLOCATED serving on the decode pool — decode engines
+                # keep full prefill capability exactly for this
+                affinity_pool = False
+                closed, probe = _scan(
+                    [i for i in live if self.roles.get(i) != "prefill"]
+                )
+                if closed or probe is not None:
+                    _obs.event(
+                        "serve_migration_route_fallback",
+                        request_id=entry.request_id,
+                    )
+        else:
+            closed, probe = _scan(list(live))
         if probe is not None:
             index = probe
         elif closed:
-            loads = {i: live[i].load() for i in closed}
-            index = pick_least_loaded(loads, 0, rr, indices=closed)
+            index = None
+            if affinity_pool:
+                # prefix-cache-aware routing: a prefill replica that
+                # recently built this prompt's first block chain serves
+                # the warm chain from its prefix cache (shared blocks,
+                # no recompute) instead of prefilling cold elsewhere
+                warm = self._affinity.get(self._affinity_key(entry.prompt))
+                if warm in closed:
+                    index = warm
+            if index is None:
+                loads = {i: live[i].load() for i in closed}
+                index = pick_least_loaded(loads, 0, rr, indices=closed)
         else:
             # nothing routable this instant (all dead/open/draining):
             # park for the pump — relaunch or a cooldown will free a slot
@@ -706,11 +912,31 @@ class LocalReplicaFleet:
         self.journal.bind(entry, completion)
         with self._lock:
             self.routed_total[index] = self.routed_total.get(index, 0) + 1
+            if self.disaggregated and self.roles.get(index) == "prefill":
+                # this replica's prefix cache now holds the prompt's
+                # block chain: steer same-prefix requests back to it
+                if len(self._affinity) > 4096:
+                    self._affinity.clear()  # bounded, best-effort
+                self._affinity[self._affinity_key(entry.prompt)] = index
         _obs.event(
             "req/route", request_id=rid, replica=index,
             attempt=entry.attempts, track=f"req {entry.request_id}",
         )
         return True
+
+    def _affinity_key(self, prompt: Sequence[int]) -> bytes:
+        """First-block chain key of a prompt, mirrored host-side (same
+        rolling-hash seed as the paged allocator's ``_chain_keys``): the
+        warm-chain affinity map's key. Prompts shorter than one block
+        hash what they have — still a valid grouping key."""
+        import hashlib
+
+        import numpy as np
+
+        chunk = np.asarray(
+            list(prompt[: self._affinity_bs]), dtype=np.int64
+        ).tobytes()
+        return hashlib.sha256(chunk).digest()
 
     def _expire(self, entry: JournalEntry) -> None:
         self.journal.finish(entry, "expired", finish_reason="expired")
@@ -757,6 +983,9 @@ class LocalReplicaFleet:
             self._pump_locked()
 
     def _pump_locked(self) -> None:
+        # 0) disaggregation: collect parked exports, drive KV migrations
+        if self.disaggregated:
+            self._pump_migrations()
         # 1) settle finished attempts
         for entry in self.journal.inflight():
             with entry._lock:
@@ -818,6 +1047,257 @@ class LocalReplicaFleet:
             breakers = dict(self.breakers)
         publish_breaker_states(breakers)
 
+    # ---------------- disaggregated KV migration ----------------------- #
+    def _pump_migrations(self) -> None:
+        """One migration sweep: adopt freshly parked exports from every
+        prefill replica, then drive each in-flight migration's
+        send → verify → admit ladder (bounded attempts, exponential
+        backoff, graceful fallback to colocated decode)."""
+        with self._lock:
+            replicas = dict(self._replicas)
+        for idx, eng in replicas.items():
+            if self.roles.get(idx) != "prefill" or not eng.alive:
+                continue
+            for rid in eng.drain_ready_exports():
+                entry = self.journal.get(rid.split("~", 1)[0])
+                if entry is None:
+                    eng.cancel_export(rid)
+                    continue
+                with entry._lock:
+                    stale = entry.done or entry.attempt_rid != rid
+                    comp = entry.attempt_completion
+                if stale:
+                    # the journal moved on (finished/expired/superseded)
+                    # while the export sat parked: decode in place, the
+                    # stream guard discards any stale tokens
+                    eng.cancel_export(rid)
+                    continue
+                self._migrations[rid] = _Migration(entry, idx, rid, comp)
+        if not self._migrations:
+            return
+        now = time.perf_counter()
+        finished: List[str] = []
+        for rid, mig in list(self._migrations.items()):
+            if now >= mig.next_at and self._attempt_migration(mig):
+                finished.append(rid)
+        for rid in finished:
+            self._migrations.pop(rid, None)
+
+    def _pick_decode_target(self, exclude: set) -> Optional[int]:
+        """Pool-aware receiver choice: least-loaded decode replica whose
+        breaker admits traffic (half-open probe as last resort); ``None``
+        when the decode pool is unroutable this instant."""
+        with self._lock:
+            replicas = dict(self._replicas)
+            rr = self._rr
+            self._rr += 1
+        cands = [
+            i for i, e in replicas.items()
+            if i not in exclude and e.alive
+            and self.roles.get(i, "both") in ("decode", "both")
+        ]
+        closed: List[int] = []
+        probe: Optional[int] = None
+        for i in sorted(cands):
+            breaker = self._breaker(i)
+            if breaker.state == BREAKER_CLOSED:
+                closed.append(i)
+            elif probe is None and breaker.allow_request():
+                probe = i
+        if closed:
+            loads = {i: replicas[i].load() for i in closed}
+            return pick_least_loaded(loads, 0, rr, indices=closed)
+        return probe
+
+    def _attempt_migration(self, mig: _Migration) -> bool:
+        """Run one attempt of one migration. Returns True when the
+        record is finished (migrated, fallen back, or abandoned); False
+        parks it for a backed-off retry."""
+        entry = mig.entry
+        with self._lock:
+            src = self._replicas.get(mig.source)
+        with entry._lock:
+            stale = entry.done or entry.attempt_rid != mig.source_rid
+            if mig.source_completion is None:
+                # the export was adopted between submit() and the
+                # journal's bind — pick the completion up now
+                mig.source_completion = entry.attempt_completion
+        if stale:
+            if src is not None and src.alive:
+                src.cancel_export(mig.source_rid)
+            return True
+        if (
+            src is None
+            or not src.alive
+            or (
+                mig.source_completion is not None
+                and mig.source_completion.done
+            )
+        ):
+            # the source died (or errored) with the parked slot: the
+            # settle/relaunch stages own that recovery — a normal,
+            # breaker-charged retry on another replica
+            return True
+        policy = self.migration_policy
+        reg = _obs.registry()
+        mig.attempts += 1
+        self.migration_stats.attempts += 1
+        if reg is not None:
+            reg.counter(_metrics.SERVE_MIGRATION_ATTEMPTS_METRIC).inc()
+        failure: Optional[str] = None
+        corrupt = False
+        began = False
+        charge_dst: Optional[int] = None
+        dst_idx: Optional[int] = None
+        completion = None
+        rid2 = None
+        t0 = time.perf_counter()
+        try:
+            if mig.shipment is None:
+                # exported once, reused across retries: a corrupt
+                # delivery is simulated on a copy so the clean bytes
+                # survive for the next attempt
+                mig.shipment = src.export_shipment(mig.source_rid)
+            ship = mig.shipment
+            # scripted send-side faults, keyed on the SOURCE replica and
+            # its 1-based shipment sequence (stall sleeps in place)
+            with self._lock:
+                seq = self._ship_seq.get(mig.source, 0) + 1
+                self._ship_seq[mig.source] = seq
+            spec = _faults.migration_send_fault(mig.source, seq)
+            if spec is not None and spec.kind == "drop-shipment":
+                raise _migration.ShipmentError(
+                    f"scripted fault: shipment #{seq} from replica "
+                    f"{mig.source} dropped in flight"
+                )
+            if spec is not None and spec.kind == "corrupt-shipment":
+                ship = _migration.corrupt_copy(ship)
+            if time.perf_counter() - t0 > policy.send_timeout_s:
+                raise _migration.ShipmentError(
+                    f"shipment #{seq} send exceeded "
+                    f"{policy.send_timeout_s}s"
+                )
+            dst_idx = self._pick_decode_target(
+                exclude=mig.tried | {mig.source}
+            )
+            if dst_idx is None:
+                raise _migration.MigrationRejected(
+                    "no routable decode replica (pool at capacity or "
+                    "fully breaker-open)"
+                )
+            mig.tried.add(dst_idx)
+            with self._lock:
+                dst = self._replicas.get(dst_idx)
+            if dst is None or not dst.alive:
+                raise _migration.MigrationRejected(
+                    f"decode replica {dst_idx} vanished before admit"
+                )
+            # the handoff is journaled as a MIGRATION attempt (~m<K>):
+            # attempts does not advance, no retry is charged — a clean
+            # migration is routing, not failure recovery
+            rid2, _prompt, budget = self.journal.begin_attempt(
+                entry, dst_idx, migration=True
+            )
+            began = True
+            remaining_ms = (
+                max((entry.deadline - time.perf_counter()) * 1e3, 0.0)
+                if entry.deadline is not None
+                else None
+            )
+            completion = dst.import_shipment(
+                ship,
+                max_new_tokens=budget,
+                request_id=rid2,
+                eos_id=entry.eos_id,
+                on_token=self.journal.stream_guard(entry, rid2),
+                deadline_ms=remaining_ms,
+                priority=entry.priority,
+                retries=entry.attempts - 1,
+                timeout=policy.admit_timeout_s,
+            )
+        except _migration.ShipmentCorrupt as e:
+            # the receiver's checksum gate caught it BEFORE any device
+            # write — the corrupt payload was never decoded. Rejecting
+            # garbage proves the receiver HEALTHY: keep it eligible for
+            # the clean resend instead of burning the pool
+            corrupt = True
+            failure = str(e)
+            if dst_idx is not None:
+                mig.tried.discard(dst_idx)
+        except _migration.MigrationRejected as e:
+            failure = str(e)  # capacity verdict: no breaker charge
+        except Exception as e:
+            failure = repr(e)
+            if dst_idx is not None and began:
+                # receiver-side crash/timeout mid-admit: the decode
+                # replica earns a breaker failure like any other death
+                charge_dst = dst_idx
+        if failure is None:
+            self.journal.bind(entry, completion)
+            src.finish_export(mig.source_rid)
+            transfer_ms = (time.perf_counter() - t0) * 1e3
+            nbytes = mig.shipment.nbytes()
+            st = self.migration_stats
+            st.verified += 1
+            st.migrated += 1
+            st.bytes_shipped += nbytes
+            st.transfer_ms.append(transfer_ms)
+            if reg is not None:
+                reg.counter(_metrics.SERVE_MIGRATION_VERIFIED_METRIC).inc()
+                reg.counter(_metrics.SERVE_MIGRATION_BYTES_METRIC).inc(
+                    nbytes
+                )
+                reg.histogram(
+                    _metrics.SERVE_MIGRATION_TRANSFER_MS_METRIC,
+                    bounds=_TRANSFER_MS_BOUNDS,
+                ).observe(transfer_ms, exemplar=rid2)
+            with self._lock:
+                self.routed_total[dst_idx] = (
+                    self.routed_total.get(dst_idx, 0) + 1
+                )
+            _obs.event(
+                "serve_migration", request_id=entry.request_id,
+                source=mig.source, dest=dst_idx,
+                attempt=mig.attempts, bytes=nbytes,
+            )
+            return True
+        # ---- failed attempt ------------------------------------------ #
+        if began:
+            # the shipment never landed, but the source still holds the
+            # prefilled slot: point the journal back at the source
+            # attempt — from the request's view it never left, and no
+            # attempt/retry is charged
+            self.journal.restore_attempt(
+                entry, mig.source, mig.source_rid, mig.source_completion
+            )
+        if charge_dst is not None:
+            self._breaker(charge_dst).record_failure()
+        st = self.migration_stats
+        if corrupt:
+            st.corrupt += 1
+            if reg is not None:
+                reg.counter(_metrics.SERVE_MIGRATION_CORRUPT_METRIC).inc()
+        if mig.attempts >= policy.max_attempts:
+            # retry budget exhausted: graceful degradation — un-park the
+            # slot so the request decodes on the PREFILL replica, counted
+            # and alarmed but never dropped
+            st.fallbacks += 1
+            if reg is not None:
+                reg.counter(
+                    _metrics.SERVE_MIGRATION_FALLBACKS_METRIC
+                ).inc()
+            _obs.event(
+                "serve_migration_fallback", request_id=entry.request_id,
+                source=mig.source, attempts=mig.attempts, error=failure,
+            )
+            src.cancel_export(mig.source_rid)
+            return True
+        st.retries += 1
+        if reg is not None:
+            reg.counter(_metrics.SERVE_MIGRATION_RETRIES_METRIC).inc()
+        mig.next_at = time.perf_counter() + policy.backoff(mig.attempts)
+        return False
+
     def attach_aggregator(self, aggregator: Any) -> None:
         """Couple the fleet to a DriverAggregator: replica deaths land in
         the flight record and the request-journal summary becomes an
@@ -832,9 +1312,23 @@ class LocalReplicaFleet:
         out["relaunches"] = self.relaunches_total
         out["routed"] = dict(self.routed_total)
         out["breakers"] = {i: b.state for i, b in self.breakers.items()}
+        if self.disaggregated:
+            out["roles"] = dict(self.roles)
+            out["migration"] = self.migration_stats.as_dict()
         return out
 
     def shutdown(self) -> None:
+        if self.disaggregated:
+            # un-park every export still waiting on a migration: a parked
+            # slot never finishes on its own, and the drains below wait
+            # for occupancy to hit zero
+            with self._pump_gate:
+                for rid, mig in list(self._migrations.items()):
+                    with self._lock:
+                        src = self._replicas.get(mig.source)
+                    if src is not None and src.alive:
+                        src.cancel_export(rid)
+                self._migrations.clear()
         with self._lock:
             engines = list(self._replicas.values())
             self._replicas.clear()

@@ -250,9 +250,9 @@ class JournalEntry:
     __slots__ = (
         "request_id", "prompt", "max_new_tokens", "eos_id", "priority",
         "deadline", "max_retries", "on_token", "delivered", "attempts",
-        "replica", "replica_history", "attempt_rid", "attempt_completion",
-        "disposition", "finish_reason", "error", "submitted_at",
-        "first_token_at", "_done", "_lock",
+        "migrations", "retries_counted", "replica", "replica_history",
+        "attempt_rid", "attempt_completion", "disposition", "finish_reason",
+        "error", "submitted_at", "first_token_at", "_done", "_lock",
     )
 
     def __init__(
@@ -276,6 +276,8 @@ class JournalEntry:
         self.max_retries = int(max_retries)
         self.delivered: List[int] = []
         self.attempts = 0
+        self.migrations = 0
+        self.retries_counted = 0
         self.replica: Optional[int] = None
         self.replica_history: List[int] = []
         self.attempt_rid: Optional[str] = None
@@ -366,7 +368,7 @@ class RequestJournal:
         return entry
 
     def begin_attempt(
-        self, entry: JournalEntry, replica: int
+        self, entry: JournalEntry, replica: int, migration: bool = False
     ) -> Tuple[str, Tuple[int, ...], int]:
         """Start (re)dispatch of ``entry`` to ``replica``.
 
@@ -375,16 +377,27 @@ class RequestJournal:
         everything the client already has) and the budget is whatever is
         left of ``max_new_tokens`` — under greedy sampling the
         continuation is bitwise-identical to the unfaulted stream.
+
+        ``migration=True`` marks a planned cross-pool handoff (prefill →
+        decode KV shipment) rather than a failure recovery: the entry
+        moves to a new replica under a fresh ``~m<K>`` attempt id, but
+        ``attempts`` does NOT advance — a clean migration is not a retry
+        and must not burn the request's retry budget or inflate the
+        retry metrics.
         """
         with entry._lock:
-            entry.attempts += 1
+            if migration:
+                entry.migrations += 1
+                rid = f"{entry.request_id}~m{entry.migrations}"
+            else:
+                entry.attempts += 1
+                rid = (
+                    entry.request_id
+                    if entry.attempts == 1
+                    else f"{entry.request_id}~r{entry.attempts - 1}"
+                )
             entry.replica = replica
             entry.replica_history.append(replica)
-            rid = (
-                entry.request_id
-                if entry.attempts == 1
-                else f"{entry.request_id}~r{entry.attempts - 1}"
-            )
             entry.attempt_rid = rid
             entry.attempt_completion = None
             prompt = entry.prompt + tuple(entry.delivered)
@@ -395,16 +408,21 @@ class RequestJournal:
         """The attempt reached an engine queue: it is now live. Retries
         are counted here (not at begin_attempt) so a dispatch that never
         landed — engine closed, queue full, replica gone — can be
-        aborted and re-tried without inflating the retry metrics."""
+        aborted and re-tried without inflating the retry metrics. Each
+        retry level is counted at most once (``retries_counted``): a
+        migration bind that follows a genuine retry re-binds the same
+        attempt number and must not double-count it."""
         with entry._lock:
             entry.attempt_completion = completion
-            attempts = entry.attempts
-        if attempts > 1:
+            new_retries = max(0, entry.attempts - 1) - entry.retries_counted
+            if new_retries > 0:
+                entry.retries_counted += new_retries
+        if new_retries > 0:
             with self._lock:
-                self.retries_total += 1
+                self.retries_total += new_retries
             reg = _obs.registry()
             if reg is not None:
-                reg.counter(_metrics.SERVE_RETRIES_METRIC).inc()
+                reg.counter(_metrics.SERVE_RETRIES_METRIC).inc(new_retries)
 
     def abort_attempt(self, entry: JournalEntry) -> None:
         """Roll back a begin_attempt whose dispatch never reached an
@@ -413,6 +431,26 @@ class RequestJournal:
             entry.attempts = max(0, entry.attempts - 1)
             entry.attempt_rid = None
             entry.attempt_completion = None
+
+    def restore_attempt(
+        self,
+        entry: JournalEntry,
+        replica: int,
+        attempt_rid: Optional[str],
+        completion: Any,
+    ) -> None:
+        """Point the entry back at a still-live earlier attempt after a
+        failed migration: the shipment never landed (lost, corrupt,
+        receiver crash, pool full), but the source replica still holds
+        the prefilled slot — its attempt id and completion become current
+        again, its stream guard resumes accepting tokens, and the pump's
+        settle loop watches the source completion as before. No attempt
+        or retry is charged: from the journal's view the request simply
+        never left."""
+        with entry._lock:
+            entry.replica = replica
+            entry.attempt_rid = attempt_rid
+            entry.attempt_completion = completion
 
     def stream_guard(
         self, entry: JournalEntry, attempt_rid: str

@@ -45,6 +45,16 @@ RLT_SERVE_SPECULATE_K=4 python -m pytest tests/test_speculative.py -v \
     -m speculative -k "drop_stream or token_identity or eos_mid_burst" \
     -p no:cacheprovider "$@"
 
+echo "== KV-migration shipment faults under the lock-order sanitizer =="
+# disaggregated prefill/decode: the sustained kill loop runs corrupt
+# shipments (must be caught by checksum, never decoded) and receiver
+# crash-mid-admit (must retry elsewhere or fall back to colocated
+# decode) with RLT_SANITIZE=1 covering the migration pump's lock traffic
+RLT_SANITIZE=1 python -m pytest tests/test_migration.py \
+    tests/test_resilience.py -v -m "migration or serving_chaos" \
+    -k "kill_loop or crash_mid_admit or mid_migration or corrupt" \
+    -p no:cacheprovider "$@"
+
 echo "== legacy relaunch/retry path (slow) =="
 python -m pytest tests/test_cli_and_checkpointing.py -v -m slow \
     -k "retries or relaunch" -p no:cacheprovider "$@"

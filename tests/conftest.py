@@ -61,7 +61,9 @@ from ray_lightning_tpu.analysis.invariants import ThreadGuard  # noqa: E402
 # Suites whose whole point is concurrent lock traffic run under the
 # lock-order sanitizer (docs/development.md). Tests can also opt in
 # individually with @pytest.mark.sanitize.
-_SANITIZE_MARKERS = {"sanitize", "chaos", "elastic", "arbiter", "serving_chaos"}
+_SANITIZE_MARKERS = {
+    "sanitize", "chaos", "elastic", "arbiter", "serving_chaos", "migration",
+}
 
 
 @pytest.fixture(autouse=True)

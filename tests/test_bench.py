@@ -38,6 +38,7 @@ def _isolated_cache_dir(tmp_path, monkeypatch):
     monkeypatch.setenv("RLT_BENCH_GOODPUT_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_ZERO_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_SPECULATIVE_SWEEP", "0")
+    monkeypatch.setenv("RLT_BENCH_DISAGG_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_PAGED_KERNEL_SWEEP", "0")
 
 

@@ -64,14 +64,19 @@ def _reference(params, cfg, prompt, n_new):
 
 
 @contextlib.contextmanager
-def _fault_env(spec):
-    """Arm RLT_FAULT with a serving spec; no fuse dir, so @every faults
-    keep firing across same-index relaunches (a true sustained kill
-    loop). Restores the env and the parse cache on exit."""
+def _fault_env(spec, fuse=None):
+    """Arm RLT_FAULT with a serving spec; by default no fuse dir, so
+    @every faults keep firing across same-index relaunches (a true
+    sustained kill loop). Pass ``fuse`` (a directory) to make each spec
+    fire exactly ONCE across relaunches instead. Restores the env and
+    both parse caches (engine serving + migration) on exit."""
     old = os.environ.get(faults.FAULT_ENV)
     old_fuse = os.environ.pop("RLT_FAULT_FUSE", None)
     os.environ[faults.FAULT_ENV] = spec
+    if fuse is not None:
+        os.environ["RLT_FAULT_FUSE"] = str(fuse)
     faults._serve_cache = (None, [])
+    faults._migration_cache = (None, [])
     try:
         yield
     finally:
@@ -79,9 +84,11 @@ def _fault_env(spec):
             os.environ.pop(faults.FAULT_ENV, None)
         else:
             os.environ[faults.FAULT_ENV] = old
+        os.environ.pop("RLT_FAULT_FUSE", None)
         if old_fuse is not None:
             os.environ["RLT_FAULT_FUSE"] = old_fuse
         faults._serve_cache = (None, [])
+        faults._migration_cache = (None, [])
 
 
 ENGINE_KW = dict(num_slots=4, max_prompt_len=16, max_len=32, max_queue=64)
@@ -572,5 +579,56 @@ def test_kill_loop_completes_all_requests_token_identical(model):
             assert (BREAKER_CLOSED, BREAKER_OPEN) in [
                 (frm, to) for _, frm, to in b0.transitions
             ]
+        finally:
+            fleet.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# disaggregated serving: a decode-replica death MID-MIGRATION is just
+# another replica death — the journal resumes, nothing drops
+# --------------------------------------------------------------------- #
+def test_decode_replica_kill_mid_migration_token_identical(model, tmp_path):
+    """1 prefill + 1 decode replica. The request prefills on replica 0,
+    its KV ships to replica 1, and replica 1 CRASHES a few decode steps
+    in (fused tick fault: fires exactly once, so the relaunch stays up).
+    The journal must re-dispatch prompt + delivered through the prefill
+    pool and finish the request token-identical to generate(), with
+    exactly one charged retry and zero dropped requests."""
+    params, cfg = model
+    ekw = dict(ENGINE_KW, kv_layout="paged", block_size=4)
+    with _fault_env("replica1:crash@tick4", fuse=tmp_path):
+        fleet = LocalReplicaFleet(
+            lambda: (params, cfg),
+            engine_kwargs=ekw,
+            initial_replicas=2,
+            prefill_replicas=1,
+            max_retries=4,
+            breaker_threshold=3,
+        )
+        try:
+            streamed = []
+            prompt, n_new = [4, 8, 15, 16], 8
+            entry = fleet.submit(
+                prompt, max_new_tokens=n_new,
+                on_token=lambda rid, t: streamed.append(t),
+            )
+            want = _reference(params, cfg, prompt, n_new)
+            assert entry.result(timeout=300) == want
+            # the client stream merges both attempts: the tokens that
+            # landed before the decode replica died plus the resumed
+            # remainder, each exactly once and in order
+            assert streamed == want
+            assert entry.retries == 1
+            # first attempt went prefill-pool first, then the handoff
+            assert entry.replica_history[:2] == [0, 1]
+            stats = fleet.stats()
+            assert stats["completed"] == 1
+            assert stats["failed"] == 0 and stats["shed"] == 0
+            # the first migration landed before the kill; the resumed
+            # attempt re-enters through the prefill pool (a later
+            # migration may land OR gracefully fall back to colocated
+            # decode while replica 1 relaunches — both are valid; a
+            # dropped request is not)
+            assert stats["migration"]["migrated"] >= 1
         finally:
             fleet.shutdown()
