@@ -1639,8 +1639,13 @@ def _disagg_sweep(args: argparse.Namespace) -> int:
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
+    from ray_lightning_tpu import observability as _obs
     from ray_lightning_tpu.models.llama import LlamaConfig, init_params
     from ray_lightning_tpu.serving import LocalReplicaFleet
+
+    # request-scoped tracing on: the sweep reports the per-request TTFT
+    # decomposition (queue_wait/prefill/transfer/decode medians) per mode
+    _obs.enable()
 
     cfg = dataclasses.replace(
         LlamaConfig.tiny(), dtype=jnp.float32, vocab_size=64
@@ -1661,6 +1666,31 @@ def _disagg_sweep(args: argparse.Namespace) -> int:
             return None
         vals = sorted(vals)
         return vals[min(int(q * len(vals)), len(vals) - 1)]
+
+    def med(vals):
+        return pct(vals, 0.5)
+
+    def ttft_decomposition(records):
+        """Median seconds per lineage component over the first-token hop
+        records of the burst (the hop whose record carries the telescoped
+        ``ttft_components``; see docs/observability.md)."""
+        by_comp = {}
+        totals = []
+        for rec in records:
+            comps = rec.get("ttft_components")
+            if not comps or "ttft_total_s" not in rec:
+                continue
+            totals.append(rec["ttft_total_s"])
+            for name, secs in comps.items():
+                by_comp.setdefault(name, []).append(secs)
+        if not totals:
+            return None
+        out = {
+            name: round(med(vals), 6)
+            for name, vals in sorted(by_comp.items())
+        }
+        out["ttft_total_s"] = round(med(totals), 6)
+        return out
 
     def serve(prefill_replicas):
         fleet = LocalReplicaFleet(
@@ -1683,6 +1713,7 @@ def _disagg_sweep(args: argparse.Namespace) -> int:
             ]
             streams = [e.result(timeout=600) for e in entries]
             wall = time.perf_counter() - t0
+            records = fleet.drain_request_records()
             ttfts = [
                 (ts[0] - t0) * 1e3 for ts in arrivals.values() if ts
             ]
@@ -1704,6 +1735,9 @@ def _disagg_sweep(args: argparse.Namespace) -> int:
                 "ttft_p95_ms": round(pct(ttfts, 0.95), 2),
                 "itl_p99_ms": round(pct(itls, 0.99), 2),
             }
+            decomp = ttft_decomposition(records)
+            if decomp is not None:
+                out["ttft_decomposition_s"] = decomp
             if prefill_replicas:
                 m = stats["migration"]
                 out["migration"] = m
@@ -1727,9 +1761,9 @@ def _disagg_sweep(args: argparse.Namespace) -> int:
 
 def _attach_disagg_sweep(result: dict, here: str, env: dict) -> None:
     """Attach detail.disagg (colocated vs disaggregated prefill/decode
-    serving: TTFT p95 / ITL p99 / migration fallback rate and the
-    cross-mode token-identity verdict). RLT_BENCH_DISAGG_SWEEP=0
-    disables."""
+    serving: TTFT p95 / ITL p99 / per-component TTFT decomposition
+    medians / migration fallback rate and the cross-mode token-identity
+    verdict). RLT_BENCH_DISAGG_SWEEP=0 disables."""
     if os.environ.get("RLT_BENCH_DISAGG_SWEEP", "1") == "0":
         return
     sweep_env = dict(env)

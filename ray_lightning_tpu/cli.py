@@ -351,11 +351,18 @@ def _cmd_serve(args) -> int:
                 write_local_dump,
             )
 
+            # fleet runs drain every live engine so migrated requests
+            # land both their prefill-side and decode-side hop records
+            records = (
+                fleet.drain_request_records()
+                if fleet is not None
+                else engine.drain_request_records()
+            )
             write_local_dump(
                 args.telemetry_dir,
                 _obs.get_recorder(),
                 reg,
-                requests=engine.drain_request_records(),
+                requests=records,
             )
             print(json.dumps({"telemetry_dir": args.telemetry_dir}))
     if fleet is not None:
@@ -443,6 +450,7 @@ def _cmd_requests(args) -> int:
         ("tokens_out", 6), ("queue_wait_s", 12), ("prefill_s", 9),
         ("ttft_s", 8), ("total_s", 8), ("itl_p50_ms", 10),
         ("itl_max_ms", 10), ("deferred_ticks", 8), ("replica", 7),
+        ("hop", 3), ("pool", 7), ("origin_replica", 6),
     )
     print("  ".join(f"{name:>{w}}" for name, w in cols))
     for r in records:
@@ -453,6 +461,58 @@ def _cmd_requests(args) -> int:
                 v = f"{v:.4f}"
             cells.append(f"{'-' if v is None else v:>{w}}")
         print("  ".join(cells))
+    return 0
+
+
+def _cmd_lineage(args) -> int:
+    """Render one request's cross-replica causal timeline — prefill hop,
+    KV shipment, decode hop, retry branches — stitched from the run's
+    ``requests.jsonl`` (see docs/observability.md "Request lineage")."""
+    import json
+    import os
+
+    from ray_lightning_tpu.observability import lineage as _lineage
+    from ray_lightning_tpu.observability import reqtrace
+
+    path = os.path.join(args.dir, reqtrace.REQUESTS_FILE)
+    lineages = _lineage.load_lineages(path)
+    if not lineages:
+        print(f"no request records found at {path}")
+        return 1
+    if args.rid is None:
+        # no rid: list every lineage, multi-hop (migrated/retried) first
+        rows = sorted(
+            lineages.values(),
+            key=lambda lin: (-len(lin.hops), lin.base_rid),
+        )
+        if args.json:
+            for lin in rows:
+                print(json.dumps(_lineage.summary(lin), sort_keys=True))
+            return 0
+        print(
+            f"{'base_rid':>14}  {'hops':>4}  {'migr':>4}  {'retry':>5}  "
+            f"{'complete':>8}  {'disposition':>11}  {'ttft_s':>8}"
+        )
+        for lin in rows:
+            s = _lineage.summary(lin)
+            ttft = s.get("ttft_total_s")
+            print(
+                f"{lin.base_rid:>14}  {len(lin.hops):>4}  "
+                f"{s['migrations']:>4}  {s['retries']:>5}  "
+                f"{str(s['complete']):>8}  "
+                f"{s.get('disposition') or '-':>11}  "
+                f"{f'{ttft:.4f}' if ttft is not None else '-':>8}"
+            )
+        return 0
+    base = reqtrace.base_rid(args.rid)
+    lin = lineages.get(base)
+    if lin is None:
+        print(f"no lineage for rid {args.rid!r} (base {base!r}) in {path}")
+        return 1
+    if args.json:
+        print(json.dumps(_lineage.summary(lin), sort_keys=True))
+        return 0
+    print(_lineage.render(lin))
     return 0
 
 
@@ -823,6 +883,26 @@ def main(argv: Optional[list] = None) -> int:
     requests_p.add_argument(
         "--json", action="store_true", help="emit JSONL instead of a table"
     )
+    lineage_p = sub.add_parser(
+        "lineage",
+        help="cross-replica causal timeline for one request "
+        "(prefill -> shipment -> decode hops, retry branches)",
+    )
+    lineage_p.add_argument(
+        "--dir",
+        required=True,
+        help="telemetry directory containing requests.jsonl",
+    )
+    lineage_p.add_argument(
+        "rid",
+        nargs="?",
+        default=None,
+        help="request id (any attempt rid; resolved to its base lineage). "
+        "Omit to list all lineages",
+    )
+    lineage_p.add_argument(
+        "--json", action="store_true", help="emit JSON summaries"
+    )
     arbiter_p = sub.add_parser(
         "arbiter",
         help="chip-arbiter ledger: transfer state, device split, "
@@ -876,6 +956,8 @@ def main(argv: Optional[list] = None) -> int:
         return _cmd_profile(args)
     if args.command == "requests":
         return _cmd_requests(args)
+    if args.command == "lineage":
+        return _cmd_lineage(args)
     if args.command == "arbiter":
         return _cmd_arbiter(args, arbiter_p)
     parser.print_help()

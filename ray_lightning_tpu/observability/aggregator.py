@@ -33,6 +33,7 @@ from ..utils import fsio
 from . import anomaly as _anomaly
 from . import goodput as _goodput
 from . import incidents as _incidents
+from . import lineage as _lineage
 from . import metrics as _metrics
 from . import reqtrace as _reqtrace
 from . import trace as _trace
@@ -148,6 +149,10 @@ class DriverAggregator:
             events_path=self._events.path,
             trace_provider=self._trace_slice,
         )
+        # every incident bundle freezes a lineage slice: the stitched
+        # causal timelines of recent requests, led by the rids the TTFT
+        # histogram's slow buckets name
+        self.incidents.register_source("lineage", self._lineage_slice)
         os.makedirs(run_dir, exist_ok=True)
         self._prom: Optional[_metrics.PromServer] = None
         port = _metrics.prom_port_from_env()
@@ -405,6 +410,33 @@ class DriverAggregator:
         """Expose a ledger/journal snapshot to future incident bundles."""
         self.incidents.register_source(name, fn)
 
+    def _lineage_slice(self) -> Dict[str, Any]:
+        """Frozen lineage slice for an incident bundle: stitched causal
+        timelines reconstructed from the trailing window of the fleet
+        ``requests.jsonl`` (rotation-stitched, skew-corrected). Prefers
+        the base rids named by the TTFT histogram's bucket exemplars —
+        the offending requests — and falls back to the most recent
+        lineages when no exemplars exist."""
+        path = os.path.join(self.run_dir, REQUESTS_FILE)
+        lineages = _lineage.lineages_from_window(
+            path, skew_by_rank=self.skew_by_rank()
+        )
+        exemplar_rids = set()
+        for (name, _labels), m in self.registry.items():
+            if name != "rlt_serve_ttft_seconds":
+                continue
+            for ids in getattr(m, "exemplars", {}).values():
+                exemplar_rids.update(
+                    _reqtrace.base_rid(str(r)) for r in ids
+                )
+        picked = sorted(b for b in exemplar_rids if b in lineages)
+        if not picked:
+            picked = sorted(lineages)[-16:]
+        return {
+            "requests_total": self.requests_total,
+            "lineages": [_lineage.summary(lineages[b]) for b in picked],
+        }
+
     def _trace_slice(self, limit: int = 2000) -> Dict[str, Any]:
         """Merged Chrome-trace slice of the recent per-rank tails plus the
         driver ring (non-destructive peek), for incident bundles."""
@@ -626,6 +658,23 @@ class DriverAggregator:
             if driver_events:
                 events_by_rank[_trace.DRIVER] = list(driver_events)
             merged = _trace.merge_traces(events_by_rank, self.skew_by_rank())
+            # cross-replica request lineage: stitch the fleet-wide
+            # requests.jsonl into causal timelines (skew-corrected),
+            # land lineage.jsonl next to it and thread Perfetto flow
+            # arrows between the replica tracks in trace.json
+            req_path = os.path.join(self.run_dir, REQUESTS_FILE)
+            if os.path.exists(req_path) or os.path.exists(req_path + ".1"):
+                lineages = _lineage.load_lineages(
+                    req_path, self.skew_by_rank()
+                )
+                if lineages:
+                    _lineage.write_lineage(
+                        os.path.join(self.run_dir, _lineage.LINEAGE_FILE),
+                        lineages,
+                    )
+                    merged["traceEvents"].extend(
+                        _lineage.chrome_events(lineages)
+                    )
             self._write_json(TRACE_FILE, merged)
             self._write_json(
                 METRICS_FILE,

@@ -68,6 +68,19 @@ SERVE_MIGRATION_FALLBACKS_METRIC = "rlt_serve_migration_fallbacks_total"
 SERVE_MIGRATION_BYTES_METRIC = "rlt_serve_migration_bytes_total"
 SERVE_MIGRATION_TRANSFER_MS_METRIC = "rlt_serve_migration_transfer_ms"
 
+# Cross-replica request lineage: per-component TTFT decomposition
+# (observability/reqtrace.py is the single emit site, on the hop that
+# delivers the first token). Components telescope across hops — their
+# sum per request equals the measured end-to-end TTFT.
+SERVE_TTFT_COMPONENT_METRIC = "rlt_serve_ttft_component_seconds"
+# Same shape as the serving latency histograms: sub-millisecond buckets
+# at the fast end (tiny-model queue/transfer segments), tens of seconds
+# at the slow end.
+TTFT_COMPONENT_BOUNDS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
 # `# HELP` text for the exposition; metrics not listed fall back to a
 # name-derived placeholder so every family still carries a HELP line.
 HELP: Dict[str, str] = {
@@ -93,6 +106,7 @@ HELP: Dict[str, str] = {
     "rlt_serve_migration_fallbacks_total": "Migrations abandoned to colocated decode on the prefill replica.",
     "rlt_serve_migration_bytes_total": "KV payload bytes shipped by admitted migrations.",
     "rlt_serve_migration_transfer_ms": "End-to-end migration transfer time (export to admitted), milliseconds.",
+    "rlt_serve_ttft_component_seconds": "TTFT decomposition per lineage component and pool (components sum to measured TTFT).",
     "rlt_goodput_seconds_total": "Wall time per goodput category (category, src labels).",
     "rlt_goodput_fraction": "Fraction of fleet wall time spent in productive compute.",
     "rlt_anomaly_score": "Current robust z-score (or drop) per anomaly detector.",

@@ -31,6 +31,7 @@ import os
 import time
 import zlib
 from collections import deque
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from . import metrics, trace
@@ -71,12 +72,54 @@ def head_sampled(request_id: str, rate: float) -> bool:
 
 def disposition_for(finish_reason: str) -> str:
     """Collapse a finish reason into the client-facing disposition
-    (completed / shed / expired / cancelled / failed)."""
+    (completed / shed / expired / cancelled / migrated / failed).
+
+    ``migrated`` is a per-hop disposition, not a client outcome: the
+    prefill-side hop of a disaggregated request finishes with it when its
+    KV shipment is admitted downstream, and the decode-side hop carries
+    the client-facing outcome."""
     if finish_reason in ("eos", "length"):
         return "completed"
-    if finish_reason in ("shed", "expired", "cancelled"):
+    if finish_reason in ("shed", "expired", "cancelled", "migrated"):
         return finish_reason
     return "failed"
+
+
+def base_rid(request_id: str) -> str:
+    """Strip the attempt suffix (``~rN`` retry / ``~mK`` migration) off an
+    attempt rid, recovering the client-facing base request id."""
+    return str(request_id).split("~", 1)[0]
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """Hop-carrying lineage context for one request attempt.
+
+    Minted by whichever layer hands a request to its next execution site —
+    fleet dispatch (hop 0 and retry hops) or ``export_shipment`` (a KV
+    shipment leaving a prefill replica) — and consumed by
+    :meth:`RequestTracer.start` on the receiving side, so every hop's
+    :class:`RequestTrace` knows its position in the request's causal
+    history (hop index, parent attempt rid, origin replica) and carries
+    the TTFT seconds already spent upstream.
+
+    ``hop`` is the hop index of the *receiving* attempt; ``rid`` is the
+    parent attempt's rid (equal to the receiver's own rid on hop 0, which
+    means "no parent"). ``components`` accumulates the upstream TTFT
+    decomposition; the receiver charges the wall-clock gap between
+    ``sent_wall`` and its own submit stamp to ``gap_component``
+    (``dispatch`` for queue hand-offs, ``transfer`` for KV shipments), so
+    the decomposition telescopes across hops with nothing counted twice
+    and no instant dropped."""
+
+    rid: str
+    base_rid: str
+    attempt: int = 1
+    hop: int = 0
+    origin_replica: Optional[Any] = None
+    sent_wall: float = 0.0
+    components: Dict[str, float] = field(default_factory=dict)
+    gap_component: str = "dispatch"
 
 
 def jsonl_max_bytes(environ=os.environ) -> int:
@@ -143,46 +186,77 @@ class JsonlWriter:
                 pass
             self._fh = None
 
-    def read_window(self, max_bytes: int = 256 * 1024) -> List[str]:
+    def read_window(
+        self, max_bytes: int = 256 * 1024, rotated_floor: float = 0.5
+    ) -> List[str]:
         """Trailing window of this writer's records — see
         :func:`read_window`. Flushes nothing (``write`` already flushes
         per line) but stitches the live file with its rotation, so a
         reader never loses the seconds straddling a rotation boundary."""
-        return read_window(self.path, max_bytes)
+        return read_window(self.path, max_bytes, rotated_floor=rotated_floor)
 
 
-def read_window(path: str, max_bytes: int = 256 * 1024) -> List[str]:
+def read_window(
+    path: str, max_bytes: int = 256 * 1024, rotated_floor: float = 0.5
+) -> List[str]:
     """The last ``max_bytes`` worth of JSONL lines ending at ``path``'s
-    tail, stitched across the single-generation rotation: the budget is
-    spent on the live file first, then on ``<path>.1``, and the result is
-    returned oldest-first. A partially-included first line (the seek
-    landed mid-record) is dropped rather than returned corrupt."""
-    chunks: List[bytes] = []
-    remaining = max(0, int(max_bytes))
-    for p in (path, path + ".1"):
-        if remaining <= 0:
-            break
+    tail, stitched across the single-generation rotation, returned
+    oldest-first. A partially-included first line (the seek landed
+    mid-record) is dropped rather than returned corrupt.
+
+    When both generations exist, ``rotated_floor`` (fraction of the
+    budget) is reserved for the ``<path>.1`` tail before the live file
+    spends the rest. Without the floor, a live file larger than the
+    window starves the rotated generation entirely — and a rotation
+    mid-burst splits one request's hop records across the boundary, so a
+    lineage reconstructor reading only the live side sees orphan hops.
+    The floor still trims from the OLD side first: the live file's last
+    complete line is always kept, however small the budget."""
+    budget = max(0, int(max_bytes))
+    live, rotated = path, path + ".1"
+    sizes: Dict[str, int] = {}
+    for p in (live, rotated):
         try:
-            size = os.path.getsize(p)
+            sizes[p] = os.path.getsize(p)
         except OSError:
-            continue
-        take = min(size, remaining)
-        if take <= 0:
-            continue
+            sizes[p] = 0
+
+    def _tail_lines(p: str, take: int) -> List[bytes]:
+        size = sizes[p]
+        if take <= 0 or size <= 0:
+            return []
+        take = min(take, size)
         try:
             with open(p, "rb") as fh:
                 fh.seek(size - take)
                 data = fh.read(take)
         except OSError:
-            continue
+            return []
         if take < size:
+            # the seek landed mid-record: drop the corrupt first line
             nl = data.find(b"\n")
             data = data[nl + 1:] if nl >= 0 else b""
-        chunks.append(data)
-        remaining -= take
-    chunks.reverse()  # rotated generation (older) first
-    text = b"".join(chunks).decode("utf-8", "replace")
-    return [ln for ln in text.splitlines() if ln.strip()]
+        return [ln for ln in data.splitlines() if ln.strip()]
+
+    live_lines = _tail_lines(live, budget)
+    reserve = 0
+    if live_lines and sizes[rotated] > 0:
+        floor = max(0.0, min(1.0, float(rotated_floor)))
+        reserve = min(sizes[rotated], int(budget * floor))
+    if reserve:
+        # give the rotated generation its reserve by shedding the live
+        # tail's OLDEST lines — but never its newest complete line
+        keep = budget - reserve
+        spent = sum(len(ln) + 1 for ln in live_lines)
+        while len(live_lines) > 1 and spent > keep:
+            spent -= len(live_lines[0]) + 1
+            live_lines = live_lines[1:]
+        reserve = budget - spent
+    rotated_take = budget if not live_lines else reserve
+    rotated_lines = _tail_lines(rotated, rotated_take)
+    return [
+        ln.decode("utf-8", "replace") for ln in rotated_lines + live_lines
+    ]
 
 
 class RequestTrace:
@@ -194,7 +268,9 @@ class RequestTrace:
         "submitted_wall", "_submitted", "_admitted", "_first_deferred",
         "deferred_ticks", "prefill_s", "_prefill_done", "_first_token",
         "_last_token", "tokens", "token_stamps", "slot",
-        "hbm_bytes_in_use", "retries",
+        "hbm_bytes_in_use", "retries", "hop", "parent_rid",
+        "origin_replica", "pool", "ctx_components", "ctx_sent_wall",
+        "gap_component",
     )
 
     def __init__(
@@ -204,12 +280,29 @@ class RequestTrace:
         max_new_tokens: int = 0,
         replica: Optional[Any] = None,
         retries: int = 0,
+        ctx: Optional[TraceContext] = None,
+        pool: Optional[str] = None,
     ):
         self.request_id = str(request_id)
         self.prompt_len = int(prompt_len)
         self.max_new_tokens = int(max_new_tokens)
         self.replica = replica
         self.retries = int(retries)
+        self.pool = pool
+        if ctx is not None:
+            self.hop = int(ctx.hop)
+            self.parent_rid = ctx.rid if ctx.rid != self.request_id else None
+            self.origin_replica = ctx.origin_replica
+            self.ctx_components = dict(ctx.components) if ctx.components else {}
+            self.ctx_sent_wall = ctx.sent_wall or None
+            self.gap_component = ctx.gap_component
+        else:
+            self.hop = 0
+            self.parent_rid = None
+            self.origin_replica = None
+            self.ctx_components = {}
+            self.ctx_sent_wall = None
+            self.gap_component = "dispatch"
         self.submitted_wall = time.time()
         self._submitted = time.perf_counter()
         self._admitted: Optional[float] = None
@@ -293,6 +386,67 @@ class RequestTrace:
     def _wall(self, perf_t: float) -> float:
         return self.submitted_wall + (perf_t - self._submitted)
 
+    # ------------------------------------------------------------- #
+    # TTFT decomposition (telescoping across hops)
+    # ------------------------------------------------------------- #
+    def local_components(self) -> Dict[str, float]:
+        """This hop's own TTFT segments, back-to-back on one clock:
+        submit → admitted (``queue_wait``), admitted → prefill done
+        (``prefill``), last stamp → first token (``decode``). Their sum
+        is exactly submit → first-token on this hop, because each
+        segment starts where the previous one ended."""
+        out: Dict[str, float] = {}
+        if self._admitted is not None:
+            out["queue_wait"] = max(0.0, self._admitted - self._submitted)
+        if self._prefill_done is not None:
+            start = self._admitted if self._admitted is not None else self._submitted
+            out["prefill"] = max(0.0, self._prefill_done - start)
+        if self._first_token is not None:
+            start = self._prefill_done
+            if start is None:
+                start = self._admitted if self._admitted is not None else self._submitted
+            out["decode"] = max(0.0, self._first_token - start)
+        return out
+
+    def ttft_components(self) -> Dict[str, float]:
+        """Cumulative TTFT decomposition through this hop: upstream
+        components carried by the :class:`TraceContext`, the inter-hop
+        gap (charged to the context's ``gap_component``), and this hop's
+        local segments. On the hop that emits the first token the values
+        sum — telescoping, no double counting — to the request's
+        end-to-end submit → first-token time."""
+        out = dict(self.ctx_components) if self.ctx_components else {}
+        if self.ctx_sent_wall:
+            gap = max(0.0, self.submitted_wall - self.ctx_sent_wall)
+            out[self.gap_component] = out.get(self.gap_component, 0.0) + gap
+        for name, val in self.local_components().items():
+            out[name] = out.get(name, 0.0) + val
+        return out
+
+    def export_context(self) -> TraceContext:
+        """The :class:`TraceContext` for this request's NEXT hop — a KV
+        shipment leaving this replica. Carries everything accumulated
+        through this hop plus ``export_wait`` (prefill done → send), and
+        stamps the send wall-clock so the receiver charges the in-flight
+        gap to ``transfer``."""
+        now = time.perf_counter()
+        comps = self.ttft_components()
+        anchor = self._prefill_done
+        if anchor is None:
+            anchor = self._admitted if self._admitted is not None else self._submitted
+        comps["export_wait"] = comps.get("export_wait", 0.0) + max(0.0, now - anchor)
+        origin = self.origin_replica if self.origin_replica is not None else self.replica
+        return TraceContext(
+            rid=self.request_id,
+            base_rid=base_rid(self.request_id),
+            attempt=self.retries + 1,
+            hop=self.hop + 1,
+            origin_replica=origin,
+            sent_wall=self._wall(now),
+            components=comps,
+            gap_component="transfer",
+        )
+
     def record(self, finish_reason: str) -> Dict[str, Any]:
         """The finished-request JSON record (one ``requests.jsonl`` line)."""
         itls = self.itls()
@@ -326,6 +480,26 @@ class RequestTrace:
             rec["replica"] = self.replica
         if self.hbm_bytes_in_use is not None:
             rec["hbm_bytes_in_use"] = self.hbm_bytes_in_use
+        rec["start_ts"] = round(self.submitted_wall, 6)
+        rec["hop"] = self.hop
+        base = base_rid(self.request_id)
+        if base != self.request_id:
+            rec["base_rid"] = base
+        if self.parent_rid:
+            rec["parent_rid"] = self.parent_rid
+        if self.origin_replica is not None:
+            rec["origin_replica"] = self.origin_replica
+        if self.pool:
+            rec["pool"] = self.pool
+        if self.ctx_sent_wall and self.gap_component == "transfer":
+            rec["transfer_s"] = round(
+                max(0.0, self.submitted_wall - self.ctx_sent_wall), 6
+            )
+        comps = self.ttft_components()
+        if comps:
+            rec["ttft_components"] = {k: round(v, 6) for k, v in comps.items()}
+            if self._first_token is not None:
+                rec["ttft_total_s"] = round(sum(comps.values()), 6)
         return rec
 
     def emit_spans(self, recorder: trace.TraceRecorder, finish_reason: str) -> None:
@@ -380,7 +554,9 @@ class RequestTracer:
         self,
         out_dir: Optional[str] = None,
         rate: Optional[float] = None,
+        pool: Optional[str] = None,
     ):
+        self.pool = pool
         self.rate = sample_rate() if rate is None else min(1.0, max(0.0, rate))
         self._writer = (
             JsonlWriter(os.path.join(out_dir, REQUESTS_FILE)) if out_dir else None
@@ -401,15 +577,20 @@ class RequestTracer:
         max_new_tokens: int = 0,
         replica: Optional[Any] = None,
         retries: int = 0,
+        ctx: Optional[TraceContext] = None,
     ) -> Optional[RequestTrace]:
         """Mint a trace for a new request, or ``None`` when head sampling
-        drops it (the request then costs one attribute check per tick)."""
+        drops it (the request then costs one attribute check per tick).
+        Sampling keys on the BASE rid so every hop of one request shares
+        the keep/drop verdict — a lineage is whole or absent, never
+        partial."""
         self.started_total += 1
-        if not head_sampled(request_id, self.rate):
+        if not head_sampled(base_rid(request_id), self.rate):
             return None
         self.sampled_total += 1
         return RequestTrace(
-            request_id, prompt_len, max_new_tokens, replica, retries=retries
+            request_id, prompt_len, max_new_tokens, replica,
+            retries=retries, ctx=ctx, pool=self.pool,
         )
 
     def finish(self, tr: RequestTrace, finish_reason: str) -> Dict[str, Any]:
@@ -417,6 +598,17 @@ class RequestTracer:
         if recorder is not None:
             tr.emit_spans(recorder, finish_reason)
         rec = tr.record(finish_reason)
+        comps = rec.get("ttft_components")
+        if comps and "ttft_total_s" in rec:
+            reg = metrics.get_registry()
+            pool = tr.pool or "serve"
+            for name, secs in comps.items():
+                reg.histogram(
+                    metrics.SERVE_TTFT_COMPONENT_METRIC,
+                    bounds=metrics.TTFT_COMPONENT_BOUNDS,
+                    component=name,
+                    pool=pool,
+                ).observe(secs, exemplar=tr.request_id)
         self.finished_total += 1
         self._pending.append(rec)
         if self._writer is not None:

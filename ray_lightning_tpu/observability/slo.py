@@ -207,6 +207,54 @@ class BurnRateMonitor:
         }
 
 
+def ttft_burn_attribution(reg) -> Optional[Dict[str, Any]]:
+    """Name the TTFT component (and the pool it charges) dominating the
+    ``rlt_serve_ttft_component_seconds`` histograms — the lineage layer's
+    burn attribution. Because the components of one request sum to its
+    measured TTFT, the component with the largest cumulative seconds IS
+    where the fleet's TTFT budget is going; a ``queue_wait``-dominated
+    breach points at prefill capacity, a ``decode``-dominated one at the
+    decode pool, a ``transfer``-dominated one at the migration path.
+    Returns ``None`` when no component samples exist."""
+    totals: Dict[tuple, float] = {}
+    grand = 0.0
+    try:
+        items = reg.items()
+    except AttributeError:
+        return None
+    for (name, labels), metric in items:
+        if name != "rlt_serve_ttft_component_seconds":
+            continue
+        seconds = float(getattr(metric, "sum", 0.0))
+        component = dict(labels).get("component", "?")
+        pool = dict(labels).get("pool", "?")
+        key = (component, _POOL_FOR_COMPONENT.get(component, pool))
+        totals[key] = totals.get(key, 0.0) + seconds
+        grand += seconds
+    if not totals or grand <= 0.0:
+        return None
+    (component, pool), seconds = max(totals.items(), key=lambda kv: kv[1])
+    return {
+        "dominant_component": component,
+        "dominant_pool": pool,
+        "component_share": round(seconds / grand, 3),
+    }
+
+
+# Which pool a TTFT component's seconds charge. Cumulative components are
+# emitted by the first-token hop (its own pool label), but queue_wait and
+# prefill seconds were spent on the PREFILL side and transfer/export on
+# the migration path regardless of who emitted them.
+_POOL_FOR_COMPONENT = {
+    "queue_wait": "prefill",
+    "prefill": "prefill",
+    "export_wait": "migration",
+    "transfer": "migration",
+    "dispatch": "driver",
+    "decode": "decode",
+}
+
+
 class SLOMonitor:
     """A set of burn-rate monitors with metric-name routing, gauge
     publication, and a fleet-level breached verdict."""
@@ -298,11 +346,24 @@ class SLOMonitor:
         self, now: Optional[float] = None, reg=None
     ) -> List[Dict[str, Any]]:
         """Evaluate every objective; publish gauges when ``reg`` is given;
-        return the list of breach/clear transition events (often empty)."""
+        return the list of breach/clear transition events (often empty).
+
+        A TTFT breach verdict is annotated with its dominant lineage
+        component (``dominant_component`` / ``dominant_pool`` /
+        ``component_share`` — see :func:`ttft_burn_attribution`), so the
+        alert names WHERE the time went, not just that it went."""
         verdicts: List[Dict[str, Any]] = []
         for m in self.monitors.values():
             v = m.evaluate(now)
             if v is not None:
+                if (
+                    v["event"] == "slo_breach"
+                    and v.get("metric") == "rlt_serve_ttft_seconds"
+                    and reg is not None
+                ):
+                    attr = ttft_burn_attribution(reg)
+                    if attr is not None:
+                        v.update(attr)
                 verdicts.append(v)
         if reg is not None:
             for name, m in self.monitors.items():

@@ -261,6 +261,31 @@ def to_chrome_events(
     return out
 
 
+def flow_pair(
+    flow_id: int,
+    name: str,
+    src: Tuple[int, int, float],
+    dst: Tuple[int, int, float],
+    cat: str = "lineage",
+) -> List[Dict[str, Any]]:
+    """A Chrome flow-event pair — ``ph:"s"`` at ``src`` and ``ph:"f"``
+    at ``dst``, each ``(pid, tid, wall_seconds)`` — rendering as one
+    arrow between two tracks in Perfetto. Used by the lineage
+    reconstructor to connect a request's hops across replica processes
+    (prefill slice → shipment → decode slice). ``bp:"e"`` binds the
+    finish point to the enclosing slice so the arrow lands on the hop
+    span rather than the next event on the track."""
+    src_pid, src_tid, src_ts = src
+    dst_pid, dst_tid, dst_ts = dst
+    fid = int(flow_id) & 0x7FFFFFFF
+    return [
+        {"name": name, "cat": cat, "ph": "s", "id": fid,
+         "ts": src_ts * 1e6, "pid": int(src_pid), "tid": int(src_tid)},
+        {"name": name, "cat": cat, "ph": "f", "bp": "e", "id": fid,
+         "ts": dst_ts * 1e6, "pid": int(dst_pid), "tid": int(dst_tid)},
+    ]
+
+
 def merge_traces(
     events_by_rank: Dict[Any, List[TraceTuple]],
     skew_by_rank: Optional[Dict[Any, float]] = None,

@@ -407,7 +407,11 @@ class InferenceEngine:
         # request-scoped tracing: None when telemetry is off, so every
         # per-request/per-token trace site stays a single attribute check
         self._tracer: Optional[_reqtrace.RequestTracer] = (
-            _reqtrace.RequestTracer() if _obs.enabled() else None
+            _reqtrace.RequestTracer(
+                pool=self._role if self._role in ("prefill", "decode") else "serve"
+            )
+            if _obs.enabled()
+            else None
         )
         # goodput ledger for this engine's wall time; a relaunch under the
         # same replica index adopts the predecessor's totals so the
@@ -686,6 +690,7 @@ class InferenceEngine:
         deadline_ms: Optional[float] = None,
         priority: int = 0,
         retries: int = 0,
+        trace_ctx: Optional["_reqtrace.TraceContext"] = None,
     ) -> Completion:
         """Enqueue one request; returns its :class:`Completion` handle.
 
@@ -693,7 +698,9 @@ class InferenceEngine:
         evicted (queued or decoding) with ``finish_reason="expired"``.
         ``priority`` 0 is the protected class; >= 1 is sheddable (see
         ``EngineConfig.shed_watermark``). ``retries`` is the journal's
-        attempt number, threaded into trace records.
+        attempt number, threaded into trace records. ``trace_ctx`` is the
+        fleet's hop-carrying lineage context (parent attempt, hop index,
+        upstream TTFT components); observability-only.
 
         Raises :class:`RequestQueueFull` (bounded queue back-pressure),
         :class:`RequestShed` (load-shed verdict on sheddable work),
@@ -743,7 +750,9 @@ class InferenceEngine:
         )
         if self._tracer is not None:
             req.trace = self._tracer.start(
-                rid, len(tokens), int(max_new_tokens), retries=int(retries)
+                rid, len(tokens), int(max_new_tokens),
+                replica=self.replica_index, retries=int(retries),
+                ctx=trace_ctx,
             )
         with self._work:
             if self._closed:
@@ -1173,6 +1182,12 @@ class InferenceEngine:
             block_k.append(np.asarray(cache["k"][:, bid]))
             block_v.append(np.asarray(cache["v"][:, bid]))
         prompt = self._export_prompt(request_id, slot)
+        # Lineage: the parked slot's trace hands the shipment a hop
+        # context (parent rid, accumulated TTFT components, send stamp)
+        # so the receiving replica records a linked child hop.
+        trace_ctx = (
+            slot.trace.export_context() if slot.trace is not None else None
+        )
         return _migration.build_shipment(
             request_id=request_id,
             prompt=prompt,
@@ -1180,6 +1195,7 @@ class InferenceEngine:
             block_size=bs,
             block_k=tuple(block_k),
             block_v=tuple(block_v),
+            trace_ctx=trace_ctx,
         )
 
     def _export_prompt(self, request_id: str, slot) -> tuple:
@@ -1378,9 +1394,14 @@ class InferenceEngine:
             self._history[rid] = list(prompt)
         completion = Completion(rid)
         if self._tracer is not None:
+            # Seed the receiving hop from the shipment's lineage context:
+            # the new trace knows its parent attempt, hop index, and the
+            # TTFT seconds spent upstream (the gap since the context's
+            # send stamp lands in the "transfer" component).
             slot.trace = self._tracer.start(
                 rid, len(prompt), int(ticket.max_new_tokens),
-                retries=ticket.retries,
+                replica=self.replica_index, retries=ticket.retries,
+                ctx=shipment.trace_ctx,
             )
         with self._work:
             self._completions[rid] = completion

@@ -130,6 +130,12 @@ class KVShipment:
     block_v: Tuple[np.ndarray, ...]
     block_shas: Tuple[str, ...]
     digest: str
+    # Hop-carrying lineage context (observability/reqtrace.TraceContext)
+    # riding the shipment so the receiving hop knows its parent attempt
+    # and the TTFT seconds already spent upstream. Observability-only:
+    # deliberately NOT sealed by the digest (a reconstructed or
+    # ctx-less shipment still verifies) and absent when tracing is off.
+    trace_ctx: Optional[object] = None
 
     @property
     def num_blocks(self) -> int:
@@ -146,6 +152,7 @@ def build_shipment(
     block_size: int,
     block_k: Tuple[np.ndarray, ...],
     block_v: Tuple[np.ndarray, ...],
+    trace_ctx: Optional[object] = None,
 ) -> KVShipment:
     """Seal prompt-block payloads into a checksummed shipment."""
     if len(block_k) != len(block_v):
@@ -162,6 +169,7 @@ def build_shipment(
         block_v=tuple(block_v),
         block_shas=shas,
         digest=_shipment_digest(fingerprint, prompt, shas),
+        trace_ctx=trace_ctx,
     )
 
 
@@ -227,6 +235,7 @@ def corrupt_copy(shipment: KVShipment) -> KVShipment:
         block_v=shipment.block_v,
         block_shas=shipment.block_shas,
         digest=shipment.digest,
+        trace_ctx=shipment.trace_ctx,
     )
 
 

@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_lightning_tpu import observability as _obs
 from ray_lightning_tpu.observability import metrics as _metrics
+from ray_lightning_tpu.observability import reqtrace as _reqtrace
 from ray_lightning_tpu.runtime import faults as _faults
 from ray_lightning_tpu.serving import migration as _migration
 from ray_lightning_tpu.serving.resilience import (
@@ -130,6 +131,8 @@ def autoscale_decision(
     slo_breached: bool = False,
     itl_high_ms: Optional[float] = None,
     role: Optional[str] = None,
+    ttft_component_s: Optional[float] = None,
+    ttft_component_high_s: Optional[float] = None,
 ) -> int:
     """Pure scaling verdict: +1 (add a replica), -1 (drain one), or 0.
 
@@ -155,7 +158,15 @@ def autoscale_decision(
     default considers every report — homogeneous fleets are unchanged.
     The intended split: the PREFILL pool scales on ``queue_high``
     (admission queues back up there) and the DECODE pool on
-    ``itl_high_ms`` (its saturation signal)."""
+    ``itl_high_ms`` (its saturation signal).
+
+    ``ttft_component_s`` is the lineage-attributed per-pool signal: the
+    recent mean of the pool's own TTFT component (``queue_wait`` for
+    prefill, ``decode`` for decode — see
+    ``rlt_serve_ttft_component_seconds``). Unlike queue depth or raw
+    latency percentiles, it charges TTFT burn to the pool that actually
+    spent the time, so a decode-side stall never scales the prefill
+    pool. Scale-up fires when it exceeds ``ttft_component_high_s``."""
     if min_replicas < 1:
         raise ValueError("min_replicas must be >= 1")
     if max_replicas < min_replicas:
@@ -179,6 +190,12 @@ def autoscale_decision(
         if ttft_high_ms is not None and worst_ttft > ttft_high_ms:
             return 1
         if itl_high_ms is not None and worst_itl > itl_high_ms:
+            return 1
+        if (
+            ttft_component_s is not None
+            and ttft_component_high_s is not None
+            and ttft_component_s > ttft_component_high_s
+        ):
             return 1
     if (
         num_replicas > min_replicas
@@ -220,6 +237,7 @@ class Autoscaler:
         slo_monitor: Optional[Any] = None,
         itl_high_ms: Optional[float] = None,
         role: Optional[str] = None,
+        ttft_component_high_s: Optional[float] = None,
     ):
         if idle_ticks_down < 1:
             raise ValueError("idle_ticks_down must be >= 1")
@@ -234,6 +252,11 @@ class Autoscaler:
         # the homogeneous whole-fleet scaler, unchanged.
         self.itl_high_ms = itl_high_ms
         self.role = role
+        # lineage-attributed pool signal: recent mean of the pool's own
+        # TTFT component (rlt_serve_ttft_component_seconds) against this
+        # high-watermark; None (default) disables it
+        self.ttft_component_high_s = ttft_component_high_s
+        self._component_prev = (0.0, 0.0)  # (sum, count) snapshot
         self.cooldown_s = float(cooldown_s)
         self.idle_ticks_down = int(idle_ticks_down)
         # optional observability.slo.SLOMonitor: a firing burn-rate
@@ -251,6 +274,39 @@ class Autoscaler:
         self.capacity_blocked_streak = 0
         self.last_outcome: Optional[str] = None
         self.history: List[Tuple[float, int, int]] = []  # (t, n, delta)
+
+    # Which lineage TTFT component charges a pool: the prefill pool owns
+    # submit -> admitted (queue_wait backs up there), the decode pool
+    # owns the first-token decode segment.
+    POOL_COMPONENT = {"prefill": "queue_wait", "decode": "decode"}
+
+    def _component_signal(self, reg: Any) -> Optional[float]:
+        """Mean of this pool's TTFT component over the requests finished
+        since the last tick, from the cumulative
+        ``rlt_serve_ttft_component_seconds`` histograms (summed across
+        emitting pools — cumulative components are recorded on the
+        first-token hop, but the component NAME says which pool spent
+        the time). Returns ``None`` when disabled or no new samples."""
+        if reg is None or self.ttft_component_high_s is None:
+            return None
+        comp = self.POOL_COMPONENT.get(self.role or "")
+        if comp is None:
+            return None
+        total_sum, total_count = 0.0, 0.0
+        for (name, labels), metric in reg.items():
+            if name != _metrics.SERVE_TTFT_COMPONENT_METRIC:
+                continue
+            if dict(labels).get("component") != comp:
+                continue
+            total_sum += float(metric.sum)
+            total_count += float(metric.count)
+        prev_sum, prev_count = self._component_prev
+        self._component_prev = (total_sum, total_count)
+        d_sum = total_sum - prev_sum
+        d_count = total_count - prev_count
+        if d_count <= 0:
+            return None
+        return d_sum / d_count
 
     def tick(self, now: Optional[float] = None) -> int:
         """Evaluate once; returns the applied delta (-1, 0, +1)."""
@@ -278,6 +334,8 @@ class Autoscaler:
             slo_breached=slo_breached,
             itl_high_ms=self.itl_high_ms,
             role=self.role,
+            ttft_component_s=self._component_signal(_obs.registry()),
+            ttft_component_high_s=self.ttft_component_high_s,
         )
         if delta <= 0:
             # the scale-up pressure is gone: clear any capacity_blocked
@@ -871,7 +929,28 @@ class LocalReplicaFleet:
             with self._lock:
                 self._pending.append(entry)
             return False
+        prev_rid = entry.attempt_rid
         rid, prompt, budget = self.journal.begin_attempt(entry, index)
+        # Hop-carrying lineage context: hop 0 for the first attempt,
+        # parented on the previous attempt rid for redispatches, so the
+        # engine's RequestTrace records its place in the causal chain.
+        # The first dispatch anchors sent_wall at the fleet submit
+        # instant, charging any driver-side parking to the ``dispatch``
+        # component — the decomposition then sums to the TTFT the CLIENT
+        # measured, not just the on-replica slice of it.
+        sent_wall = time.time()
+        if prev_rid is None:
+            sent_wall -= max(0.0, time.perf_counter() - entry.submitted_at)
+        trace_ctx = _reqtrace.TraceContext(
+            rid=prev_rid or rid,
+            base_rid=entry.request_id,
+            attempt=entry.attempts,
+            hop=max(0, len(entry.replica_history) - 1),
+            origin_replica=(
+                entry.replica_history[0] if entry.replica_history else index
+            ),
+            sent_wall=sent_wall,
+        )
         remaining_ms = (
             max((entry.deadline - time.perf_counter()) * 1e3, 0.0)
             if entry.deadline is not None
@@ -887,6 +966,7 @@ class LocalReplicaFleet:
                 deadline_ms=remaining_ms,
                 priority=entry.priority,
                 retries=entry.attempts - 1,
+                trace_ctx=trace_ctx,
             )
         except RequestShed as e:
             self.journal.abort_attempt(entry)
@@ -1315,6 +1395,21 @@ class LocalReplicaFleet:
         if self.disaggregated:
             out["roles"] = dict(self.roles)
             out["migration"] = self.migration_stats.as_dict()
+        return out
+
+    def drain_request_records(self) -> List[Dict[str, Any]]:
+        """Finished-request trace records drained from every live
+        engine. A disaggregated request's hops finish on different
+        replicas, so a lineage-complete ``requests.jsonl`` needs all of
+        them — draining only one engine records half the story."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            engines = list(self._replicas.values())
+        for engine in engines:
+            try:
+                out.extend(engine.drain_request_records())
+            except Exception:
+                continue
         return out
 
     def shutdown(self) -> None:

@@ -251,8 +251,9 @@ class JournalEntry:
         "request_id", "prompt", "max_new_tokens", "eos_id", "priority",
         "deadline", "max_retries", "on_token", "delivered", "attempts",
         "migrations", "retries_counted", "replica", "replica_history",
-        "attempt_rid", "attempt_completion", "disposition", "finish_reason",
-        "error", "submitted_at", "first_token_at", "_done", "_lock",
+        "attempt_rids", "attempt_rid", "attempt_completion", "disposition",
+        "finish_reason", "error", "submitted_at", "first_token_at",
+        "_done", "_lock",
     )
 
     def __init__(
@@ -280,6 +281,10 @@ class JournalEntry:
         self.retries_counted = 0
         self.replica: Optional[int] = None
         self.replica_history: List[int] = []
+        # every attempt rid ever begun, in dispatch order — the journal's
+        # half of the request's hop lineage (replica_history pairs with it
+        # index-for-index)
+        self.attempt_rids: List[str] = []
         self.attempt_rid: Optional[str] = None
         self.attempt_completion: Optional[Any] = None
         self.disposition: Optional[str] = None
@@ -398,6 +403,7 @@ class RequestJournal:
                 )
             entry.replica = replica
             entry.replica_history.append(replica)
+            entry.attempt_rids.append(rid)
             entry.attempt_rid = rid
             entry.attempt_completion = None
             prompt = entry.prompt + tuple(entry.delivered)

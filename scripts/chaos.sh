@@ -55,6 +55,14 @@ RLT_SANITIZE=1 python -m pytest tests/test_migration.py \
     -k "kill_loop or crash_mid_admit or mid_migration or corrupt" \
     -p no:cacheprovider "$@"
 
+echo "== request lineage under a corrupt-shipment kill loop =="
+# the test arms replica0:corrupt-shipment@every:2 — every other KV
+# shipment off the prefill pool is poisoned — and asserts every completed
+# rid still stitches a complete lineage (no orphan hops) with migration
+# retry branches present in the reconstructed timeline
+python -m pytest tests/test_lineage.py -v -m "slow and migration" \
+    -k kill_loop -p no:cacheprovider "$@"
+
 echo "== legacy relaunch/retry path (slow) =="
 python -m pytest tests/test_cli_and_checkpointing.py -v -m slow \
     -k "retries or relaunch" -p no:cacheprovider "$@"
