@@ -1513,6 +1513,263 @@ def _attach_zero_sweep(result: dict, here: str, env: dict) -> None:
         }
 
 
+def _parallelism_sweep(args) -> int:
+    """Child: the composed-parallelism matrix (--_parallelism_sweep).
+
+    Trains under four compositions on 4 virtual CPU devices — ddp,
+    zero3 (data-axis state sharding), zero3+tp (ZeRO x tensor-parallel
+    partition rules with the int8 all-gather), and zero3+tp+pp (the full
+    3D stack: megatron f/g math inside 1F1B pipeline stages) — and
+    reports per config: the engaged program, median post-warmup step
+    time, live state bytes (addressable shards: sharded state counts
+    once, replicated once per device), analytic collective bytes per
+    step (rlt_collective_bytes_total source), jit cache size after the
+    run (the zero-recompile invariant), and the roofline verdict for the
+    measured step. Reported as detail.parallelism."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = " ".join(
+        f for f in flags.split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count=4".strip()
+    )
+    os.environ.pop("RLT_TELEMETRY_DIR", None)  # keep dumps under tmp roots
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as _np
+    import optax
+
+    import ray_lightning_tpu as rlt
+    from ray_lightning_tpu.observability import profiler as _prof
+    from ray_lightning_tpu.parallel.mesh import MeshSpec
+    from ray_lightning_tpu.parallel.pipeline_1f1b import (
+        identity_fwd_psum_bwd,
+        psum_fwd_identity_bwd,
+    )
+    from ray_lightning_tpu.parallel.sharding import ShardingPolicy
+
+    class _TpMLP(rlt.LightningModule):
+        """Explicit-params MLP; megatron column->row math when tp is on."""
+
+        def __init__(self, tp=False):
+            super().__init__()
+            self.tp = tp
+
+        def init_params(self, rng):
+            k1, k2 = jax.random.split(rng)
+            return {
+                "w1": 0.2 * jax.random.normal(k1, (64, 512), jnp.float32),
+                "b1": jnp.zeros((512,), jnp.float32),
+                "w2": 0.2 * jax.random.normal(k2, (512, 16), jnp.float32),
+                "b2": jnp.zeros((16,), jnp.float32),
+            }
+
+        def training_step(self, params, batch, batch_idx):
+            x, y = batch
+            if self.tp:
+                hin = identity_fwd_psum_bwd(x, "tp")
+                h = jnp.tanh(hin @ params["w1"] + params["b1"])
+                out = (
+                    psum_fwd_identity_bwd(h @ params["w2"], "tp")
+                    + params["b2"]
+                )
+            else:
+                h = jnp.tanh(x @ params["w1"] + params["b1"])
+                out = h @ params["w2"] + params["b2"]
+            loss = jnp.mean((out - y) ** 2)
+            self.log("loss", loss)
+            return loss
+
+        def configure_optimizers(self):
+            return optax.adam(1e-2)
+
+    class _PipeTpModel(rlt.LightningModule):
+        """2 pipeline stages, each a megatron column->row pair over tp."""
+
+        def init_params(self, rng):
+            k1, k2, k3 = jax.random.split(rng, 3)
+            return {
+                "stages": {
+                    "wa": 0.2 * jax.random.normal(k1, (2, 32, 64), jnp.float32),
+                    "wb": 0.2 * jax.random.normal(k2, (2, 64, 32), jnp.float32),
+                },
+                "last": {
+                    "head": 0.2 * jax.random.normal(k3, (32, 8), jnp.float32)
+                },
+            }
+
+        def pipeline_stage(self, sp, x):
+            hin = identity_fwd_psum_bwd(x, "tp")
+            h = jnp.tanh(hin @ sp["wa"])
+            return psum_fwd_identity_bwd(h @ sp["wb"], "tp")
+
+        def pipeline_last(self, lp, y, targets):
+            return jnp.mean((y @ lp["head"] - targets) ** 2)
+
+        def configure_optimizers(self):
+            return optax.adam(1e-2)
+
+    def _loader(d_in, d_out):
+        rng = _np.random.RandomState(0)
+        x = rng.randn(128, d_in).astype(_np.float32)
+        y = rng.randn(128, d_out).astype(_np.float32)
+        return rlt.DataLoader(
+            list(zip(x, y)),
+            batch_size=32,
+            collate_fn=lambda items: (
+                _np.stack([i[0] for i in items]),
+                _np.stack([i[1] for i in items]),
+            ),
+        )
+
+    class _StepTimer(rlt.Callback):
+        """Per-step wall times plus the profiler's cost reports, grabbed
+        inside the loop — the trainer drops the profiler before
+        on_train_end fires."""
+
+        def __init__(self):
+            self.marks = []
+            self.reports = {}
+
+        def on_train_batch_end(self, trainer, module, outputs, batch, batch_idx):
+            jax.block_until_ready(trainer._params)
+            self.marks.append(time.perf_counter())
+            prof = getattr(trainer, "_profiler", None)
+            if prof is not None and prof._reports:
+                self.reports = dict(prof._reports)
+
+    def _live_bytes(tree) -> int:
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                total += int(sum(s.data.nbytes for s in shards))
+            elif hasattr(leaf, "nbytes"):
+                total += int(leaf.nbytes)
+        return total
+
+    TP_RULES = "^w1$=None,tp;^b1$=tp;^w2$=tp,None"
+    PP_TP_RULES = "stages/wa=pp,None,tp;stages/wb=pp,tp,None"
+    configs = [
+        # name, model factory, loader dims, strategy kwargs
+        ("ddp", lambda: _TpMLP(tp=False), (64, 16), dict(
+            sharding_policy=ShardingPolicy.ddp(),
+        )),
+        ("zero3", lambda: _TpMLP(tp=False), (64, 16), dict(
+            sharding_policy=ShardingPolicy(
+                zero_stage=3, data_axes=("dp",), min_shard_size=1024
+            ),
+        )),
+        ("zero3_tp", lambda: _TpMLP(tp=True), (64, 16), dict(
+            mesh_spec=MeshSpec(axes={"dp": -1, "tp": 2}),
+            sharding_policy=ShardingPolicy(
+                zero_stage=3, data_axes=("dp",), min_shard_size=1024
+            ),
+            partition_rules=TP_RULES,
+            zero_quantized_allgather=True,
+        )),
+        ("zero3_tp_pp", lambda: _PipeTpModel(), (32, 8), dict(
+            mesh_spec=MeshSpec.composed(dp=1, tp=2, pp=2),
+            sharding_policy=ShardingPolicy(
+                zero_stage=3, data_axes=("dp",), min_shard_size=1024
+            ),
+            partition_rules=PP_TP_RULES,
+            pipeline_stages=2,
+            pipeline_microbatches=4,
+        )),
+    ]
+    out = {"platform": "cpu", "devices": 4, "configs": {}}
+    for name, model_fn, dims, strat_kw in configs:
+        timer = _StepTimer()
+        root = tempfile.mkdtemp(prefix=f"rlt-par-sweep-{name}-")
+        trainer = rlt.Trainer(
+            default_root_dir=root,
+            max_steps=8,
+            max_epochs=10,
+            strategy=rlt.XLAStrategy(devices=4, telemetry=True, **strat_kw),
+            enable_progress_bar=False,
+            enable_checkpointing=False,
+            logger=False,
+            callbacks=[timer],
+            seed=0,
+        )
+        built = {}
+        orig = trainer._build_train_step
+        trainer._build_train_step = lambda _o=orig, _b=built: _b.setdefault(
+            "step", _o()
+        )
+        trainer.fit(model_fn(), _loader(*dims))
+        deltas = sorted(
+            b - a for a, b in zip(timer.marks[1:-1], timer.marks[2:])
+        )
+        step_s = deltas[len(deltas) // 2] if deltas else None
+        state_bytes = _live_bytes((trainer._params, trainer._opt_state))
+        entry = {
+            "program": trainer._train_program,
+            "step_ms": round(step_s * 1e3, 3) if step_s else None,
+            "state_bytes": state_bytes,
+            "state_bytes_per_device": state_bytes // 4,
+        }
+        try:
+            entry["jit_cache_entries"] = int(built["step"]._cache_size())
+        except Exception:
+            pass
+        rep = timer.reports.get(trainer._train_program)
+        if rep is not None:
+            entry["collective_bytes"] = rep.collective_bytes
+            roof = _prof.roofline(rep, step_time_s=step_s)
+            entry["roofline_verdict"] = roof.get("verdict")
+            entry["measured_bound"] = roof.get("measured_bound")
+            entry["mfu"] = roof.get("mfu")
+        ctx = getattr(trainer, "_zero_ctx", None)
+        if ctx is not None:
+            entry["allgather_wire_bytes"] = ctx.gather_wire_bytes()
+            entry["allgather_fp32_bytes"] = ctx.gather_fp32_bytes()
+        out["configs"][name] = entry
+    cfg = out["configs"]
+    tp, z3 = cfg.get("zero3_tp", {}), cfg.get("zero3", {})
+    if tp.get("state_bytes_per_device") and z3.get("state_bytes_per_device"):
+        # the tentpole's acceptance: model-axis sharding must shrink
+        # per-device state strictly below data-axis-only ZeRO
+        out["tp_state_below_zero3"] = bool(
+            tp["state_bytes_per_device"] < z3["state_bytes_per_device"]
+        )
+    if tp.get("allgather_fp32_bytes"):
+        out["quantized_allgather_savings"] = round(
+            1.0 - tp["allgather_wire_bytes"] / tp["allgather_fp32_bytes"], 4
+        )
+    print(json.dumps(out))
+    return 0
+
+
+def _attach_parallelism_sweep(result: dict, here: str, env: dict) -> None:
+    """Attach detail.parallelism (ddp / zero3 / zero3+tp / zero3+tp+pp
+    step time, state bytes, collective bytes, roofline verdicts).
+    RLT_BENCH_PARALLELISM_SWEEP=0 disables."""
+    if os.environ.get("RLT_BENCH_PARALLELISM_SWEEP", "1") == "0":
+        return
+    sweep_env = dict(env)
+    sweep_env["JAX_PLATFORMS"] = "cpu"
+    ok, sweep, serr = _run(
+        [sys.executable, here, "--_parallelism_sweep"],
+        _env_timeout("RLT_BENCH_PARALLELISM_TIMEOUT", 600.0),
+        sweep_env,
+    )
+    detail = result.setdefault("detail", {})
+    if ok and isinstance(sweep, dict) and "configs" in sweep:
+        detail["parallelism"] = sweep
+    else:
+        detail["parallelism"] = {
+            "error": (sweep or {}).get("error")
+            or serr
+            or "sweep produced no JSON"
+        }
+
+
 def _speculative_sweep(args: argparse.Namespace) -> int:
     """Child: the self-speculation sweep (--_speculative_sweep).
 
@@ -2182,6 +2439,7 @@ def main() -> int:
     parser.add_argument("--_arbitration_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_goodput_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_zero_sweep", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--_parallelism_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_speculative_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_disagg_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_paged_kernel_sweep", action="store_true", help=argparse.SUPPRESS)
@@ -2205,6 +2463,8 @@ def main() -> int:
         return _goodput_sweep(args)
     if args._zero_sweep:
         return _zero_sweep(args)
+    if args._parallelism_sweep:
+        return _parallelism_sweep(args)
     if args._speculative_sweep:
         return _speculative_sweep(args)
     if args._disagg_sweep:
@@ -2308,6 +2568,7 @@ def main() -> int:
                     _attach_arbitration_sweep(result, here, env)
                     _attach_goodput_sweep(result, here, env)
                     _attach_zero_sweep(result, here, env)
+                    _attach_parallelism_sweep(result, here, env)
                     _attach_speculative_sweep(result, here, env)
                     _attach_disagg_sweep(result, here, env)
                     _attach_paged_kernel_sweep(result, here, env)
@@ -2364,6 +2625,7 @@ def main() -> int:
         _attach_arbitration_sweep(result, here, env)
         _attach_goodput_sweep(result, here, env)
         _attach_zero_sweep(result, here, env)
+        _attach_parallelism_sweep(result, here, env)
         _attach_speculative_sweep(result, here, env)
         _attach_disagg_sweep(result, here, env)
         _attach_paged_kernel_sweep(result, here, env)

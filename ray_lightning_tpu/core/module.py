@@ -194,6 +194,34 @@ class LightningModule:
     def predict_step(self, params, batch, batch_idx):
         return self.forward(params, batch)
 
+    # ------------------------------------------------------------------ #
+    # 1F1B pipeline contract (strategies with pipeline_stages > 0)
+    # ------------------------------------------------------------------ #
+    def pipeline_stage(self, stage_params, x):
+        """One pipeline stage's forward: ``x -> activations``. Under a
+        pipelined strategy ``init_params`` must return
+        ``{"stages": <leaves with leading dim == pipeline_stages>,
+        "last": <head params>}`` and the batch must be ``(x, targets)``;
+        ``stage_params`` is one stage's slice of the ``"stages"`` subtree
+        (leading dim stripped). Tensor-parallel math inside a stage must
+        use the f/g operators from ``parallel.pipeline_1f1b``
+        (``identity_fwd_psum_bwd`` / ``psum_fwd_identity_bwd``) — a plain
+        ``psum`` double-counts cotangents under the manual pipeline VJP."""
+        raise NotImplementedError(
+            "pipeline_stages > 0 requires the module to implement "
+            "pipeline_stage(stage_params, x)"
+        )
+
+    def pipeline_last(self, last_params, y, targets):
+        """Loss head on the final stage's output: ``(y, targets) -> scalar
+        per-microbatch loss`` (mean-reduced over microbatches by the 1F1B
+        schedule). ``last_params`` is the ``"last"`` subtree, replicated
+        across pipeline stages."""
+        raise NotImplementedError(
+            "pipeline_stages > 0 requires the module to implement "
+            "pipeline_last(last_params, y, targets)"
+        )
+
     def configure_optimizers(self):
         raise NotImplementedError
 

@@ -247,6 +247,12 @@ class Trainer:
         # qualifies; None means sharding stays GSPMD placement only
         self._zero_ctx = None
         self._zero_tx = None  # clip-stripped wrap of the configured tx
+        # why the explicit ZeRO path was declined (slug mirrored in
+        # rlt_zero_fallback_total{reason}); None = engaged or never tried
+        self._zero_fallback_reason = None
+        # 1F1B pipeline config (strategy pipeline_stages/RLT_PP_STAGES),
+        # set by _setup_pipeline; None means no pipelining
+        self._pp_cfg = None
         self._configured_tx = None  # pre-_wrap_tx optax transformation
         self._train_program = "train_step"  # compile-cache/profiler key
         self._matmul_precision = "default"  # resolved in _build_train_step
@@ -626,11 +632,19 @@ class Trainer:
         The explicit step assumes ELEMENTWISE optimizer transforms
         (adam/sgd/rmsprop/adamw/...): per-tensor-norm optimizers
         (lamb/lars/adafactor) compute tensor statistics that are wrong on
-        a 1/N shard and must stay on the GSPMD path (pass partition_rules
-        to force it).
+        a 1/N shard and must stay on the GSPMD path.
+
+        Composes with MODEL-axis parallelism: partition_rules (and the
+        pipeline's stage axis) claim model axes per leaf and the ZeRO
+        machinery runs per model shard; only rules that claim the DATA
+        axis itself force the GSPMD fallback. Every declined path is
+        observable: ``rlt_zero_fallback_total{reason}`` increments and
+        ``self._zero_fallback_reason`` carries the slug for
+        :meth:`describe_parallelism`.
         """
         policy = self.strategy.sharding_policy
         quantized = bool(getattr(self.strategy, "zero_quantized_allgather", False))
+        self._zero_fallback_reason = None
         if policy.zero_stage < 2:
             if quantized:
                 raise ValueError(
@@ -639,10 +653,18 @@ class Trainer:
                     f"zero_stage={policy.zero_stage}"
                 )
             return None
-        from ray_lightning_tpu.parallel.zero import PAD_UNIT, ZeroContext
+        from ray_lightning_tpu.parallel.zero import (
+            PAD_UNIT,
+            ZeroContext,
+            ZeroLayoutError,
+        )
         from ray_lightning_tpu.utils.common import rank_zero_warn
 
-        def fallback(reason):
+        def fallback(reason, slug):
+            self._zero_fallback_reason = slug
+            reg = obs.registry()
+            if reg is not None:
+                reg.counter("rlt_zero_fallback_total", reason=slug).inc()
             if quantized:
                 raise ValueError(
                     "zero_quantized_allgather needs the explicit ZeRO update "
@@ -657,61 +679,131 @@ class Trainer:
             return None
 
         if self._alt_txs is not None:
-            return fallback("alternating optimizers are configured")
+            return fallback(
+                "alternating optimizers are configured",
+                "alternating_optimizers",
+            )
         if self._dcn_ctx is not None:
-            return fallback("dcn_grad_compression is active")
+            return fallback(
+                "dcn_grad_compression is active", "dcn_compression"
+            )
         mesh = self.strategy.mesh
         module_fn = getattr(self._module, "param_shardings", None)
         if callable(module_fn) and module_fn(mesh) is not None:
-            return fallback("the module owns its sharding layout")
-        if self.strategy.partition_rules:
             return fallback(
-                "partition_rules are set (rules define a GSPMD placement)"
+                "the module owns its sharding layout", "module_shardings"
             )
         data_axes = [
             a
             for a in policy.data_axes
             if a in mesh.axis_names and mesh.shape[a] > 1
         ]
-        non_data = [
-            a
-            for a in mesh.axis_names
-            if a not in policy.data_axes and mesh.shape[a] > 1
-        ]
-        if len(data_axes) > 1 or non_data:
+        if len(data_axes) > 1:
             return fallback(
-                f"needs a single data axis (data axes {data_axes}, model "
-                f"axes {non_data})"
+                f"needs a single data axis, got {data_axes}",
+                "multiple_data_axes",
             )
         axis = data_axes[0] if data_axes else policy.data_axes[0]
         if axis not in mesh.axis_names:
-            return fallback(f"data axis {axis!r} missing from the mesh")
+            return fallback(
+                f"data axis {axis!r} missing from the mesh",
+                "missing_data_axis",
+            )
+        try:
+            param_specs, claims = self._model_axis_specs()
+        except ValueError as err:
+            return fallback(str(err), "bad_model_specs")
+        if claims:
+            return fallback(
+                f"partition_rules claim the data axis ({claims}); rules "
+                "may only claim model axes under the explicit ZeRO step",
+                "rules_claim_data_axis",
+            )
         n = int(mesh.shape[axis])
         if PAD_UNIT % n:
             return fallback(
                 f"world size {n} does not divide the padding unit "
                 f"{PAD_UNIT} (padded shapes would depend on the world size "
-                "and break elastic state handoff)"
+                "and break elastic state handoff)",
+                "pad_unit",
             )
-        ctx = ZeroContext(
-            mesh,
-            axis,
-            self._param_shape_tree,
-            stage=policy.zero_stage,
-            min_shard_size=policy.min_shard_size,
-            quantized=quantized,
-            gather_group_size=getattr(
-                self.strategy, "zero_gather_group_size", 8
-            ),
-        )
+        try:
+            ctx = ZeroContext(
+                mesh,
+                axis,
+                self._param_shape_tree,
+                stage=policy.zero_stage,
+                min_shard_size=policy.min_shard_size,
+                quantized=quantized,
+                gather_group_size=getattr(
+                    self.strategy, "zero_gather_group_size", 8
+                ),
+                param_specs=param_specs,
+            )
+        except ZeroLayoutError as err:
+            return fallback(str(err), "layout_ambiguous")
         if not ctx.big_leaves:
             return fallback(
                 f"no float param leaf reaches min_shard_size="
-                f"{policy.min_shard_size}"
+                f"{policy.min_shard_size}",
+                "no_big_leaves",
             )
         self._zero_tx = self._wrap_tx(self._configured_tx, skip_clip=True)
         self._publish_zero_telemetry(ctx)
         return ctx
+
+    def _model_axis_specs(self):
+        """Per-leaf MODEL-axis PartitionSpecs for the composed train step:
+        the pipeline's stage axis first (``stages/`` leaves lead with the
+        pp axis), then the strategy's regex partition rules. Returns
+        ``(spec_tree_or_None, claims)`` where ``claims`` is a non-empty
+        description when a rule claims a DATA axis (the caller must fall
+        back to GSPMD placement — the explicit ZeRO step owns that axis)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ray_lightning_tpu.parallel.partition_rules import (
+            resolve_rule,
+            spec_axes,
+        )
+        from ray_lightning_tpu.parallel.sharding import path_str
+
+        rules = self.strategy.partition_rules or ()
+        pp_cfg = self._pp_cfg
+        if not rules and pp_cfg is None:
+            return None, ""
+        data_axes = set(self.strategy.sharding_policy.data_axes)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(
+            self._param_shape_tree
+        )
+        specs, claims = [], []
+        for key_path, _leaf in flat:
+            path = path_str(key_path)
+            is_stage = pp_cfg is not None and (
+                path == "stages" or path.startswith("stages/")
+            )
+            rule = resolve_rule(rules, path)
+            if rule is not None:
+                spec = rule.partition_spec()
+                hit = sorted(set(spec_axes(spec)) & data_axes)
+                if hit:
+                    claims.append(
+                        f"{rule.pattern!r} places {path} on {hit}"
+                    )
+                elif is_stage and (not len(spec) or spec[0] != pp_cfg["axis"]):
+                    raise ValueError(
+                        f"pipeline stage param {path!r} matched rule "
+                        f"{rule.pattern!r} with spec {spec}, which does not "
+                        f"lead with the stage axis {pp_cfg['axis']!r}"
+                    )
+            elif is_stage:
+                spec = P(pp_cfg["axis"])
+            else:
+                spec = P()
+            specs.append(spec)
+        return (
+            jax.tree_util.tree_unflatten(treedef, specs),
+            "; ".join(claims),
+        )
 
     def _publish_zero_telemetry(self, ctx) -> None:
         """Wire-cost gauges for the ZeRO param all-gather: what the
@@ -734,7 +826,16 @@ class Trainer:
         data axis, optimizer update on this rank's 1/N shard (fp32 masters
         at stage 3, re-sliced params at stage 2), updated params
         all-gathered per layer group — optionally as an int8 block-scaled
-        payload with error feedback carried in the ZeroState."""
+        payload with error feedback carried in the ZeroState.
+
+        Under composed model-axis parallelism (partition rules), params
+        enter the shard_map with their MODEL-axis specs: the module's
+        ``training_step`` sees its tp-local weight shards and must perform
+        its cross-shard math with the f/g operators from
+        ``parallel.pipeline_1f1b`` (``identity_fwd_psum_bwd`` /
+        ``psum_fwd_identity_bwd``) so replicated-leaf gradients come out
+        identical across the model axes — gradient reduction then crosses
+        only the data axis (scatter_grads)."""
         from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
 
@@ -820,17 +921,233 @@ class Trainer:
                     logs,
                 )
 
+        # params carry their model-axis specs (all-P() without rules): the
+        # body sees model-local shards, the data axis stays ZeRO's own
+        pspec = ctx.param_spec_tree
         mapped = shard_map(
             train_step,
             mesh=ctx.mesh,
-            in_specs=(P(), state_specs, P(axis), P(), P()),
-            out_specs=(P(), state_specs, P()),
+            in_specs=(pspec, state_specs, P(axis), P(), P()),
+            out_specs=(pspec, state_specs, P()),
             check_rep=False,
         )
         # distinct program name: its cost report (and the profiler's
         # collective attribution) must not collide with "train_step"
         return _compile_cache.wrap(
             jax.jit(mapped, donate_argnums=(0, 1)), "zero_train_step"
+        )
+
+    # ------------------------------------------------------------------ #
+    # 1F1B pipeline parallelism (parallel/pipeline_1f1b.py)
+    # ------------------------------------------------------------------ #
+    def _setup_pipeline(self):
+        """Validate and assemble the 1F1B pipeline config from the
+        strategy's ``pipeline_stages``/``pipeline_microbatches`` knobs
+        (env ``RLT_PP_STAGES``/``RLT_PP_MICROBATCHES``), or None when
+        pipelining is off. Pipelining is an explicit opt-in, so a config
+        that cannot run raises instead of silently falling back."""
+        stages = int(getattr(self.strategy, "pipeline_stages", 0) or 0)
+        if not stages:
+            return None
+        from ray_lightning_tpu.core.module import LightningModule
+
+        module = self._module
+        cls = type(module)
+        if (
+            cls.pipeline_stage is LightningModule.pipeline_stage
+            or cls.pipeline_last is LightningModule.pipeline_last
+        ):
+            raise ValueError(
+                "pipeline_stages > 0 requires the module to override both "
+                "pipeline_stage(stage_params, x) and "
+                "pipeline_last(last_params, y, targets)"
+            )
+        if self._alt_txs is not None:
+            raise ValueError(
+                "pipeline_stages cannot compose with alternating optimizers"
+            )
+        if self._dcn_ctx is not None:
+            raise ValueError(
+                "pipeline_stages cannot compose with dcn_grad_compression"
+            )
+        mesh = self.strategy.mesh
+        axis = "pp"
+        if axis not in mesh.axis_names or int(mesh.shape[axis]) != stages:
+            raise ValueError(
+                f"pipeline_stages={stages} needs a mesh {axis!r} axis of "
+                f"exactly that size; the mesh has {dict(mesh.shape)} "
+                "(build it with MeshSpec.pipeline or MeshSpec.composed)"
+            )
+        microbatches = int(
+            getattr(self.strategy, "pipeline_microbatches", 0) or stages
+        )
+        policy = self.strategy.sharding_policy
+        data_axes = [
+            a
+            for a in policy.data_axes
+            if a in mesh.axis_names and mesh.shape[a] > 1
+        ]
+        if len(data_axes) > 1:
+            raise ValueError(
+                f"the pipelined step supports at most one data axis, got "
+                f"{data_axes}"
+            )
+        tmpl = self._param_shape_tree
+        if not (isinstance(tmpl, dict) and {"stages", "last"} <= set(tmpl)):
+            raise ValueError(
+                "a pipelined module's init_params must return "
+                '{"stages": <per-stage leaves>, "last": <head params>}'
+            )
+        for leaf in jax.tree_util.tree_leaves(tmpl["stages"]):
+            shape = tuple(getattr(leaf, "shape", ()))
+            if not shape or shape[0] != stages:
+                raise ValueError(
+                    f'every "stages" leaf must lead with the stage count '
+                    f"{stages}; got shape {shape}"
+                )
+        return {
+            "stages": stages,
+            "microbatches": microbatches,
+            "axis": axis,
+            "data_axis": data_axes[0] if data_axes else None,
+            "param_specs": None,  # attached after _model_axis_specs
+        }
+
+    def _attach_pipeline_specs(self):
+        """Resolve the pipeline's per-leaf placement from the rules engine
+        (run after ``_setup_zero`` so the composed claim check happened).
+        Rules claiming a DATA axis are a hard error here: the pipelined
+        step's explicit shard_map owns the batch axis."""
+        specs, claims = self._model_axis_specs()
+        if claims:
+            raise ValueError(
+                f"partition_rules claim a data axis under pipelining "
+                f"({claims}); stage placement may only use model axes"
+            )
+        self._pp_cfg["param_specs"] = specs
+
+    def _build_pipeline_train_step(self):
+        """1F1B pipelined train step. The forward/backward is the manual
+        1F1B schedule of ``parallel/pipeline_1f1b.py`` (its own shard_map
+        over the pp [+ tp + data] axes; per-stage/tp placement from the
+        rules engine; gradients leave it mean-reduced over the data axis
+        and replicated there). The update is either the plain optax step
+        on the GSPMD-placed leaves ("pipeline_train_step") or — composed
+        with explicit ZeRO — a second shard_map that reduce-scatters the
+        dp-replicated grads, updates each rank's local shard, and re-runs
+        the grouped (optionally int8-quantized, error-fed-back) param
+        all-gather ("pipeline_zero_train_step")."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ray_lightning_tpu.parallel.pipeline_1f1b import pipeline_1f1b_loss
+        from ray_lightning_tpu.parallel.zero import ZeroState
+
+        module = self._module
+        cfg = self._pp_cfg
+        mesh = self.strategy.mesh
+        policy = self.precision_policy
+        compute_dtype = policy.compute_dtype
+        mp = self._matmul_precision
+        ctx = self._zero_ctx
+        clip = self.gradient_clip_val
+        program = self._train_program
+        data_spec = P(cfg["data_axis"]) if cfg["data_axis"] else P()
+        stage_specs = (
+            cfg["param_specs"]["stages"] if cfg["param_specs"] else None
+        )
+        microbatches = cfg["microbatches"]
+        axis = cfg["axis"]
+        stage_fn = module.pipeline_stage
+        last_fn = module.pipeline_last
+
+        if ctx is not None:
+            tx = self._zero_tx
+            state_specs = ctx.state_specs(self._opt_state)
+            pspec = ctx.param_spec_tree
+
+            def update_body(params, zstate, grads):
+                # grads arrive dp-replicated and already batch-mean-reduced
+                # (the 1F1B schedule psums over data axes not in a leaf's
+                # spec): psum_scatter/n of n identical copies is exactly
+                # this rank's slice, so the one scatter path serves both
+                # the in-body-grad and the pipeline-grad steps
+                mixed_g = ctx.scatter_grads(grads)
+                if clip:
+                    gnorm = ctx.global_grad_norm(mixed_g)
+                    scale = jnp.minimum(
+                        1.0, clip / jnp.maximum(gnorm, 1e-12)
+                    )
+                    mixed_g = jax.tree_util.tree_map(
+                        lambda g: g * scale.astype(g.dtype)
+                        if jnp.issubdtype(g.dtype, jnp.floating)
+                        else g,
+                        mixed_g,
+                    )
+                cur = ctx.current_mixed(params, zstate.masters)
+                updates, new_inner = tx.update(mixed_g, zstate.inner, cur)
+                new_mixed = optax.apply_updates(cur, updates)
+                new_params, new_masters, new_ef = ctx.gather_params(
+                    params, new_mixed, zstate.gather_ef
+                )
+                return new_params, ZeroState(
+                    new_inner, new_masters, tuple(new_ef)
+                )
+
+            mapped_update = shard_map(
+                update_body,
+                mesh=mesh,
+                in_specs=(pspec, state_specs, pspec),
+                out_specs=(pspec, state_specs),
+                check_rep=False,
+            )
+        else:
+            tx = self._tx
+            mapped_update = None
+
+        def train_step(params, opt_state, batch, rng_root, step):
+            with matmul_precision_scope(mp):
+                if not (isinstance(batch, (tuple, list)) and len(batch) == 2):
+                    raise ValueError(
+                        "pipeline_stages > 0 expects batches of "
+                        "(inputs, targets)"
+                    )
+                x, targets = batch
+                x = cast_floats(x, compute_dtype)
+                x = round_matmul_inputs(mp, x)
+
+                def loss_fn(p):
+                    if policy.cast_params_in_compute:
+                        p = cast_floats(p, compute_dtype)
+                    p = round_matmul_inputs(mp, p)
+                    return pipeline_1f1b_loss(
+                        stage_fn,
+                        last_fn,
+                        p["stages"],
+                        p["last"],
+                        x,
+                        targets,
+                        mesh,
+                        axis=axis,
+                        num_microbatches=microbatches,
+                        data_spec=data_spec,
+                        param_spec=stage_specs,
+                    )
+
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                if mapped_update is not None:
+                    new_params, new_opt_state = mapped_update(
+                        params, opt_state, grads
+                    )
+                else:
+                    updates, new_opt_state = tx.update(
+                        grads, opt_state, params
+                    )
+                    new_params = optax.apply_updates(params, updates)
+                return new_params, new_opt_state, {"loss": loss}
+
+        return _compile_cache.wrap(
+            jax.jit(train_step, donate_argnums=(0, 1)), program
         )
 
     def _stack_ef_residual(self, opt_state):
@@ -961,6 +1278,13 @@ class Trainer:
             return self._build_alternating_train_step()
         if self._dcn_ctx is not None:
             return self._build_compressed_train_step()
+        if self._pp_cfg is not None:
+            self._train_program = (
+                "pipeline_zero_train_step"
+                if self._zero_ctx is not None
+                else "pipeline_train_step"
+            )
+            return self._build_pipeline_train_step()
         if self._zero_ctx is not None:
             self._train_program = "zero_train_step"
             return self._build_zero_train_step()
@@ -1194,10 +1518,15 @@ class Trainer:
             )
         self._tx = self._normalize_tx(model.configure_optimizers())
         self._dcn_ctx = self._setup_dcn_compression()
-        # explicit-ZeRO decision needs the optimizer/dcn verdicts above and
-        # must precede placement: its step keeps params REPLICATED (the
-        # shards live in the ZeroState masters, not in GSPMD placement)
+        # pipeline first (zero's composed layout needs the stage axis),
+        # then the explicit-ZeRO decision — both need the optimizer/dcn
+        # verdicts above and must precede placement: the composed step owns
+        # its params' placement (model-axis specs; data-axis shards live in
+        # the ZeroState, not in GSPMD placement)
+        self._pp_cfg = self._setup_pipeline()
         self._zero_ctx = self._setup_zero()
+        if self._pp_cfg is not None:
+            self._attach_pipeline_specs()
         self._params = self._place_params(host_params)
         if self._dcn_ctx is not None:
             from ray_lightning_tpu.parallel.compression import (
@@ -1245,10 +1574,7 @@ class Trainer:
             init_fn = self._tx.init
         self._opt_init_fn = init_fn  # elastic resizes re-init from this
         opt_shapes = jax.eval_shape(init_fn, self._params)
-        if self._zero_ctx is not None:
-            opt_shardings = self._zero_ctx.state_shardings(opt_shapes)
-        else:
-            opt_shardings = self.strategy.optstate_shardings(opt_shapes)
+        opt_shardings = self._opt_shardings_for(opt_shapes)
         if opt_shardings is None:
             # moments inherit the param shardings through XLA propagation
             self._opt_state = jax.jit(init_fn)(self._params)
@@ -1527,15 +1853,65 @@ class Trainer:
         return None
 
     def _place_params(self, host_params):
-        """Host params -> device arrays. Under the explicit ZeRO step the
-        params stay REPLICATED (the 1/N shards live in the ZeroState, not
-        in GSPMD placement); otherwise the strategy's policy decides."""
+        """Host params -> device arrays. Under the explicit ZeRO step (or
+        a pipelined step) the composed model-axis specs place the params —
+        sharded over model axes, REPLICATED over the data axis (the 1/N
+        data shards live in the ZeroState, not in GSPMD placement);
+        otherwise the strategy's policy decides."""
+        from jax.sharding import NamedSharding
+
+        specs = None
         if self._zero_ctx is not None:
-            repl = self.strategy.replicated
+            specs = self._zero_ctx.param_spec_tree
+        elif self._pp_cfg is not None:
+            specs = self._pp_cfg["param_specs"]
+        if specs is not None:
+            mesh = self.strategy.mesh
             return jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, repl), host_params
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                host_params,
+                specs,
             )
         return self.strategy.place_params(host_params)
+
+    def _opt_shardings_for(self, opt_shapes):
+        """Optimizer-state shardings for the engaged program: the explicit
+        ZeRO mirror rule, XLA propagation from the composed-placed params
+        (pipeline without ZeRO), or the strategy's rules/policy."""
+        if self._zero_ctx is not None:
+            return self._zero_ctx.state_shardings(opt_shapes)
+        if self._pp_cfg is not None:
+            return None  # moments inherit the placed-param shardings
+        return self.strategy.optstate_shardings(opt_shapes)
+
+    def describe_parallelism(self) -> str:
+        """One-stop summary of the engaged training program and every
+        composed-parallelism decision: which step runs, why the explicit
+        ZeRO path fell back (if it did — mirrored in
+        ``rlt_zero_fallback_total{reason}``), the pipeline config, the
+        ZeRO layout, and the per-leaf placement report."""
+        lines = [f"train program: {self._train_program}"]
+        if self._zero_fallback_reason:
+            lines.append(
+                "explicit ZeRO fallback: "
+                f"{self._zero_fallback_reason} "
+                "(rlt_zero_fallback_total{reason=...})"
+            )
+        if self._pp_cfg is not None:
+            cfg = self._pp_cfg
+            lines.append(
+                f"pipeline: {cfg['stages']} stages x "
+                f"{cfg['microbatches']} microbatches over {cfg['axis']!r}"
+                + (
+                    f", data axis {cfg['data_axis']!r}"
+                    if cfg["data_axis"]
+                    else ", no data axis"
+                )
+            )
+        if self._zero_ctx is not None:
+            lines.append(self._zero_ctx.describe())
+        lines.append(self.strategy.describe_shardings())
+        return "\n".join(lines)
 
     def _host_opt_state(self):
         """Optimizer state as host-readable arrays. Explicit-ZeRO state is
@@ -1673,6 +2049,10 @@ class Trainer:
         self._rng_root = jax.random.key(self._seed_used)
 
         # -- rebuild placed templates exactly as _fit_impl does ------------
+        if self._pp_cfg is not None:
+            # revalidate the pipeline against the rebuilt mesh (the pp/tp
+            # axes must survive the resize — only the data axis is elastic)
+            self._pp_cfg = self._setup_pipeline()
         if self._zero_ctx is not None:
             # re-chunk the ZeRO layout for the new world size; PAD_UNIT is
             # world-independent, so the padded GLOBAL shapes — and with
@@ -1681,19 +2061,19 @@ class Trainer:
             if new_ctx is None:
                 raise RuntimeError(
                     f"elastic {cmd.kind} to world {new_world}: the explicit "
-                    "ZeRO layout cannot be rebuilt at this size and its "
-                    "optimizer state does not transfer to the GSPMD path"
+                    "ZeRO layout cannot be rebuilt at this size "
+                    f"({self._zero_fallback_reason}) and its optimizer "
+                    "state does not transfer to the GSPMD path"
                 )
             self._zero_ctx = new_ctx
+        if self._pp_cfg is not None:
+            self._attach_pipeline_specs()
         host_zeros = jax.tree_util.tree_map(
             lambda s: np.zeros(s.shape, s.dtype), self._param_shape_tree
         )
         self._params = self._place_params(host_zeros)
         opt_shapes = jax.eval_shape(self._opt_init_fn, self._params)
-        if self._zero_ctx is not None:
-            opt_shardings = self._zero_ctx.state_shardings(opt_shapes)
-        else:
-            opt_shardings = strategy.optstate_shardings(opt_shapes)
+        opt_shardings = self._opt_shardings_for(opt_shapes)
         if opt_shardings is None:
             self._opt_state = jax.jit(self._opt_init_fn)(self._params)
         else:

@@ -109,7 +109,10 @@ def int8_payload_bytes(
     n_elements: int, block_size: int = DEFAULT_BLOCK_SIZE
 ) -> int:
     """Wire bytes of one quantized tensor: int8 payload (padded to blocks)
-    plus one bf16 scale (2 bytes) per block."""
+    plus one bf16 scale (2 bytes) per block. Shared accounting for both
+    quantized collectives — the two-phase DCN gradient reduce and the
+    explicit-ZeRO param all-gather (``ZeroContext.gather_wire_bytes``) —
+    so their telemetry ratios are directly comparable."""
     n_blocks = max(1, -(-int(n_elements) // block_size))
     return n_blocks * block_size + n_blocks * 2
 

@@ -72,6 +72,22 @@ class MeshSpec:
         """GPipe stages over 'pp'; remaining devices become data parallel."""
         return MeshSpec(axes={"pp": pp, "dp": -1})
 
+    @staticmethod
+    def composed(dp: int = -1, tp: int = 1, pp: int = 1) -> "MeshSpec":
+        """3D composed-parallelism mesh: pipeline stages outermost (their
+        ppermute traffic is the sparsest), data parallel next, tensor
+        parallel innermost (the densest collectives land on the most
+        adjacent devices) — ``dp=-1`` (default) absorbs the remaining
+        devices. ``resolved()`` keeps a size-1 ``dp`` axis so the explicit
+        ZeRO step can still bind its data axis on a pure tp x pp mesh."""
+        axes: Dict[str, int] = {}
+        if pp != 1:
+            axes["pp"] = pp
+        axes["dp"] = dp
+        if tp != 1:
+            axes["tp"] = tp
+        return MeshSpec(axes=axes)
+
 
 def split_dcn_axes(
     spec: MeshSpec, mesh: Mesh, axes: Sequence[str]

@@ -46,6 +46,20 @@ class PartitionRule:
         return f"{self.pattern}={_spec_str(self.spec)}"
 
 
+def spec_axes(spec: Sequence[SpecEntry]) -> Tuple[str, ...]:
+    """Every mesh axis a PartitionSpec mentions, in order (joined-axis
+    entries like ``('tp', 'sp')`` are flattened). The composed-parallelism
+    gate classifies rules with this: specs naming only MODEL axes compose
+    with the explicit ZeRO step, specs naming a DATA axis force the GSPMD
+    fallback."""
+    return tuple(
+        a
+        for entry in spec
+        for a in (entry if isinstance(entry, (tuple, list)) else (entry,))
+        if a
+    )
+
+
 def _spec_str(spec: Tuple[SpecEntry, ...]) -> str:
     if not spec:
         return "replicated"

@@ -31,6 +31,15 @@ memory-optimal schedule of our own pp layer. Composes with 'dp' (each data
 group runs its own pipeline) and 'tp' (megatron-in-stage via the f/g
 custom-VJP operators below — plain lax.psum is WRONG under the manual VJP
 because JAX transposes psum to psum, doubling cotangents per stage).
+
+Trainer integration: ``pipeline_stages``/``pipeline_microbatches`` on any
+strategy (env ``RLT_PP_STAGES``/``RLT_PP_MICROBATCHES``) runs this schedule
+as the compiled train step ("pipeline_train_step"), with per-stage/tp
+placement resolved by the partition-rules engine and — composed with
+explicit ZeRO — the data-axis sharded update of ``parallel/zero.py``
+re-using the dp-replicated grads this schedule emits
+("pipeline_zero_train_step"). The f/g operators serve BOTH contexts: the
+manual VJP here and jax.grad-inside-shard_map in the composed ZeRO step.
 """
 from __future__ import annotations
 

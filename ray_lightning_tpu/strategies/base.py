@@ -59,6 +59,8 @@ class Strategy:
         partition_rules: Optional[Any] = None,
         zero_quantized_allgather: Optional[bool] = None,
         zero_gather_group_size: int = 8,
+        pipeline_stages: Optional[int] = None,
+        pipeline_microbatches: Optional[int] = None,
     ):
         self.mesh_spec = mesh_spec or MeshSpec.data_parallel()
         self.sharding_policy = sharding_policy or ShardingPolicy.ddp()
@@ -72,6 +74,8 @@ class Strategy:
         self._partition_rules = partition_rules
         self._zero_quantized_allgather = zero_quantized_allgather
         self.zero_gather_group_size = int(zero_gather_group_size)
+        self._pipeline_stages = pipeline_stages
+        self._pipeline_microbatches = pipeline_microbatches
         self._sharding_report: Optional[ShardingReport] = None
         self._mesh: Optional[Mesh] = None
         self._trainer = None
@@ -130,6 +134,45 @@ class Strategy:
                 f"{raw!r}"
             )
         return bool(value)
+
+    @property
+    def pipeline_stages(self) -> int:
+        """Number of 1F1B pipeline stages the trainer's step runs over the
+        mesh's ``"pp"`` axis (``parallel/pipeline_1f1b.py``). ``0`` (the
+        default) disables pipelining. A non-zero value requires the module
+        to implement ``pipeline_stage``/``pipeline_last`` and the mesh to
+        carry a ``pp`` axis of exactly this size. Constructor argument
+        wins; otherwise ``RLT_PP_STAGES``."""
+        value = self._pipeline_stages
+        if value is None:
+            value = os.environ.get("RLT_PP_STAGES")
+        if value in (None, ""):
+            return 0
+        value = int(value)
+        if value < 0:
+            raise ValueError(
+                f"pipeline_stages (RLT_PP_STAGES) must be >= 0, got {value}"
+            )
+        return value
+
+    @property
+    def pipeline_microbatches(self) -> int:
+        """Microbatches per step under 1F1B pipelining; the global batch
+        must divide evenly into them. More microbatches shrink the pipeline
+        bubble (steady state needs M >= stages). Constructor argument wins;
+        otherwise ``RLT_PP_MICROBATCHES``; defaults to ``pipeline_stages``."""
+        value = self._pipeline_microbatches
+        if value is None:
+            value = os.environ.get("RLT_PP_MICROBATCHES")
+        if value in (None, ""):
+            return self.pipeline_stages
+        value = int(value)
+        if value <= 0:
+            raise ValueError(
+                f"pipeline_microbatches (RLT_PP_MICROBATCHES) must be > 0, "
+                f"got {value}"
+            )
+        return value
 
     @property
     def heartbeat_interval(self) -> float:
@@ -382,13 +425,57 @@ class Strategy:
         """Human-readable report of what claimed every tensor (rule /
         inference / inheritance), including leaves that stayed replicated
         because no axis divides the shard count. Populated by
-        ``param_shardings``/``optstate_shardings`` during setup."""
-        if self._sharding_report is None:
-            return (
+        ``param_shardings``/``optstate_shardings`` during setup. Under
+        composed configs (explicit ZeRO and/or 1F1B pipelining) this is
+        extended with the pipeline-stage placement and the per-leaf ZeRO
+        shard fraction — a mis-written rule silently replicating a hot
+        tensor shows up here as fraction 1.0 before the run burns chips."""
+        if self._sharding_report is not None:
+            base = self._sharding_report.describe()
+        else:
+            base = (
                 "no sharding report: params not resolved yet, or the module "
                 "owns its sharding layout (module.param_shardings)"
             )
-        return self._sharding_report.describe()
+        extra = self._describe_composed()
+        return base + ("\n" + extra if extra else "")
+
+    def _describe_composed(self) -> str:
+        trainer = self._trainer
+        if trainer is None:
+            return ""
+        lines = []
+        pp_cfg = getattr(trainer, "_pp_cfg", None)
+        if pp_cfg:
+            lines.append(
+                f"pipeline: {pp_cfg['stages']} stages x "
+                f"{pp_cfg['microbatches']} microbatches over axis "
+                f"{pp_cfg['axis']!r} (stage params lead with "
+                f"{pp_cfg['axis']!r}; last-stage params replicated across "
+                "stages)"
+            )
+        ctx = getattr(trainer, "_zero_ctx", None)
+        if ctx is not None:
+            n_dev = self.num_chips
+            lines.append(
+                f"ZeRO shard fractions over {n_dev} devices (fraction of "
+                "each tensor + its optimizer state one device holds; 1.0 = "
+                "fully replicated):"
+            )
+            for i, path in enumerate(ctx.leaf_paths):
+                frac = ctx.shard_fraction(i)
+                kind = (
+                    "zero+model" if ctx.is_big(i) and frac < 1.0 / ctx.n
+                    else "zero" if ctx.is_big(i)
+                    else "model" if frac < 1.0
+                    else "replicated"
+                )
+                lines.append(f"  {path}: {frac:.4g} [{kind}]")
+        if not lines:
+            return ""
+        return "composed parallelism:\n" + "\n".join(
+            "  " + l for l in lines
+        )
 
     def place_params(self, params: Any) -> Any:
         """Host pytree -> device arrays with the policy's shardings."""
@@ -473,6 +560,8 @@ class XLAStrategy(Strategy):
         partition_rules: Optional[Any] = None,
         zero_quantized_allgather: Optional[bool] = None,
         zero_gather_group_size: int = 8,
+        pipeline_stages: Optional[int] = None,
+        pipeline_microbatches: Optional[int] = None,
     ):
         super().__init__(
             mesh_spec,
@@ -487,6 +576,8 @@ class XLAStrategy(Strategy):
             partition_rules=partition_rules,
             zero_quantized_allgather=zero_quantized_allgather,
             zero_gather_group_size=zero_gather_group_size,
+            pipeline_stages=pipeline_stages,
+            pipeline_microbatches=pipeline_microbatches,
         )
         self._num_devices = devices
 

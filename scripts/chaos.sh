@@ -63,6 +63,15 @@ echo "== request lineage under a corrupt-shipment kill loop =="
 python -m pytest tests/test_lineage.py -v -m "slow and migration" \
     -k kill_loop -p no:cacheprovider "$@"
 
+echo "== composed 3D parallelism shrink/regrow under the sanitizer =="
+# the composed zero x tp (x pp) configs must survive membership churn:
+# elastic shrink/regrow re-engages the explicit layout (or refuses
+# loudly with a recorded rlt_zero_fallback_total reason) with bitwise
+# params, and the pipelined/zero programs keep their parity bars while
+# RLT_SANITIZE=1 watches the resize path's lock traffic
+RLT_SANITIZE=1 python -m pytest tests/test_parallel3d.py -v \
+    -m parallel3d -p no:cacheprovider "$@"
+
 echo "== legacy relaunch/retry path (slow) =="
 python -m pytest tests/test_cli_and_checkpointing.py -v -m slow \
     -k "retries or relaunch" -p no:cacheprovider "$@"

@@ -40,6 +40,7 @@ def _isolated_cache_dir(tmp_path, monkeypatch):
     monkeypatch.setenv("RLT_BENCH_SPECULATIVE_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_DISAGG_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_PAGED_KERNEL_SWEEP", "0")
+    monkeypatch.setenv("RLT_BENCH_PARALLELISM_SWEEP", "0")
 
 
 def _result(value, **detail):
@@ -406,6 +407,66 @@ def test_zero_sweep_failure_is_reported_not_fatal(monkeypatch, capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["value"] == 42.0
     assert "timeout" in out["detail"]["zero"]["error"]
+
+
+def test_parallelism_sweep_attaches_detail(monkeypatch, capsys):
+    """The composed-parallelism matrix child's JSON lands in
+    detail.parallelism (CPU-pinned spawn), a failed sweep reports its
+    error without costing the measurement."""
+    monkeypatch.setenv("RLT_BENCH_PARALLELISM_SWEEP", "1")
+    sweep = {
+        "platform": "cpu",
+        "configs": {
+            "ddp": {"program": "train_step", "step_ms": 2.0},
+            "zero3_tp_pp": {
+                "program": "pipeline_zero_train_step",
+                "step_ms": 2.4,
+            },
+        },
+        "tp_state_below_zero3": True,
+        "quantized_allgather_savings": 0.75,
+    }
+    calls = []
+
+    def fake_run(cmd, timeout, env):
+        calls.append(list(cmd))
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        if "--_parallelism_sweep" in cmd:
+            assert env.get("JAX_PLATFORMS") == "cpu"
+            return True, dict(sweep), None
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    assert any("--_parallelism_sweep" in c for c in calls)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 42.0
+    assert out["detail"]["parallelism"]["tp_state_below_zero3"] is True
+    assert (
+        out["detail"]["parallelism"]["quantized_allgather_savings"] == 0.75
+    )
+
+
+def test_parallelism_sweep_failure_is_reported_not_fatal(monkeypatch, capsys):
+    monkeypatch.setenv("RLT_BENCH_PARALLELISM_SWEEP", "1")
+
+    def fake_run(cmd, timeout, env):
+        if "--_probe" in cmd:
+            return True, {"platform": "tpu"}, None
+        if "--_parallelism_sweep" in cmd:
+            return False, None, "timeout after 600s"
+        return True, _result(42.0), None
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 42.0
+    assert "timeout" in out["detail"]["parallelism"]["error"]
 
 
 def test_input_sweep_attaches_detail(monkeypatch, capsys):

@@ -212,6 +212,10 @@ def test_quantized_with_stage2_raises():
 
 
 def test_partition_rules_force_gspmd_fallback(recwarn):
+    # rules that claim MODEL axes now compose with the explicit step
+    # (tests/test_parallel3d.py); only a rule claiming the DATA axis —
+    # like this one — still forces the GSPMD fallback, observably
+    # (rlt_zero_fallback_total{reason="rules_claim_data_axis"})
     model = _ZeroModel()
     trainer = rlt.Trainer(
         strategy=XLAStrategy(
@@ -231,6 +235,9 @@ def test_partition_rules_force_gspmd_fallback(recwarn):
 
 
 def test_quantized_with_rules_raises():
+    # quantization demands the explicit step; a rule claiming the data
+    # axis makes it ineligible, so this must raise rather than silently
+    # training unquantized (model-axis rules would compose fine)
     model = _ZeroModel()
     trainer = rlt.Trainer(
         strategy=XLAStrategy(
