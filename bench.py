@@ -981,6 +981,111 @@ def _attach_serve_sweep(result: dict, here: str, env: dict) -> None:
         }
 
 
+def _replay_sweep(args: argparse.Namespace) -> int:
+    """Child: the multi-tenant trace-replay sweep (--_replay_sweep).
+
+    Plays the diurnal and flash-crowd presets (seeded, virtual-time
+    accelerated) through a tenant-aware 2-replica fleet and reports the
+    verdict's headline numbers per preset: goodput fraction, per-tenant
+    SLO attainment, and the cross-tenant p95/mean wait ratio — the
+    standing fairness regression surface (docs/serving.md). CPU-pinned
+    like the other sweeps: this measures scheduling policy, not FLOPs.
+    RLT_BENCH_REPLAY_DURATION / RLT_BENCH_REPLAY_SPEED shape the run.
+    """
+    import dataclasses
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.llama import LlamaConfig, init_params
+    from ray_lightning_tpu.serving import (
+        LocalReplicaFleet,
+        TenantRegistry,
+        TenantSpec,
+    )
+    from ray_lightning_tpu.workloads import diurnal_trace, flash_crowd_trace
+    from ray_lightning_tpu.workloads.replay import ReplayDriver
+
+    duration = float(os.environ.get("RLT_BENCH_REPLAY_DURATION", "8"))
+    speed = float(os.environ.get("RLT_BENCH_REPLAY_SPEED", "8"))
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mix = {"gold": 4.0, "free": 1.0}
+    presets = {
+        "diurnal": diurnal_trace(
+            duration, 4.0, tenants=mix, seed=0, heavy_tail=True,
+            prompt_len=(2, 8), max_new_tokens=4,
+        ),
+        "flash_crowd": flash_crowd_trace(
+            duration, 3.0, crowd_tenant="free", crowd_at_s=duration / 3,
+            tenants={"gold": 1.0}, seed=0, heavy_tail=True,
+            prompt_len=(2, 8), max_new_tokens=4,
+        ),
+    }
+    payload = {"platform": "cpu", "duration_s": duration, "speed": speed}
+    for name, events in presets.items():
+        registry = TenantRegistry([
+            TenantSpec("gold", tenant_class="guaranteed", weight=4.0),
+            TenantSpec("free", tenant_class="best_effort", weight=1.0),
+        ])
+        fleet = LocalReplicaFleet(
+            lambda: (params, cfg),
+            engine_kwargs=dict(
+                num_slots=4, max_prompt_len=8, max_len=32, max_queue=512,
+            ),
+            initial_replicas=2,
+            tenants=registry,
+        )
+        try:
+            verdict = ReplayDriver(
+                fleet, events, tenants=registry, speed=speed, seed=0,
+                vocab=int(cfg.vocab_size), max_prompt_len=8,
+                trace_meta={"generator": name},
+            ).run()
+        finally:
+            fleet.shutdown()
+        payload[name] = {
+            "events": len(events),
+            "passed": verdict["passed"],
+            "goodput_fraction": verdict["goodput"]["fraction"],
+            "max_wait_ratio": verdict["starvation"]["max_wait_ratio"],
+            "slo_attainment": {
+                t: row.get("slo_attainment")
+                for t, row in verdict["tenants"].items()
+            },
+        }
+    print(json.dumps(payload))
+    return 0
+
+
+def _attach_replay_sweep(result: dict, here: str, env: dict) -> None:
+    """Attach detail.replay (the multi-tenant trace-replay fairness
+    sweep) to a fresh measurement. RLT_BENCH_REPLAY_SWEEP=0 disables;
+    RLT_BENCH_REPLAY_TIMEOUT bounds the child (default 300 s);
+    RLT_BENCH_REPLAY_DURATION / RLT_BENCH_REPLAY_SPEED shape the
+    presets."""
+    if os.environ.get("RLT_BENCH_REPLAY_SWEEP", "1") == "0":
+        return
+    sweep_env = dict(env)
+    sweep_env["JAX_PLATFORMS"] = "cpu"
+    ok, sweep, serr = _run(
+        [sys.executable, here, "--_replay_sweep"],
+        _env_timeout("RLT_BENCH_REPLAY_TIMEOUT", 300.0),
+        sweep_env,
+    )
+    detail = result.setdefault("detail", {})
+    if ok and isinstance(sweep, dict) and "flash_crowd" in sweep:
+        detail["replay"] = sweep
+    else:
+        detail["replay"] = {
+            "error": (sweep or {}).get("error")
+            or serr
+            or "sweep produced no JSON"
+        }
+
+
 def _compile_sweep(args: argparse.Namespace) -> int:
     """Child: the compile-time microbenchmark (--_compile_sweep).
 
@@ -2443,6 +2548,7 @@ def main() -> int:
     parser.add_argument("--_speculative_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_disagg_sweep", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--_paged_kernel_sweep", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--_replay_sweep", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
 
     if args._probe:
@@ -2471,6 +2577,8 @@ def main() -> int:
         return _disagg_sweep(args)
     if args._paged_kernel_sweep:
         return _paged_kernel_sweep(args)
+    if args._replay_sweep:
+        return _replay_sweep(args)
 
     probe_timeout = _env_timeout("RLT_BENCH_PROBE_TIMEOUT", 600.0)
     bench_timeout = _env_timeout("RLT_BENCH_TIMEOUT", 1800.0)
@@ -2572,6 +2680,7 @@ def main() -> int:
                     _attach_speculative_sweep(result, here, env)
                     _attach_disagg_sweep(result, here, env)
                     _attach_paged_kernel_sweep(result, here, env)
+                    _attach_replay_sweep(result, here, env)
                     if _is_on_chip(result):
                         _save_tpu_cache(result, _args_key(args))
                     print(json.dumps(result))
@@ -2629,6 +2738,7 @@ def main() -> int:
         _attach_speculative_sweep(result, here, env)
         _attach_disagg_sweep(result, here, env)
         _attach_paged_kernel_sweep(result, here, env)
+        _attach_replay_sweep(result, here, env)
     if error:
         result.setdefault("detail", {})["error"] = error
     print(json.dumps(result))

@@ -45,7 +45,7 @@ from .core import Allowlist, Violation, iter_sources, parse_source
 
 __all__ = ["LockGraph", "build_graph", "analyze", "DEFAULT_SUBDIRS"]
 
-DEFAULT_SUBDIRS = ["runtime", "serving", "observability"]
+DEFAULT_SUBDIRS = ["runtime", "serving", "observability", "workloads"]
 
 _LOCK_CTORS = {
     "Lock": "lock",

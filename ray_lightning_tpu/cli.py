@@ -372,6 +372,143 @@ def _cmd_serve(args) -> int:
     return 0
 
 
+def _cmd_replay(args) -> int:
+    """Play an arrival trace (recorded JSONL or a generator preset)
+    against a tenant-aware replica fleet and print/write the verdict
+    artifact (see docs/serving.md, "Trace replay")."""
+    import dataclasses
+    import json
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.llama import LlamaConfig, init_params
+    from ray_lightning_tpu.serving import (
+        LocalReplicaFleet,
+        TenantRegistry,
+        parse_tenant_specs,
+    )
+    from ray_lightning_tpu.workloads import (
+        bursty_trace,
+        diurnal_trace,
+        flash_crowd_trace,
+        read_trace,
+    )
+    from ray_lightning_tpu.workloads.replay import ReplayDriver
+
+    registry = None
+    mix = None
+    if args.tenants:
+        specs = parse_tenant_specs(args.tenants)
+        registry = TenantRegistry(specs)
+        mix = {s.name: s.weight for s in specs}
+
+    prompt_range = (2, max(2, args.max_prompt_len))
+    if os.path.exists(args.trace):
+        meta, events = read_trace(args.trace)
+        meta = {"source": args.trace, **meta}
+    elif args.trace == "diurnal":
+        events = diurnal_trace(
+            args.duration, args.rps, tenants=mix, seed=args.seed,
+            heavy_tail=True, prompt_len=prompt_range,
+        )
+        meta = {"generator": "diurnal", "seed": args.seed}
+    elif args.trace == "bursty":
+        events = bursty_trace(
+            args.duration, args.rps, tenants=mix, seed=args.seed,
+            heavy_tail=True, prompt_len=prompt_range,
+        )
+        meta = {"generator": "bursty", "seed": args.seed}
+    elif args.trace == "flash-crowd":
+        crowd = (
+            sorted(mix)[-1] if mix else "crowd"
+        )  # flood from the LOWEST class (sorted puts best_effort names last
+        #    only by luck — prefer an explicit best_effort tenant)
+        if registry is not None:
+            be = [
+                n for n in registry.names()
+                if registry.spec(n).tenant_class == "best_effort"
+            ]
+            if be:
+                crowd = be[0]
+        events = flash_crowd_trace(
+            args.duration, args.rps, crowd_tenant=crowd,
+            crowd_at_s=args.duration / 3, tenants=mix, seed=args.seed,
+            heavy_tail=True, prompt_len=prompt_range,
+        )
+        meta = {"generator": "flash-crowd", "crowd": crowd, "seed": args.seed}
+    else:
+        raise SystemExit(
+            f"--trace {args.trace!r}: not a file and not one of "
+            "diurnal / bursty / flash-crowd"
+        )
+    if not events:
+        raise SystemExit("trace is empty: raise --duration or --rps")
+
+    preset = getattr(LlamaConfig, args.preset, None)
+    if preset is None:
+        raise SystemExit(f"unknown --preset {args.preset!r} (try: tiny, small)")
+    cfg = dataclasses.replace(preset(), dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(args.seed), cfg)
+    fleet = LocalReplicaFleet(
+        lambda: (params, cfg),
+        engine_kwargs=dict(
+            num_slots=args.num_slots,
+            max_prompt_len=args.max_prompt_len,
+            max_len=args.max_len,
+            max_queue=args.max_queue,
+        ),
+        initial_replicas=args.replicas,
+        tenants=registry,
+    )
+    try:
+        verdict = ReplayDriver(
+            fleet,
+            events,
+            tenants=registry,
+            speed=args.speed,
+            seed=args.seed,
+            vocab=int(cfg.vocab_size),
+            max_prompt_len=args.max_prompt_len,
+            deadline_ms=args.deadline_ms,
+            max_wait_ratio=args.max_wait_ratio,
+            artifact_path=args.out,
+            trace_meta={**meta, "events": len(events)},
+        ).run()
+    finally:
+        fleet.shutdown()
+    if args.json:
+        print(json.dumps(verdict, indent=2, sort_keys=True))
+    else:
+        print(
+            f"replay: {len(events)} arrivals over "
+            f"{verdict['wall_s']}s wall (speed {args.speed}x)  "
+            f"goodput_fraction={verdict['goodput']['fraction']}"
+        )
+        for name, row in sorted(verdict["tenants"].items()):
+            att = row.get("slo_attainment")
+            print(
+                f"  {name:<12} dispatched={row['dispatched']:<5} "
+                f"completed={row['completed']:<5} "
+                f"quota_rejected={row['quota_rejected']:<4} "
+                f"shed={row['shed']:<4} "
+                f"ttft_p95={row.get('ttft_p95_s', '-')}s "
+                f"slo={att if att is not None else '-'}"
+            )
+        print(
+            f"  starvation: max_wait_ratio="
+            f"{verdict['starvation']['max_wait_ratio']} "
+            f"(limit {verdict['starvation']['limit']})  "
+            f"quota_ok={verdict['quota'].get('ok')}"
+        )
+        if args.out:
+            print(f"  verdict artifact: {args.out}")
+        for f in verdict["failures"]:
+            print(f"  FAIL: {f}")
+    return 0 if verdict["passed"] else 1
+
+
 def _cmd_profile(args) -> int:
     """Coordinate a fleet profile capture, or render the profile report.
 
@@ -826,6 +963,61 @@ def main(argv: Optional[list] = None) -> int:
         help="print analytic HLO cost accounting (flops/bytes/collectives) "
         "for the compiled prefill and decode programs",
     )
+    replay_p = sub.add_parser(
+        "replay",
+        help="replay a multi-tenant arrival trace against a replica "
+        "fleet and emit the goodput/SLO/fairness verdict artifact",
+    )
+    replay_p.add_argument(
+        "--trace",
+        default="flash-crowd",
+        help="recorded-trace JSONL path, or a generator preset: "
+        "diurnal, bursty, flash-crowd",
+    )
+    replay_p.add_argument(
+        "--duration", type=float, default=30.0,
+        help="generated-trace duration in TRACE seconds (presets only)",
+    )
+    replay_p.add_argument(
+        "--rps", type=float, default=4.0,
+        help="generated-trace mean/base arrival rate (presets only)",
+    )
+    replay_p.add_argument(
+        "--speed", type=float, default=10.0,
+        help="virtual-time acceleration: trace seconds per wall second",
+    )
+    replay_p.add_argument(
+        "--tenants",
+        default="gold:guaranteed:4,silver:standard:2,free:best_effort:1",
+        help="tenant contracts, comma-separated "
+        "name:class[:weight[:rate[:burst]]] (empty string = single-tenant)",
+    )
+    replay_p.add_argument(
+        "--replicas", type=int, default=2, help="fleet size"
+    )
+    replay_p.add_argument("--preset", default="tiny", help="LlamaConfig preset")
+    replay_p.add_argument("--seed", type=int, default=0)
+    replay_p.add_argument("--num-slots", type=int, default=4)
+    replay_p.add_argument("--max-prompt-len", type=int, default=16)
+    replay_p.add_argument("--max-len", type=int, default=32)
+    replay_p.add_argument("--max-queue", type=int, default=256)
+    replay_p.add_argument(
+        "--deadline-ms", type=float, default=None,
+        help="per-request TTL threaded into every replayed request",
+    )
+    replay_p.add_argument(
+        "--max-wait-ratio", type=float, default=20.0,
+        help="verdict fails when same-priority tenants' mean first-token "
+        "waits diverge past this ratio (the starvation bound)",
+    )
+    replay_p.add_argument(
+        "--out", default=None,
+        help="write the verdict artifact JSON here (default: print only)",
+    )
+    replay_p.add_argument(
+        "--json", action="store_true",
+        help="print the full verdict JSON instead of the summary table",
+    )
     profile_p = sub.add_parser(
         "profile",
         help="coordinate a fleet jax.profiler capture, or show the report",
@@ -952,6 +1144,8 @@ def main(argv: Optional[list] = None) -> int:
         return _cmd_incidents(args)
     if args.command == "serve":
         return _cmd_serve(args)
+    if args.command == "replay":
+        return _cmd_replay(args)
     if args.command == "profile":
         return _cmd_profile(args)
     if args.command == "requests":

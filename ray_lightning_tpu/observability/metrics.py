@@ -68,6 +68,42 @@ SERVE_MIGRATION_FALLBACKS_METRIC = "rlt_serve_migration_fallbacks_total"
 SERVE_MIGRATION_BYTES_METRIC = "rlt_serve_migration_bytes_total"
 SERVE_MIGRATION_TRANSFER_MS_METRIC = "rlt_serve_migration_transfer_ms"
 
+# Multi-tenant QoS metric names (serving/tenancy.py, the engine's
+# per-tenant admission/finish paths, and the scheduler's per-tenant
+# queue gauges are the emit sites). Every series carries a `tenant`
+# label whose value passes through MetricsRegistry.tenant_label — the
+# cardinality cap below — so a million-user tenant population cannot
+# mint unbounded label values.
+TENANT_REQUESTS_METRIC = "rlt_tenant_requests_total"
+TENANT_COMPLETIONS_METRIC = "rlt_tenant_completions_total"
+TENANT_QUOTA_REJECTED_METRIC = "rlt_tenant_quota_rejected_total"
+TENANT_SHED_METRIC = "rlt_tenant_shed_total"
+TENANT_QUEUE_DEPTH_METRIC = "rlt_tenant_queue_depth"
+TENANT_TTFT_METRIC = "rlt_tenant_ttft_seconds"
+
+# Per-tenant label cardinality cap: at most this many DISTINCT tenant
+# label values per registry; later tenants collapse into the overflow
+# bucket so the exposition stays bounded no matter how many tenant
+# names traffic carries.
+TENANT_CARDINALITY_ENV = "RLT_METRIC_TENANT_CARDINALITY"
+TENANT_CARDINALITY_DEFAULT = 32
+TENANT_OVERFLOW_LABEL = "__overflow__"
+
+
+def tenant_cardinality_cap() -> int:
+    try:
+        return max(
+            1,
+            int(
+                os.environ.get(
+                    TENANT_CARDINALITY_ENV, TENANT_CARDINALITY_DEFAULT
+                )
+            ),
+        )
+    except ValueError:
+        return TENANT_CARDINALITY_DEFAULT
+
+
 # Cross-replica request lineage: per-component TTFT decomposition
 # (observability/reqtrace.py is the single emit site, on the hop that
 # delivers the first token). Components telescope across hops — their
@@ -114,6 +150,12 @@ HELP: Dict[str, str] = {
     "rlt_incidents_captured_total": "Incident bundles written per triggering kind.",
     "rlt_incidents_suppressed_total": "Incident captures suppressed by the per-kind cooldown.",
     "rlt_bench_probe_failures_total": "Native bench backend probes that failed or timed out.",
+    "rlt_tenant_requests_total": "Serving requests accepted per tenant (post quota/shed admission).",
+    "rlt_tenant_completions_total": "Serving completions per tenant and finish reason.",
+    "rlt_tenant_quota_rejected_total": "Requests refused by the tenant's token-bucket quota (distinct from shed).",
+    "rlt_tenant_shed_total": "Requests shed by the load-shed policy, per tenant.",
+    "rlt_tenant_queue_depth": "Per-tenant admission queue depth (DRR queues; tenancy configured only).",
+    "rlt_tenant_ttft_seconds": "Serving time-to-first-token per tenant.",
 }
 
 
@@ -268,6 +310,22 @@ class MetricsRegistry:
         # driver's summary cadence; incident bundles dump the window
         self._history: deque = deque(maxlen=history_cap() or 1)
         self._history_enabled = history_cap() > 0
+        # distinct tenant label values admitted so far (cardinality cap)
+        self._tenant_labels: set = set()
+
+    def tenant_label(self, tenant: str) -> str:
+        """Cardinality-capped tenant label value: the first
+        ``RLT_METRIC_TENANT_CARDINALITY`` distinct tenants keep their
+        name; every later tenant collapses into the shared
+        ``__overflow__`` series (aggregate visibility without unbounded
+        label growth)."""
+        tenant = str(tenant)
+        if tenant in self._tenant_labels:
+            return tenant
+        if len(self._tenant_labels) < tenant_cardinality_cap():
+            self._tenant_labels.add(tenant)
+            return tenant
+        return TENANT_OVERFLOW_LABEL
 
     def __len__(self) -> int:
         return len(self._metrics)

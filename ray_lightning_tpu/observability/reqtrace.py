@@ -120,6 +120,7 @@ class TraceContext:
     sent_wall: float = 0.0
     components: Dict[str, float] = field(default_factory=dict)
     gap_component: str = "dispatch"
+    tenant: Optional[str] = None
 
 
 def jsonl_max_bytes(environ=os.environ) -> int:
@@ -270,7 +271,7 @@ class RequestTrace:
         "_last_token", "tokens", "token_stamps", "slot",
         "hbm_bytes_in_use", "retries", "hop", "parent_rid",
         "origin_replica", "pool", "ctx_components", "ctx_sent_wall",
-        "gap_component",
+        "gap_component", "tenant",
     )
 
     def __init__(
@@ -282,6 +283,7 @@ class RequestTrace:
         retries: int = 0,
         ctx: Optional[TraceContext] = None,
         pool: Optional[str] = None,
+        tenant: Optional[str] = None,
     ):
         self.request_id = str(request_id)
         self.prompt_len = int(prompt_len)
@@ -289,6 +291,9 @@ class RequestTrace:
         self.replica = replica
         self.retries = int(retries)
         self.pool = pool
+        self.tenant = tenant if tenant is not None else (
+            ctx.tenant if ctx is not None else None
+        )
         if ctx is not None:
             self.hop = int(ctx.hop)
             self.parent_rid = ctx.rid if ctx.rid != self.request_id else None
@@ -491,6 +496,8 @@ class RequestTrace:
             rec["origin_replica"] = self.origin_replica
         if self.pool:
             rec["pool"] = self.pool
+        if self.tenant is not None:
+            rec["tenant"] = self.tenant
         if self.ctx_sent_wall and self.gap_component == "transfer":
             rec["transfer_s"] = round(
                 max(0.0, self.submitted_wall - self.ctx_sent_wall), 6
@@ -578,6 +585,7 @@ class RequestTracer:
         replica: Optional[Any] = None,
         retries: int = 0,
         ctx: Optional[TraceContext] = None,
+        tenant: Optional[str] = None,
     ) -> Optional[RequestTrace]:
         """Mint a trace for a new request, or ``None`` when head sampling
         drops it (the request then costs one attribute check per tick).
@@ -590,7 +598,7 @@ class RequestTracer:
         self.sampled_total += 1
         return RequestTrace(
             request_id, prompt_len, max_new_tokens, replica,
-            retries=retries, ctx=ctx, pool=self.pool,
+            retries=retries, ctx=ctx, pool=self.pool, tenant=tenant,
         )
 
     def finish(self, tr: RequestTrace, finish_reason: str) -> Dict[str, Any]:

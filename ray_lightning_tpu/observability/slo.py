@@ -116,6 +116,39 @@ def default_objectives(environ=os.environ) -> List[SLObjective]:
     ]
 
 
+def tenant_objectives(registry, environ=os.environ) -> List[SLObjective]:
+    """Per-tenant TTFT objectives for every tenant in ``registry``
+    (a ``serving.tenancy.TenantRegistry``).
+
+    Objective names are ``tenant_ttft_<name>`` and every one shares the
+    ``rlt_tenant_ttft_seconds`` metric — observations MUST route by
+    objective name (``SLOMonitor.observe_latency("tenant_ttft_gold",
+    ...)``), since metric routing would collapse all tenants onto the
+    first monitor. Threshold: the tenant spec's ``ttft_slo_ms`` when
+    set, else env ``RLT_SLO_TENANT_TTFT_S`` (seconds, default 2.0)."""
+    default_s = _env_float(environ, "RLT_SLO_TENANT_TTFT_S", 2.0)
+    out: List[SLObjective] = []
+    for name in registry.names():
+        spec = registry.spec(name)
+        threshold = (
+            float(spec.ttft_slo_ms) / 1e3
+            if spec.ttft_slo_ms is not None
+            else default_s
+        )
+        out.append(
+            SLObjective(
+                f"tenant_ttft_{name}",
+                metric="rlt_tenant_ttft_seconds",
+                threshold=threshold,
+                target=0.95,
+                description=(
+                    f"tenant {name!r} time-to-first-token under threshold"
+                ),
+            )
+        )
+    return out
+
+
 class BurnRateMonitor:
     """Good/bad window counts + multi-window burn-rate evaluation for one
     objective. Not thread-safe; callers serialize (the aggregator does)."""
@@ -137,6 +170,10 @@ class BurnRateMonitor:
         self.clock = clock
         self.breached = False
         self.breaches_total = 0
+        # lifetime totals (never windowed): whole-run attainment for
+        # replay verdicts and post-hoc reports
+        self.good_total = 0
+        self.bad_total = 0
         self._samples: deque = deque(maxlen=MAX_WINDOW_SAMPLES)
 
     # ------------------------------------------------------------- #
@@ -151,6 +188,8 @@ class BurnRateMonitor:
         if good <= 0 and bad <= 0:
             return
         now = self.clock() if now is None else now
+        self.good_total += int(good)
+        self.bad_total += int(bad)
         self._samples.append((now, int(good), int(bad)))
 
     # ------------------------------------------------------------- #
@@ -164,6 +203,13 @@ class BurnRateMonitor:
                 good += g
                 bad += b
         return good, bad
+
+    def attainment(self) -> Optional[float]:
+        """Lifetime good fraction, or ``None`` with zero observations."""
+        total = self.good_total + self.bad_total
+        if total == 0:
+            return None
+        return self.good_total / total
 
     def burn_rate(self, window_s: float, now: Optional[float] = None) -> float:
         """Bad fraction over the window divided by the error budget."""
@@ -299,6 +345,11 @@ class SLOMonitor:
         m = self.monitors.get(name)
         if m is not None:
             m.record(good, bad, now)
+
+    def attainment(self, name: str) -> Optional[float]:
+        """Lifetime attainment of one objective (None = no data)."""
+        m = self.monitors.get(name)
+        return m.attainment() if m is not None else None
 
     def breached(self, name: Optional[str] = None) -> bool:
         if name is not None:

@@ -31,6 +31,11 @@ Five layers, bottom-up:
   :class:`~.resilience.CircuitBreaker` routing health, the deadline/
   priority-aware :class:`~.resilience.ShedPolicy`, and the SIGTERM
   preemption drain.
+- :mod:`.tenancy` — multi-tenant QoS: per-tenant contracts
+  (:class:`~.tenancy.TenantSpec`), token-bucket admission quotas, and
+  the :class:`~.tenancy.TenantRegistry` that switches the scheduler to
+  deficit-round-robin per-tenant queues and the shed policy to tenant
+  classes. Nothing changes until a registry is installed.
 """
 from ray_lightning_tpu.serving.engine import (  # noqa: F401
     Completion,
@@ -82,6 +87,13 @@ from ray_lightning_tpu.serving.scheduler import (  # noqa: F401
     Request,
     RequestQueueFull,
 )
+from ray_lightning_tpu.serving.tenancy import (  # noqa: F401
+    QuotaExceeded,
+    TenantRegistry,
+    TenantSpec,
+    TokenBucket,
+    parse_tenant_specs,
+)
 
 __all__ = [
     "Autoscaler",
@@ -104,6 +116,7 @@ __all__ = [
     "OutOfBlocks",
     "PagedKVPool",
     "Plan",
+    "QuotaExceeded",
     "ReplicaGroup",
     "Request",
     "RequestJournal",
@@ -116,11 +129,15 @@ __all__ = [
     "ShipmentError",
     "ShipmentMismatch",
     "Slot",
+    "TenantRegistry",
+    "TenantSpec",
+    "TokenBucket",
     "autoscale_decision",
     "build_shipment",
     "install_sigterm_drain",
     "kv_fingerprint",
     "needs_relaunch",
+    "parse_tenant_specs",
     "pick_least_loaded",
     "verify_shipment",
 ]

@@ -248,15 +248,16 @@ class Completion:
 
     __slots__ = (
         "request_id", "tokens", "finish_reason", "error",
-        "ttft_s", "_done", "submitted_at",
+        "ttft_s", "tenant", "_done", "submitted_at",
     )
 
-    def __init__(self, request_id: str):
+    def __init__(self, request_id: str, tenant: Optional[str] = None):
         self.request_id = request_id
         self.tokens: List[int] = []
         self.finish_reason: Optional[str] = None
         self.error: Optional[BaseException] = None
         self.ttft_s: Optional[float] = None
+        self.tenant = tenant
         self.submitted_at = time.perf_counter()
         self._done = threading.Event()
 
@@ -365,6 +366,11 @@ class InferenceEngine:
         # None = not a fleet member, serve faults never fire
         self.replica_index = replica_index
         self.shed_policy = ShedPolicy(queue_watermark=ecfg.shed_watermark)
+        # multi-tenant QoS: None until configure_tenants installs a
+        # registry; every tenant-aware branch below gates on it so the
+        # single-tenant path is untouched
+        self._tenancy: Optional[Any] = None
+        self._tenancy_admission = False
         # optional SLOMonitor whose serving breach couples into shedding
         self.slo_monitor: Optional[Any] = None
         # set by _fail_all: the error that killed the engine loop — the
@@ -678,6 +684,23 @@ class InferenceEngine:
         }
 
     # ------------------------------------------------------------------ #
+    # multi-tenant QoS
+    # ------------------------------------------------------------------ #
+    def configure_tenants(self, registry: Any, admission: bool = True) -> None:
+        """Install a :class:`~.tenancy.TenantRegistry`: the scheduler
+        switches to per-tenant DRR queues, the shed policy consults
+        tenant classes, and — when ``admission`` is True — submit
+        charges each request against its tenant's token-bucket quota.
+
+        A fleet front door passes ``admission=False``: quota is charged
+        ONCE at the outermost entry point (the fleet), so retries and
+        migrations re-dispatched to member engines are not double-billed.
+        """
+        self._tenancy = registry
+        self._tenancy_admission = bool(admission) and registry is not None
+        self.scheduler.configure_tenants(registry)
+
+    # ------------------------------------------------------------------ #
     # submission
     # ------------------------------------------------------------------ #
     def submit(
@@ -691,6 +714,7 @@ class InferenceEngine:
         priority: int = 0,
         retries: int = 0,
         trace_ctx: Optional["_reqtrace.TraceContext"] = None,
+        tenant: Optional[str] = None,
     ) -> Completion:
         """Enqueue one request; returns its :class:`Completion` handle.
 
@@ -700,12 +724,16 @@ class InferenceEngine:
         ``EngineConfig.shed_watermark``). ``retries`` is the journal's
         attempt number, threaded into trace records. ``trace_ctx`` is the
         fleet's hop-carrying lineage context (parent attempt, hop index,
-        upstream TTFT components); observability-only.
+        upstream TTFT components); observability-only. ``tenant`` names
+        the submitting tenant when a registry is installed
+        (:meth:`configure_tenants`): it selects the DRR queue, shed
+        class, quota bucket, and per-tenant metric labels.
 
         Raises :class:`RequestQueueFull` (bounded queue back-pressure),
         :class:`RequestShed` (load-shed verdict on sheddable work),
-        :class:`EngineClosed` after drain/shutdown, and ``ValueError``
-        for prompts that do not fit the compiled shapes.
+        :class:`~.tenancy.QuotaExceeded` (tenant over its contracted
+        rate), :class:`EngineClosed` after drain/shutdown, and
+        ``ValueError`` for prompts that do not fit the compiled shapes.
         """
         tokens = tuple(int(t) for t in prompt_tokens)
         if not tokens:
@@ -718,22 +746,49 @@ class InferenceEngine:
             )
         if eos_id == "__default__":
             eos_id = self.engine_config.eos_id
+        tenant_class = None
+        if self._tenancy is not None:
+            tenant_class = self._tenancy.tenant_class(tenant)
+            reg = _obs.registry()
+            if reg is not None and tenant is not None:
+                reg.counter(
+                    _metrics.TENANT_REQUESTS_METRIC,
+                    tenant=reg.tenant_label(tenant),
+                ).inc()
+            if self._tenancy_admission and not self._tenancy.admit(tenant):
+                if reg is not None and tenant is not None:
+                    reg.counter(
+                        _metrics.TENANT_QUOTA_REJECTED_METRIC,
+                        tenant=reg.tenant_label(tenant),
+                    ).inc()
+                from ray_lightning_tpu.serving.tenancy import QuotaExceeded
+
+                raise QuotaExceeded(
+                    f"tenant {tenant!r} exceeded its admission quota "
+                    "(token bucket empty); retry after the bucket refills"
+                )
         if self.shed_policy.should_shed(
             priority=int(priority),
             queue_depth=self.scheduler.queue_depth,
             max_queue=self.engine_config.max_queue,
             slo_breached=self._slo_breached(),
+            tenant_class=tenant_class,
         ):
             reg = _obs.registry()
             if reg is not None:
                 reg.counter(_metrics.SERVE_SHED_METRIC).inc()
+                if self._tenancy is not None and tenant is not None:
+                    reg.counter(
+                        _metrics.TENANT_SHED_METRIC,
+                        tenant=reg.tenant_label(tenant),
+                    ).inc()
             raise RequestShed(
                 f"request shed (priority={priority}): the engine is past "
                 "its queue watermark or burning SLO budget; retry later or "
                 "raise the request's priority class"
             )
         rid = request_id or f"req-{next(self._req_counter)}"
-        completion = Completion(rid)
+        completion = Completion(rid, tenant=tenant)
         req = Request(
             request_id=rid,
             tokens=tokens,
@@ -747,12 +802,13 @@ class InferenceEngine:
             ),
             priority=int(priority),
             retries=int(retries),
+            tenant=tenant,
         )
         if self._tracer is not None:
             req.trace = self._tracer.start(
                 rid, len(tokens), int(max_new_tokens),
                 replica=self.replica_index, retries=int(retries),
-                ctx=trace_ctx,
+                ctx=trace_ctx, tenant=tenant,
             )
         with self._work:
             if self._closed:
@@ -1061,6 +1117,27 @@ class InferenceEngine:
                     ).observe(
                         completion.ttft_s, exemplar=rid
                     )
+                    if (
+                        self._tenancy is not None
+                        and completion.tenant is not None
+                    ):
+                        reg.histogram(
+                            _metrics.TENANT_TTFT_METRIC,
+                            bounds=LATENCY_BOUNDS,
+                            tenant=reg.tenant_label(completion.tenant),
+                        ).observe(completion.ttft_s, exemplar=rid)
+                if (
+                    self.slo_monitor is not None
+                    and self._tenancy is not None
+                    and completion.tenant is not None
+                ):
+                    try:
+                        self.slo_monitor.observe_latency(
+                            f"tenant_ttft_{completion.tenant}",
+                            completion.ttft_s,
+                        )
+                    except Exception:
+                        pass  # unregistered tenant objective: skip
             elif slot.last_token_at is not None:
                 itl = now - slot.last_token_at
                 self._recent_itls.append(itl)
@@ -1122,6 +1199,16 @@ class InferenceEngine:
         reg = _obs.registry()
         if reg is not None:
             reg.counter("rlt_serve_completions_total", reason=reason).inc()
+            if (
+                self._tenancy is not None
+                and completion is not None
+                and completion.tenant is not None
+            ):
+                reg.counter(
+                    _metrics.TENANT_COMPLETIONS_METRIC,
+                    tenant=reg.tenant_label(completion.tenant),
+                    reason=reason,
+                ).inc()
 
     # ------------------------------------------------------------------ #
     # disaggregated serving: KV export (prefill role) / import (decode)
@@ -1537,6 +1624,7 @@ class InferenceEngine:
                     "priority": req.priority,
                     "deadline": req.deadline,
                     "retries": req.retries,
+                    "tenant": req.tenant,
                 }
             )
         return out

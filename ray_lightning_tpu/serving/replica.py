@@ -542,6 +542,7 @@ class LocalReplicaFleet:
         capacity: Optional[int] = None,
         prefill_replicas: int = 0,
         migration_policy: Optional[_migration.MigrationPolicy] = None,
+        tenants: Optional[Any] = None,
     ):
         # device capacity: how many replicas the fleet's share of the
         # reservation can host. None = unbounded (the pre-arbiter
@@ -567,6 +568,10 @@ class LocalReplicaFleet:
         self.breaker_threshold = int(breaker_threshold)
         self.breaker_cooldown_s = float(breaker_cooldown_s)
         self.journal = RequestJournal()
+        # multi-tenant QoS: the fleet is the OUTERMOST front door, so it
+        # owns quota admission; member engines get the registry with
+        # admission disabled (retries must not double-bill the bucket)
+        self._tenants = tenants
         self.breakers: Dict[int, CircuitBreaker] = {}
         self.routed_total: Dict[int, int] = {}
         self.relaunches_total = 0
@@ -708,6 +713,9 @@ class LocalReplicaFleet:
             params, cfg, EngineConfig(**ekw),
             replica_index=index,
         )
+        if self._tenants is not None:
+            # fleet already charged quota at submit: admission=False
+            engine.configure_tenants(self._tenants, admission=False)
         # resolve both programs before the replica becomes routable: on a
         # warm executable cache a relaunch (explicit index) or scale-up
         # skips XLA and this is load-bound, not compile-bound
@@ -815,11 +823,18 @@ class LocalReplicaFleet:
         priority: int = 0,
         request_id: Optional[str] = None,
         max_retries: Optional[int] = None,
+        tenant: Optional[str] = None,
     ) -> JournalEntry:
         """Journal the request and route it to the least-loaded replica
         whose breaker admits traffic. Returns the journal entry — a
         Completion-compatible handle that stays valid across replica
-        drains, deaths, and retries."""
+        drains, deaths, and retries.
+
+        ``tenant`` (with a registry installed at construction) charges
+        this request against the tenant's token-bucket quota HERE — the
+        fleet is the outermost front door, so a quota refusal journals
+        as ``quota_rejected`` before any replica is touched, and member
+        engines never re-bill retries."""
         deadline = (
             time.perf_counter() + float(deadline_ms) / 1e3
             if deadline_ms is not None
@@ -836,7 +851,29 @@ class LocalReplicaFleet:
                 self.max_retries if max_retries is None else int(max_retries)
             ),
             request_id=request_id,
+            tenant=tenant,
         )
+        if (
+            self._tenants is not None
+            and tenant is not None
+            and not self._tenants.admit(tenant)
+        ):
+            from ray_lightning_tpu.serving.tenancy import QuotaExceeded
+
+            reg = _obs.registry()
+            if reg is not None:
+                reg.counter(
+                    _metrics.TENANT_QUOTA_REJECTED_METRIC,
+                    tenant=reg.tenant_label(tenant),
+                ).inc()
+            err = QuotaExceeded(
+                f"tenant {tenant!r} exceeded its admission quota "
+                "(token bucket empty); retry after the bucket refills"
+            )
+            self.journal.finish(
+                entry, "quota_rejected", finish_reason="quota", error=err
+            )
+            raise err
         self._dispatch(entry)
         if entry.done and entry.error is not None:
             # shed / rejected at the front door: surface the engine's
@@ -950,6 +987,7 @@ class LocalReplicaFleet:
                 entry.replica_history[0] if entry.replica_history else index
             ),
             sent_wall=sent_wall,
+            tenant=entry.tenant,
         )
         remaining_ms = (
             max((entry.deadline - time.perf_counter()) * 1e3, 0.0)
@@ -967,6 +1005,7 @@ class LocalReplicaFleet:
                 priority=entry.priority,
                 retries=entry.attempts - 1,
                 trace_ctx=trace_ctx,
+                tenant=entry.tenant,
             )
         except RequestShed as e:
             self.journal.abort_attempt(entry)

@@ -62,7 +62,7 @@ __all__ = [
     "install_sigterm_drain",
 ]
 
-DISPOSITIONS = ("completed", "shed", "expired", "failed")
+DISPOSITIONS = ("completed", "shed", "expired", "failed", "quota_rejected")
 
 
 class RequestShed(RequestQueueFull):
@@ -212,10 +212,26 @@ class ShedPolicy:
     the admission queue crosses ``queue_watermark`` of its capacity:
     shedding the sheddable BEFORE the queue is full keeps headroom for
     the traffic that must not fail.
+
+    Tenant classes (``serving/tenancy.py``) generalize the priority-0
+    rule. Shed ordering, strongest protection first:
+
+    1. ``guaranteed`` is NEVER shed — regardless of priority, watermark,
+       or SLO burn. Its only refusals are queue-full back-pressure and
+       its own quota (``quota_rejected``).
+    2. ``standard`` / classless traffic sheds by the original priority
+       rule above.
+    3. ``best_effort`` sheds FIRST: any priority, at the lower
+       ``best_effort_watermark``, and immediately whenever the SLO
+       burn-rate alert fires.
+
+    With ``tenant_class=None`` (no registry installed) the decision is
+    bit-for-bit the original single-tenant policy.
     """
 
     queue_watermark: float = 0.9
     shed_priority_floor: int = 1
+    best_effort_watermark: float = 0.7
 
     def should_shed(
         self,
@@ -223,7 +239,14 @@ class ShedPolicy:
         queue_depth: int,
         max_queue: int,
         slo_breached: bool = False,
+        tenant_class: Optional[str] = None,
     ) -> bool:
+        if tenant_class == "guaranteed":
+            return False
+        if tenant_class == "best_effort":
+            if slo_breached:
+                return True
+            return queue_depth >= self.best_effort_watermark * max_queue
         if priority < self.shed_priority_floor:
             return False
         if slo_breached:
@@ -253,7 +276,7 @@ class JournalEntry:
         "migrations", "retries_counted", "replica", "replica_history",
         "attempt_rids", "attempt_rid", "attempt_completion", "disposition",
         "finish_reason", "error", "submitted_at", "first_token_at",
-        "_done", "_lock",
+        "tenant", "_done", "_lock",
     )
 
     def __init__(
@@ -266,6 +289,7 @@ class JournalEntry:
         priority: int,
         on_token: Optional[Callable[[str, int], Any]],
         max_retries: int,
+        tenant: Optional[str] = None,
     ):
         self.request_id = request_id
         self.prompt = prompt
@@ -273,6 +297,7 @@ class JournalEntry:
         self.eos_id = eos_id
         self.deadline = deadline
         self.priority = int(priority)
+        self.tenant = tenant
         self.on_token = on_token
         self.max_retries = int(max_retries)
         self.delivered: List[int] = []
@@ -360,11 +385,12 @@ class RequestJournal:
         on_token: Optional[Callable[[str, int], Any]] = None,
         max_retries: int = 2,
         request_id: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> JournalEntry:
         rid = request_id or f"jreq-{next(self._auto_id)}"
         entry = JournalEntry(
             rid, tuple(int(t) for t in prompt), max_new_tokens, eos_id,
-            deadline, priority, on_token, max_retries,
+            deadline, priority, on_token, max_retries, tenant=tenant,
         )
         with self._lock:
             if rid in self._entries:

@@ -33,6 +33,18 @@ deferred, bounded by ``head_aging_ticks``: once the head has been
 deferred that many ticks, skip-ahead is suspended (the tick admits
 nothing past it) until the head finally fits — an aging bound that
 converts possible starvation into bounded extra latency.
+
+Multi-tenant QoS (:meth:`ContinuousBatchScheduler.configure_tenants`):
+installing a :class:`~.tenancy.TenantRegistry` replaces the single FIFO
+with one FIFO *per tenant* and admits across them by deficit round-robin
+(DRR): a rotation pointer walks the active tenants, each tenant earns
+``weight`` credit when its turn arrives and spends one credit per
+admission, so sustained throughput converges to the weight ratio while
+each tenant's queue stays FIFO internally. The head-skip/aging window
+applies PER TENANT QUEUE — a starved tenant's head can only be aged
+past by its own tenant's skips, never by another tenant's traffic. With
+no registry configured (the default) the original single-queue code
+path runs unchanged, byte-identical to the single-tenant scheduler.
 """
 from __future__ import annotations
 
@@ -42,9 +54,10 @@ from ray_lightning_tpu.analysis.sanitizer import rlt_lock
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Deque, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from ray_lightning_tpu import observability as _obs
+from ray_lightning_tpu.observability import metrics as _metrics
 from ray_lightning_tpu.serving.kv_pool import KVSlotPool, Slot
 
 
@@ -77,6 +90,10 @@ class Request:
     # request-scoped trace context (reqtrace.RequestTrace), minted at
     # engine submit; None when telemetry is off or head sampling dropped it
     trace: Optional[Any] = None
+    # tenant identity (multi-tenant QoS); None = classless traffic,
+    # which rides the default DRR queue when tenancy is configured and
+    # is indistinguishable from today's requests when it is not
+    tenant: Optional[str] = None
 
     @property
     def prompt_len(self) -> int:
@@ -136,6 +153,62 @@ class ContinuousBatchScheduler:
         # engine hook: called (outside the lock) with each queued Request
         # swept past its deadline so its Completion can be failed
         self.on_evict: Optional[Callable[[Request], Any]] = None
+        # ---- multi-tenant QoS (None = single-queue path, unchanged) --- #
+        self._tenancy: Optional[Any] = None
+        self._tqueues: Dict[str, Deque[Request]] = {}
+        self._deficit: Dict[str, float] = {}
+        self._order: Deque[str] = deque()  # DRR rotation of active tenants
+        self._in_order: set = set()
+        self.admitted_by_tenant: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------ #
+    # multi-tenant QoS
+    # ------------------------------------------------------------------ #
+    def configure_tenants(self, registry: Any) -> None:
+        """Install a :class:`~.tenancy.TenantRegistry` and switch
+        admission to per-tenant DRR queues. Requests already queued are
+        migrated into their tenants' queues in FIFO order. Passing
+        ``None`` is a no-op (the single-queue path stays active)."""
+        if registry is None:
+            return
+        with self._lock:
+            self._tenancy = registry
+            backlog = list(self._queue)
+            self._queue.clear()
+            for req in backlog:
+                self._tenant_enqueue(req)
+
+    @staticmethod
+    def _tenant_key(req: Request) -> str:
+        return req.tenant or ""
+
+    def _tenant_enqueue(self, req: Request) -> None:
+        """Append to the request's tenant queue (lock held)."""
+        key = self._tenant_key(req)
+        q = self._tqueues.get(key)
+        if q is None:
+            q = self._tqueues[key] = deque()
+        q.append(req)
+        if key not in self._in_order:
+            self._order.append(key)
+            self._in_order.add(key)
+
+    def _retire_tenant(self, key: str) -> None:
+        """Drop a drained tenant from the DRR rotation (lock held).
+        Classic DRR: an emptied queue forfeits its residual deficit, so
+        an idle tenant cannot bank credit for a later burst."""
+        self._deficit.pop(key, None)
+        self._in_order.discard(key)
+        try:
+            self._order.remove(key)
+        except ValueError:
+            pass
+
+    def tenant_depths(self) -> Dict[str, int]:
+        """Queue depth per tenant key ("" = classless traffic); empty
+        dict when tenancy is not configured."""
+        with self._lock:
+            return {k: len(q) for k, q in self._tqueues.items()}
 
     # ------------------------------------------------------------------ #
     # producer side (any thread)
@@ -151,21 +224,29 @@ class ContinuousBatchScheduler:
                 f"pool's max_len={self.pool.max_len}"
             )
         with self._lock:
-            if len(self._queue) >= self.max_queue:
+            if self._depth_locked() >= self.max_queue:
                 self.rejected_total += 1
                 raise RequestQueueFull(
                     f"admission queue is full ({self.max_queue} waiting); "
                     "add replicas, raise max_queue, or retry with backoff"
                 )
-            self._queue.append(request)
+            if self._tenancy is not None:
+                self._tenant_enqueue(request)
+            else:
+                self._queue.append(request)
             self.queued_total += 1
-            depth = len(self._queue)
+            depth = self._depth_locked()
         self._publish_depth(depth)
+
+    def _depth_locked(self) -> int:
+        if self._tenancy is not None:
+            return sum(len(q) for q in self._tqueues.values())
+        return len(self._queue)
 
     @property
     def queue_depth(self) -> int:
         with self._lock:
-            return len(self._queue)
+            return self._depth_locked()
 
     # ------------------------------------------------------------------ #
     # engine side (the loop thread)
@@ -186,6 +267,8 @@ class ContinuousBatchScheduler:
         too long (see the module docstring)."""
         prefills: List[Tuple[Request, Slot]] = []
         expired: List[Request] = []
+        if self._tenancy is not None:
+            return self._tick_drr()
         with self._lock:
             if any(r.deadline is not None for r in self._queue):
                 now = time.perf_counter()
@@ -245,9 +328,132 @@ class ContinuousBatchScheduler:
                 self.on_evict(req)
         return Plan(prefills=prefills, decode_slots=self.pool.active_slots())
 
+    def _tick_drr(self) -> Plan:
+        """Tenancy-configured tick: deadline sweep over every tenant
+        queue, then deficit-round-robin admission.
+
+        The rotation pointer stays on one tenant until that tenant's
+        credit is spent, its queue drains, or its head is blocked by the
+        pool — then moves on. Credit (``weight`` per arrival, one unit
+        per admission) is what converges sustained admissions to the
+        weight ratio; the cap bounds how large a catch-up burst a
+        long-blocked tenant can bank. The head-skip/aging window runs
+        inside each tenant queue with that queue's own head, so
+        cross-tenant traffic can never age past a starved tenant's head
+        (the per-tenant aging fix)."""
+        prefills: List[Tuple[Request, Slot]] = []
+        expired: List[Request] = []
+        with self._lock:
+            for key, q in self._tqueues.items():
+                if not any(r.deadline is not None for r in q):
+                    continue
+                now = time.perf_counter()
+                kept: Deque[Request] = deque()
+                for req in q:
+                    if req.deadline is not None and now > req.deadline:
+                        expired.append(req)
+                        self.expired_total += 1
+                    else:
+                        kept.append(req)
+                self._tqueues[key] = kept
+            while self._order and len(prefills) < self.max_prefills_per_tick:
+                key = self._order[0]
+                # dict lookup, not a queue read (rltcheck: .get() on a
+                # mapping named *queues trips the blocking-under-lock lint)
+                q = self._tqueues[key] if key in self._tqueues else None
+                if not q:
+                    self._retire_tenant(key)
+                    continue
+                if self._deficit.get(key, 0.0) < 1.0:
+                    weight = float(self._tenancy.weight(key or None))
+                    cap = max(weight, 1.0) + float(self.max_prefills_per_tick)
+                    self._deficit[key] = min(
+                        self._deficit.get(key, 0.0) + weight, cap
+                    )
+                if self._admit_tenant(key, q, prefills):
+                    # pool block: the SHARED server refused this tenant's
+                    # head — not the tenant's fault, so the pointer (and
+                    # its remaining credit) stays put and the next tick
+                    # resumes right here. Rotating here would hand every
+                    # fresh tick's pool capacity to whoever sorts first,
+                    # collapsing the weight ratio to round-robin.
+                    break
+                if not q:
+                    # drained: residual credit is forfeit (classic DRR —
+                    # an idle tenant must not bank credit while absent)
+                    self._retire_tenant(key)
+                elif self._deficit.get(key, 0.0) < 1.0:
+                    self._order.rotate(-1)  # credit spent: next tenant
+                # else: tick prefill budget exhausted with credit left —
+                # loop condition exits, pointer stays for the next tick
+            depth = self._depth_locked()
+            tenant_depths = {k: len(q) for k, q in self._tqueues.items()}
+        self._publish_depth(depth, tenant_depths)
+        if expired and self.on_evict is not None:
+            for req in expired:
+                self.on_evict(req)
+        return Plan(prefills=prefills, decode_slots=self.pool.active_slots())
+
+    def _admit_tenant(
+        self,
+        key: str,
+        q: Deque[Request],
+        prefills: List[Tuple[Request, Slot]],
+    ) -> bool:
+        """Admit from one tenant queue while credit/budget remain (lock
+        held). Returns True when the queue head was blocked by the pool
+        (deferral charged to THIS tenant's head only)."""
+        i = 0
+        while (
+            i < len(q)
+            and len(prefills) < self.max_prefills_per_tick
+            and self._deficit.get(key, 0.0) >= 1.0
+        ):
+            req = q[i]
+            # per-tenant aging: an over-deferred head closes this
+            # tenant's skip-ahead window; other tenants are unaffected
+            if i > 0 and (q[0].deferred_ticks > self.head_aging_ticks):
+                return True
+            slot = self.pool.acquire(
+                req.request_id,
+                req.prompt_len,
+                req.max_new_tokens,
+                eos_id=req.eos_id,
+                prompt_tokens=req.tokens,
+                deadline=req.deadline,
+                priority=req.priority,
+            )
+            if slot is None:
+                if i == 0:
+                    req.deferred_ticks += 1
+                    self.deferred_total += 1
+                    if req.trace is not None:
+                        req.trace.deferred()
+                    if self.head_skip_limit == 0:
+                        return True
+                i += 1
+                if i > self.head_skip_limit:
+                    return True
+                continue
+            del q[i]
+            if i > 0:
+                self.skipped_total += 1
+            if req.trace is not None:
+                req.trace.admitted(slot.index)
+                slot.trace = req.trace
+            prefills.append((req, slot))
+            self._deficit[key] = self._deficit.get(key, 0.0) - 1.0
+            self.admitted_by_tenant[key] = (
+                self.admitted_by_tenant.get(key, 0) + 1
+            )
+            # do not advance i: the next element shifted into place
+        # scanned off the end with requests still queued: the pool
+        # refused everything reachable — a block, same as the head paths
+        return len(q) > 0 and i >= len(q)
+
     def has_work(self) -> bool:
         with self._lock:
-            queued = bool(self._queue)
+            queued = bool(self._queue) or any(self._tqueues.values())
         return queued or self.pool.occupancy > 0
 
     def drain_queue(self) -> List[Request]:
@@ -256,10 +462,22 @@ class ContinuousBatchScheduler:
         with self._lock:
             out = list(self._queue)
             self._queue.clear()
+            for key in list(self._tqueues):
+                out.extend(self._tqueues[key])
+                self._tqueues[key].clear()
+                self._retire_tenant(key)
         self._publish_depth(0)
         return out
 
-    def _publish_depth(self, depth: int) -> None:
+    def _publish_depth(
+        self, depth: int, tenant_depths: Optional[Dict[str, int]] = None
+    ) -> None:
         reg = _obs.registry()
         if reg is not None:
             reg.gauge("rlt_serve_queue_depth").set(depth)
+            if tenant_depths:
+                for key, tdepth in tenant_depths.items():
+                    label = reg.tenant_label(key or "default")
+                    reg.gauge(
+                        _metrics.TENANT_QUEUE_DEPTH_METRIC, tenant=label
+                    ).set(tdepth)

@@ -1,0 +1,303 @@
+"""Seeded arrival-trace generators + the JSONL recorded-trace format.
+
+A trace is a time-sorted list of :class:`ArrivalEvent` — *when* each
+request arrives, *which tenant* sent it, and its shape (prompt length,
+decode budget, priority). Generators draw from a nonhomogeneous Poisson
+process via thinning: candidate arrivals at the peak rate, accepted with
+probability ``rate(t) / peak``, which gives exact Poisson statistics for
+any bounded rate curve. Everything is ``random.Random(seed)``-driven —
+the same seed always reproduces the same trace, byte for byte, which is
+what makes a replay verdict a regression signal instead of an anecdote.
+
+Rate shapes:
+
+- :func:`diurnal_trace` — sinusoidal day/night cycle around a mean rate
+  (the classic 24h load curve, compressed to the trace duration).
+- :func:`bursty_trace` — an on/off modulated process: baseline rate with
+  periodic bursts at a multiple of it (batchy upstream clients).
+- :func:`flash_crowd_trace` — baseline multi-tenant traffic plus one
+  tenant spiking to a multiple of the total at a chosen instant, then
+  decaying exponentially (the launch-day / viral-link shape, and the
+  adversarial case for cross-tenant fairness).
+
+Prompt lengths draw uniform from a range, or heavy-tail (clipped Pareto)
+with ``heavy_tail=True`` — the long-prompt tail is what stresses
+admission (KV block pressure) and the head-skip/aging policy.
+
+The recorded format is JSONL: a header line (``kind: rlt-trace``) with
+generator metadata, then one event per line. :func:`write_trace` /
+:func:`read_trace` round-trip it; hand-edited or production-recorded
+files replay the same way.
+"""
+from __future__ import annotations
+
+import json
+import math
+import random
+from dataclasses import asdict, dataclass
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from ray_lightning_tpu.utils.fsio import atomic_writer
+
+__all__ = [
+    "ArrivalEvent",
+    "TRACE_KIND",
+    "TRACE_VERSION",
+    "bursty_trace",
+    "diurnal_trace",
+    "flash_crowd_trace",
+    "heavy_tail_prompt_len",
+    "read_trace",
+    "write_trace",
+]
+
+TRACE_KIND = "rlt-trace"
+TRACE_VERSION = 1
+
+
+@dataclass(frozen=True)
+class ArrivalEvent:
+    """One request arrival: offset from trace start + request shape."""
+
+    t: float
+    tenant: Optional[str] = None
+    prompt_len: int = 8
+    max_new_tokens: int = 8
+    priority: int = 0
+
+
+def heavy_tail_prompt_len(
+    rng: random.Random, lo: int, hi: int, alpha: float = 1.5
+) -> int:
+    """Clipped-Pareto prompt length in ``[lo, hi]``: mostly short, with
+    the occasional near-``hi`` monster the uniform draw never produces."""
+    if hi <= lo:
+        return int(lo)
+    span = (rng.paretovariate(alpha) - 1.0) / 9.0  # ~90% below 1.0
+    return int(lo + min(1.0, span) * (hi - lo))
+
+
+def _pick_tenant(
+    rng: random.Random, tenants: Optional[Dict[str, float]]
+) -> Optional[str]:
+    """Sample a tenant from a ``{name: mix_weight}`` traffic mix (None =
+    classless single-tenant traffic)."""
+    if not tenants:
+        return None
+    names = sorted(tenants)
+    weights = [max(0.0, float(tenants[n])) for n in names]
+    total = sum(weights)
+    if total <= 0:
+        return names[0]
+    x = rng.random() * total
+    for name, w in zip(names, weights):
+        x -= w
+        if x <= 0:
+            return name
+    return names[-1]
+
+
+def _thinned_arrivals(
+    rng: random.Random,
+    duration_s: float,
+    rate_fn: Callable[[float], float],
+    peak: float,
+) -> Iterator[float]:
+    """Nonhomogeneous Poisson arrivals on ``[0, duration_s)`` by
+    thinning against the peak rate."""
+    if peak <= 0:
+        return
+    t = 0.0
+    while True:
+        t += rng.expovariate(peak)
+        if t >= duration_s:
+            return
+        if rng.random() * peak <= max(0.0, rate_fn(t)):
+            yield t
+
+
+def _draw_prompt_len(
+    rng: random.Random, prompt_len: Tuple[int, int], heavy_tail: bool
+) -> int:
+    lo, hi = int(prompt_len[0]), int(prompt_len[1])
+    if heavy_tail:
+        return heavy_tail_prompt_len(rng, lo, hi)
+    return rng.randint(lo, max(lo, hi))
+
+
+def _events_from_rate(
+    rng: random.Random,
+    duration_s: float,
+    rate_fn: Callable[[float], float],
+    peak: float,
+    tenants: Optional[Dict[str, float]],
+    prompt_len: Tuple[int, int],
+    heavy_tail: bool,
+    max_new_tokens: int,
+    priority: int,
+    tenant_fn: Optional[Callable[[float], Optional[str]]] = None,
+) -> List[ArrivalEvent]:
+    events = []
+    for t in _thinned_arrivals(rng, duration_s, rate_fn, peak):
+        tenant = (
+            tenant_fn(t) if tenant_fn is not None
+            else _pick_tenant(rng, tenants)
+        )
+        events.append(
+            ArrivalEvent(
+                t=round(t, 6),
+                tenant=tenant,
+                prompt_len=_draw_prompt_len(rng, prompt_len, heavy_tail),
+                max_new_tokens=int(max_new_tokens),
+                priority=int(priority),
+            )
+        )
+    return events
+
+
+def diurnal_trace(
+    duration_s: float,
+    mean_rps: float,
+    tenants: Optional[Dict[str, float]] = None,
+    seed: int = 0,
+    amplitude: float = 0.8,
+    period_s: Optional[float] = None,
+    prompt_len: Tuple[int, int] = (4, 12),
+    heavy_tail: bool = False,
+    max_new_tokens: int = 8,
+    priority: int = 0,
+) -> List[ArrivalEvent]:
+    """Sinusoidal day/night cycle: rate(t) = mean * (1 + A sin(2πt/T)),
+    one full period over the trace by default."""
+    if not 0.0 <= amplitude <= 1.0:
+        raise ValueError(f"amplitude must be in [0, 1], got {amplitude}")
+    period = float(period_s) if period_s else float(duration_s)
+    rng = random.Random(seed)
+
+    def rate(t: float) -> float:
+        return mean_rps * (1.0 + amplitude * math.sin(2 * math.pi * t / period))
+
+    return _events_from_rate(
+        rng, duration_s, rate, mean_rps * (1.0 + amplitude),
+        tenants, prompt_len, heavy_tail, max_new_tokens, priority,
+    )
+
+
+def bursty_trace(
+    duration_s: float,
+    base_rps: float,
+    burst_mult: float = 5.0,
+    burst_every_s: float = 10.0,
+    burst_len_s: float = 2.0,
+    tenants: Optional[Dict[str, float]] = None,
+    seed: int = 0,
+    prompt_len: Tuple[int, int] = (4, 12),
+    heavy_tail: bool = False,
+    max_new_tokens: int = 8,
+    priority: int = 0,
+) -> List[ArrivalEvent]:
+    """On/off modulation: baseline rate, with ``burst_len_s`` windows at
+    ``burst_mult`` x baseline every ``burst_every_s`` seconds."""
+    rng = random.Random(seed)
+
+    def rate(t: float) -> float:
+        in_burst = (t % burst_every_s) < burst_len_s
+        return base_rps * (burst_mult if in_burst else 1.0)
+
+    return _events_from_rate(
+        rng, duration_s, rate, base_rps * max(1.0, burst_mult),
+        tenants, prompt_len, heavy_tail, max_new_tokens, priority,
+    )
+
+
+def flash_crowd_trace(
+    duration_s: float,
+    base_rps: float,
+    crowd_tenant: str,
+    crowd_at_s: float,
+    crowd_mult: float = 10.0,
+    decay_s: float = 5.0,
+    tenants: Optional[Dict[str, float]] = None,
+    seed: int = 0,
+    prompt_len: Tuple[int, int] = (4, 12),
+    heavy_tail: bool = False,
+    max_new_tokens: int = 8,
+    priority: int = 0,
+) -> List[ArrivalEvent]:
+    """Baseline multi-tenant traffic plus ONE tenant spiking to
+    ``crowd_mult`` x baseline at ``crowd_at_s``, decaying exponentially
+    with time constant ``decay_s``.
+
+    The adversarial fairness case: the crowd tenant's arrivals alone
+    would saturate the fleet, so the verdict's wait-ratio check is
+    exactly the question "did the other tenants still get their share".
+    """
+    rng = random.Random(seed)
+    mix = dict(tenants or {})
+    mix.setdefault(crowd_tenant, 1.0)
+
+    def crowd_rate(t: float) -> float:
+        if t < crowd_at_s:
+            return 0.0
+        return base_rps * crowd_mult * math.exp(-(t - crowd_at_s) / decay_s)
+
+    def rate(t: float) -> float:
+        return base_rps + crowd_rate(t)
+
+    def tenant_at(t: float) -> Optional[str]:
+        # an arrival at time t is crowd traffic with probability
+        # crowd_rate / total_rate (superposition of the two processes)
+        extra = crowd_rate(t)
+        if extra > 0 and rng.random() * (base_rps + extra) < extra:
+            return crowd_tenant
+        return _pick_tenant(rng, mix)
+
+    return _events_from_rate(
+        rng, duration_s, rate, base_rps * (1.0 + crowd_mult),
+        mix, prompt_len, heavy_tail, max_new_tokens, priority,
+        tenant_fn=tenant_at,
+    )
+
+
+def write_trace(
+    path: str, events: List[ArrivalEvent], **meta: object
+) -> None:
+    """Write the JSONL recorded-trace format: header line + one event
+    per line, time-sorted."""
+    header = {"kind": TRACE_KIND, "version": TRACE_VERSION}
+    header.update(meta)
+    with atomic_writer(path, mode="w") as fh:
+        fh.write(json.dumps(header, sort_keys=True) + "\n")
+        for ev in sorted(events, key=lambda e: e.t):
+            fh.write(json.dumps(asdict(ev), sort_keys=True) + "\n")
+
+
+def read_trace(path: str) -> Tuple[Dict[str, object], List[ArrivalEvent]]:
+    """Read a recorded trace; returns ``(header_meta, events)``."""
+    with open(path) as fh:
+        first = fh.readline()
+        if not first.strip():
+            raise ValueError(f"{path}: empty trace file")
+        header = json.loads(first)
+        if header.get("kind") != TRACE_KIND:
+            raise ValueError(
+                f"{path}: not a {TRACE_KIND} file (kind="
+                f"{header.get('kind')!r})"
+            )
+        events = []
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            events.append(
+                ArrivalEvent(
+                    t=float(rec["t"]),
+                    tenant=rec.get("tenant"),
+                    prompt_len=int(rec.get("prompt_len", 8)),
+                    max_new_tokens=int(rec.get("max_new_tokens", 8)),
+                    priority=int(rec.get("priority", 0)),
+                )
+            )
+    events.sort(key=lambda e: e.t)
+    return header, events

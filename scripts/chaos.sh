@@ -72,6 +72,16 @@ echo "== composed 3D parallelism shrink/regrow under the sanitizer =="
 RLT_SANITIZE=1 python -m pytest tests/test_parallel3d.py -v \
     -m parallel3d -p no:cacheprovider "$@"
 
+echo "== flash-crowd trace replay under a replica kill loop =="
+# the million-user scenario harness: a seeded flash-crowd trace replays
+# at 10x virtual time against a 2-replica fleet while replica0 crashes
+# on a sustained loop; the verdict must still show goodput summing to
+# wall time, guaranteed SLO attainment >= best_effort, and zero
+# quota-conformant starvation. RLT_SANITIZE=1 covers the DRR
+# scheduler's and token buckets' lock traffic under the churn.
+RLT_SANITIZE=1 python -m pytest tests/test_replay.py tests/test_tenancy.py \
+    -v -m replay -p no:cacheprovider "$@"
+
 echo "== legacy relaunch/retry path (slow) =="
 python -m pytest tests/test_cli_and_checkpointing.py -v -m slow \
     -k "retries or relaunch" -p no:cacheprovider "$@"

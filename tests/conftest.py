@@ -63,6 +63,7 @@ from ray_lightning_tpu.analysis.invariants import ThreadGuard  # noqa: E402
 # individually with @pytest.mark.sanitize.
 _SANITIZE_MARKERS = {
     "sanitize", "chaos", "elastic", "arbiter", "serving_chaos", "migration",
+    "replay",
 }
 
 

@@ -41,6 +41,7 @@ def _isolated_cache_dir(tmp_path, monkeypatch):
     monkeypatch.setenv("RLT_BENCH_DISAGG_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_PAGED_KERNEL_SWEEP", "0")
     monkeypatch.setenv("RLT_BENCH_PARALLELISM_SWEEP", "0")
+    monkeypatch.setenv("RLT_BENCH_REPLAY_SWEEP", "0")
 
 
 def _result(value, **detail):

@@ -1,0 +1,403 @@
+"""Multi-tenant QoS (ray_lightning_tpu/serving/tenancy.py + the DRR
+scheduler path): token-bucket quota math, tenant-class shed ordering,
+deficit-round-robin weight conformance, and the quota_rejected-vs-shed
+accounting split at the fleet front door.
+
+The acceptance bar: under saturation, per-tenant admissions converge to
+the configured DRR weights within 10% (including fractional weights and
+pool-blocked ticks); quota refusals are journalled ``quota_rejected``
+and NEVER counted as shed; ``guaranteed`` traffic is never shed at any
+watermark.
+
+Unit tests (FakePool, scripted clocks — no model, no jax) run first;
+the fleet-level quota e2e reuses the tiny-Llama fixture idiom.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_lightning_tpu.models.llama import LlamaConfig, init_params
+from ray_lightning_tpu.serving import (
+    LocalReplicaFleet,
+    QuotaExceeded,
+    TenantRegistry,
+    TenantSpec,
+    TokenBucket,
+    parse_tenant_specs,
+)
+from ray_lightning_tpu.serving.resilience import ShedPolicy
+from ray_lightning_tpu.serving.scheduler import (
+    ContinuousBatchScheduler,
+    Request,
+    RequestQueueFull,
+)
+
+pytestmark = pytest.mark.replay
+
+
+# --------------------------------------------------------------------- #
+# token-bucket quota math (scripted clock — no sleeping)
+# --------------------------------------------------------------------- #
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_token_bucket_burst_then_refill():
+    clock = _Clock()
+    bucket = TokenBucket(rate=2.0, capacity=4.0, clock=clock)
+    # starts full: the whole burst is available immediately
+    assert all(bucket.try_acquire() for _ in range(4))
+    assert not bucket.try_acquire()
+    # refill is rate * elapsed, capped at capacity
+    clock.t = 1.0  # +2 tokens
+    assert bucket.tokens() == pytest.approx(2.0)
+    assert bucket.try_acquire() and bucket.try_acquire()
+    assert not bucket.try_acquire()
+    clock.t = 100.0  # way past capacity: cap holds
+    assert bucket.tokens() == pytest.approx(4.0)
+    assert bucket.acquired_total == 6
+    assert bucket.refused_total == 2
+
+
+def test_token_bucket_zero_rate_is_a_fixed_allowance():
+    clock = _Clock()
+    bucket = TokenBucket(rate=0.0, capacity=2.0, clock=clock)
+    assert bucket.try_acquire() and bucket.try_acquire()
+    clock.t = 1e6  # never refills
+    assert not bucket.try_acquire()
+
+
+def test_token_bucket_clock_never_runs_backward():
+    clock = _Clock(10.0)
+    bucket = TokenBucket(rate=1.0, capacity=1.0, clock=clock)
+    assert bucket.try_acquire()
+    clock.t = 5.0  # regression must not mint negative tokens
+    assert bucket.tokens() == pytest.approx(0.0)
+
+
+def test_tenant_spec_validation():
+    with pytest.raises(ValueError):
+        TenantSpec(name="")
+    with pytest.raises(ValueError):
+        TenantSpec(name="x", tenant_class="platinum")
+    with pytest.raises(ValueError):
+        TenantSpec(name="x", weight=0.0)
+    with pytest.raises(ValueError):
+        TenantSpec(name="x", rate=-1.0)
+    with pytest.raises(ValueError):
+        TenantSpec(name="x", burst=0.5)
+    assert TenantSpec(name="x").resolved_burst() == 1.0
+    assert TenantSpec(name="x", rate=0.5).resolved_burst() == 1.0
+    assert TenantSpec(name="x", rate=8.0).resolved_burst() == 8.0
+    assert TenantSpec(name="x", rate=8.0, burst=2.0).resolved_burst() == 2.0
+
+
+def test_parse_tenant_specs_grammar():
+    specs = parse_tenant_specs("gold:guaranteed:4:50,free:best_effort:1:5:10")
+    assert [s.name for s in specs] == ["gold", "free"]
+    assert specs[0].tenant_class == "guaranteed"
+    assert specs[0].weight == 4.0 and specs[0].rate == 50.0
+    assert specs[1].burst == 10.0
+    # class is mandatory; weight/rate/burst default
+    (lone,) = parse_tenant_specs("solo:standard")
+    assert lone.weight == 1.0 and lone.rate is None
+    with pytest.raises(ValueError):
+        parse_tenant_specs("nocolon")
+    with pytest.raises(ValueError):
+        parse_tenant_specs(" , ")
+
+
+def test_registry_auto_registers_unknown_tenants_as_standard():
+    reg = TenantRegistry([TenantSpec("gold", tenant_class="guaranteed")])
+    # unknown names degrade to the default contract, never error
+    assert reg.tenant_class("drive-by") == "standard"
+    assert reg.weight("drive-by") == 1.0
+    assert reg.admit("drive-by")  # no quota on the default contract
+    assert "drive-by" in reg.names()
+    # classless traffic bypasses quota and gets unit weight
+    assert reg.tenant_class(None) is None
+    assert reg.weight(None) == 1.0
+    assert reg.admit(None)
+
+
+def test_registry_admit_accounting():
+    clock = _Clock()
+    reg = TenantRegistry(
+        [TenantSpec("metered", rate=0.0, burst=2.0)], clock=clock
+    )
+    assert reg.admit("metered") and reg.admit("metered")
+    assert not reg.admit("metered")
+    assert reg.admitted == {"metered": 2}
+    assert reg.quota_rejected == {"metered": 1}
+
+
+# --------------------------------------------------------------------- #
+# tenant-class shed ordering (ShedPolicy generalization)
+# --------------------------------------------------------------------- #
+def test_shed_policy_guaranteed_is_never_shed():
+    policy = ShedPolicy()
+    for depth in (0, 90, 100):
+        for burn in (False, True):
+            for prio in (0, 1, 5):
+                assert not policy.should_shed(
+                    prio, depth, 100, slo_breached=burn,
+                    tenant_class="guaranteed",
+                )
+
+
+def test_shed_policy_best_effort_sheds_first():
+    policy = ShedPolicy()  # best_effort_watermark=0.7, queue_watermark=0.9
+    # any priority — even 0 — sheds at the LOWER watermark
+    assert policy.should_shed(0, 70, 100, tenant_class="best_effort")
+    assert not policy.should_shed(0, 69, 100, tenant_class="best_effort")
+    # and instantly under SLO burn, regardless of depth
+    assert policy.should_shed(0, 0, 100, slo_breached=True,
+                              tenant_class="best_effort")
+    # standard traffic at the same depth is untouched (priority rule)
+    assert not policy.should_shed(0, 70, 100, tenant_class="standard")
+    assert not policy.should_shed(5, 70, 100, tenant_class="standard")
+
+
+def test_shed_policy_classless_matches_original_priority_rule():
+    policy = ShedPolicy()
+    for cls in (None, "standard"):
+        # priority 0 is protected below the watermark rules
+        assert not policy.should_shed(0, 100, 100, tenant_class=cls)
+        assert not policy.should_shed(0, 100, 100, slo_breached=True,
+                                      tenant_class=cls)
+        # priority >= floor sheds past the watermark or under burn
+        assert policy.should_shed(1, 90, 100, tenant_class=cls)
+        assert not policy.should_shed(1, 89, 100, tenant_class=cls)
+        assert policy.should_shed(1, 0, 100, slo_breached=True,
+                                  tenant_class=cls)
+
+
+# --------------------------------------------------------------------- #
+# DRR weight conformance (FakePool — pure scheduler)
+# --------------------------------------------------------------------- #
+class _FakeSlot:
+    def __init__(self, index):
+        self.index = index
+        self.trace = None
+
+
+class _FakePool:
+    """Grants up to ``per_tick`` acquisitions between ``reset_tick()``
+    calls (the shared-server bottleneck), refusing prompts at or above
+    ``refuse_at`` outright (the paged-pool big-prompt refusal shape)."""
+
+    max_len = 1 << 20
+
+    def __init__(self, per_tick=1 << 20, refuse_at=1 << 19):
+        self.per_tick = per_tick
+        self.refuse_at = refuse_at
+        self.granted_this_tick = 0
+        self._next = 0
+        self.occupancy = 0
+
+    def reset_tick(self):
+        self.granted_this_tick = 0
+
+    def acquire(self, request_id, prompt_len, max_new_tokens, **kw):
+        if prompt_len >= self.refuse_at:
+            return None
+        if self.granted_this_tick >= self.per_tick:
+            return None
+        self.granted_this_tick += 1
+        self._next += 1
+        return _FakeSlot(self._next)
+
+    def active_slots(self):
+        return []
+
+
+def _drr_sched(registry, pool, **kw):
+    kw.setdefault("max_queue", 1 << 16)
+    sched = ContinuousBatchScheduler(pool, **kw)
+    sched.configure_tenants(registry)
+    return sched
+
+
+def _flood(sched, tenant, n, start=0, prompt_len=4):
+    for i in range(n):
+        sched.submit(
+            Request(
+                request_id=f"{tenant or 'none'}-{start + i}",
+                tokens=(1,) * prompt_len,
+                max_new_tokens=4,
+                tenant=tenant,
+            )
+        )
+
+
+@pytest.mark.parametrize(
+    "weights",
+    [
+        {"gold": 4.0, "silver": 2.0, "bronze": 1.0},
+        {"gold": 4.0, "silver": 1.5, "bronze": 1.0, "scrap": 0.5},
+    ],
+)
+def test_drr_admissions_converge_to_weights(weights):
+    reg = TenantRegistry([TenantSpec(n, weight=w) for n, w in weights.items()])
+    pool = _FakePool()
+    sched = _drr_sched(reg, pool, max_prefills_per_tick=2)
+    ticks = 600
+    # saturation: every tenant queue stays non-empty the whole run
+    for name in weights:
+        _flood(sched, name, 2 * ticks + 16)
+    for _ in range(ticks):
+        pool.reset_tick()
+        sched.tick()
+    admitted = dict(sched.admitted_by_tenant)
+    total = sum(admitted.values())
+    assert total == 2 * ticks
+    wsum = sum(weights.values())
+    for name, w in weights.items():
+        share = admitted[name] / total
+        expect = w / wsum
+        assert share == pytest.approx(expect, rel=0.10), (name, admitted)
+
+
+def test_drr_holds_weights_under_pool_blocked_ticks():
+    """The shared pool refusing mid-tick must NOT reset the rotation:
+    a fresh tick resumes at the blocked tenant with its credit intact,
+    or the weight ratio collapses to round-robin (the pointer-rotation
+    bug this guards against gave the first-sorted tenant everything)."""
+    reg = TenantRegistry(
+        [TenantSpec("gold", weight=3.0), TenantSpec("bronze", weight=1.0)]
+    )
+    pool = _FakePool(per_tick=1)  # every tick blocks after ONE grant
+    sched = _drr_sched(reg, pool, max_prefills_per_tick=4)
+    ticks = 400
+    _flood(sched, "gold", ticks + 8)
+    _flood(sched, "bronze", ticks + 8)
+    for _ in range(ticks):
+        pool.reset_tick()
+        sched.tick()
+    admitted = sched.admitted_by_tenant
+    assert admitted["bronze"] > 0  # zero here = the starvation bug
+    ratio = admitted["gold"] / admitted["bronze"]
+    assert ratio == pytest.approx(3.0, rel=0.10), admitted
+
+
+def test_drr_per_tenant_head_aging_closes_skip_window():
+    reg = TenantRegistry([TenantSpec("a", weight=1.0)])
+    pool = _FakePool(refuse_at=100)
+    sched = _drr_sched(
+        reg, pool, max_prefills_per_tick=1, head_skip_limit=4,
+        head_aging_ticks=2,
+    )
+    # head is permanently refused (too big); three small ones behind it
+    _flood(sched, "a", 1, prompt_len=100)
+    _flood(sched, "a", 3, start=1, prompt_len=4)
+    admitted = []
+    for _ in range(8):
+        pool.reset_tick()
+        plan = sched.tick()
+        admitted.extend(req.request_id for req, _ in plan.prefills)
+    # the skip window admits while the head ages (deferred_ticks <= 2),
+    # then the aged head closes this tenant's window for good
+    assert admitted == ["a-1", "a-2"]
+    assert sched.tenant_depths()["a"] == 2  # blocked head + a-3 still queued
+    assert sched.deferred_total >= 3
+
+
+def test_drr_retires_drained_tenants_and_forfeits_credit():
+    reg = TenantRegistry(
+        [TenantSpec("burst", weight=8.0), TenantSpec("steady", weight=1.0)]
+    )
+    pool = _FakePool()
+    sched = _drr_sched(reg, pool, max_prefills_per_tick=1)
+    _flood(sched, "burst", 1)
+    _flood(sched, "steady", 4)
+    for _ in range(5):
+        pool.reset_tick()
+        sched.tick()
+    # burst's single request spent 1 of its 8 credits; the residual is
+    # forfeit on drain, so steady still got every remaining tick
+    assert sched.admitted_by_tenant == {"burst": 1, "steady": 4}
+    assert not sched.has_work()
+
+
+def test_drr_migrates_preexisting_backlog_and_bounds_queue():
+    reg = TenantRegistry([TenantSpec("t", weight=1.0)])
+    pool = _FakePool()
+    sched = ContinuousBatchScheduler(pool, max_queue=4)
+    _flood(sched, "t", 2)  # queued single-queue, before tenancy lands
+    sched.configure_tenants(reg)
+    _flood(sched, "t", 2, start=2)
+    with pytest.raises(RequestQueueFull):
+        _flood(sched, "t", 1, start=4)  # bound spans the tenant queues
+    assert sched.tenant_depths() == {"t": 4}
+    pool.reset_tick()
+    plan = sched.tick()
+    assert [r.request_id for r, _ in plan.prefills] == ["t-0"]  # FIFO kept
+
+
+# --------------------------------------------------------------------- #
+# fleet front door: quota_rejected is NOT shed (tiny model e2e)
+# --------------------------------------------------------------------- #
+def _cfg():
+    return dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return init_params(jax.random.key(0), cfg), cfg
+
+
+ENGINE_KW = dict(num_slots=4, max_prompt_len=16, max_len=32, max_queue=64)
+
+
+def test_fleet_quota_rejection_is_not_shed(model):
+    params, cfg = model
+    clock = _Clock()
+    registry = TenantRegistry(
+        [
+            TenantSpec("gold", tenant_class="guaranteed", weight=4.0),
+            TenantSpec("metered", rate=0.0, burst=2.0),
+        ],
+        clock=clock,
+    )
+    fleet = LocalReplicaFleet(
+        lambda: (params, cfg),
+        engine_kwargs=ENGINE_KW,
+        initial_replicas=1,
+        tenants=registry,
+    )
+    try:
+        done = [
+            fleet.submit([1, 2], max_new_tokens=3, tenant="metered")
+            for _ in range(2)
+        ]
+        with pytest.raises(QuotaExceeded) as exc_info:
+            fleet.submit([1, 2], max_new_tokens=3, tenant="metered")
+        # QuotaExceeded IS a RequestQueueFull (backoff handlers keep
+        # working) but journals as its own disposition, never shed
+        assert isinstance(exc_info.value, RequestQueueFull)
+        for entry in done:
+            assert entry.result(timeout=120)
+            assert entry.disposition == "completed"
+            assert entry.tenant == "metered"
+        # unmetered + classless traffic is untouched by the bucket
+        assert fleet.submit(
+            [3, 1], max_new_tokens=3, tenant="gold"
+        ).result(timeout=120)
+        assert fleet.submit([3, 1], max_new_tokens=3).result(timeout=120)
+        stats = fleet.journal.stats()
+        assert stats["quota_rejected"] == 1
+        assert stats["shed"] == 0
+        assert stats["completed"] == 4
+        # the quota was charged ONCE, at the fleet front door — engines
+        # run with admission disabled, so no double-spend
+        assert registry.admitted["metered"] == 2
+        assert registry.quota_rejected["metered"] == 1
+    finally:
+        fleet.shutdown()
